@@ -1,0 +1,14 @@
+# Mirrors the reference's Makefile contract (race-enabled full suite with a
+# wall-clock budget, Makefile:1-6) — Python's analog: the full suite on the
+# virtual 8-device CPU mesh with a hard timeout.
+
+.PHONY: test bench lint
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+lint:
+	python -m compileall -q ptype_tpu

@@ -1,0 +1,186 @@
+"""Actor server: register handlers, serve calls.
+
+The reference's servers were stdlib ``net/rpc``: ``rpc.Register(&Calculator{})``
++ ``rpc.HandleHTTP()`` + ``http.ListenAndServe`` (example/calculator/server.go:
+16-20,38). Here the equivalent is :class:`ActorServer`: register an object
+(its public methods become ``Type.Method`` endpoints, net/rpc naming) or a
+bare function, then ``serve()``.
+
+TPU-native behaviors:
+- payloads ride :mod:`ptype_tpu.codec`, so tensor args arrive as device
+  buffers (``jax.device_put``) rather than pickled host objects;
+- same-process calls short-circuit the socket entirely (see
+  ``lookup_local``), which is how actor calls between services that share a
+  host process stay zero-copy.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import traceback
+
+from ptype_tpu import codec, logs
+from ptype_tpu.coord import wire
+
+log = logs.get_logger("actor")
+
+# Process-local server registry for zero-copy same-process dispatch.
+_local_servers: dict[tuple[str, int], "ActorServer"] = {}
+_local_lock = threading.Lock()
+
+
+def lookup_local(address: str, port: int) -> "ActorServer | None":
+    with _local_lock:
+        server = _local_servers.get((address, port))
+    if server is not None and not server.serving:
+        return None
+    return server
+
+
+class ActorServer:
+    """Registers handlers and serves actor calls over TCP."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        # Default binds all interfaces, matching the reference's
+        # http.ListenAndServe(":port") (server.go:38) — the registry
+        # advertises the host's routable IP (cluster.go:198-213), so the
+        # server must be reachable on it.
+        self._handlers: dict[str, object] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._closed = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ handlers
+
+    def register(self, obj: object, name: str = "") -> None:
+        """Expose ``obj``'s public methods as ``Name.Method`` endpoints
+        (net/rpc naming: ref example/calculator/calculator.go:9-12 exposes
+        ``Calculator.Multiply``)."""
+        name = name or type(obj).__name__
+        for attr in dir(obj):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(obj, attr)
+            if callable(fn):
+                self._handlers[f"{name}.{attr}"] = fn
+
+    def register_function(self, name: str, fn) -> None:
+        self._handlers[name] = fn
+
+    @property
+    def methods(self) -> list[str]:
+        return sorted(self._handlers)
+
+    # ------------------------------------------------------------- serving
+
+    @property
+    def serving(self) -> bool:
+        return self._thread is not None and not self._closed.is_set()
+
+    def serve(self) -> "ActorServer":
+        """Start serving in the background; returns self."""
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"actor-{self.port}", daemon=True
+        )
+        self._thread.start()
+        with _local_lock:
+            _local_servers[(self.host, self.port)] = self
+            # Alias every address a registry entry might advertise for this
+            # server, so in-process clients short-circuit regardless of
+            # which name they dial.
+            _local_servers[("127.0.0.1", self.port)] = self
+            from ptype_tpu.cluster import get_ip
+
+            _local_servers[(get_ip(), self.port)] = self
+        log.info("actor server listening",
+                 kv={"addr": f"{self.host}:{self.port}",
+                     "methods": len(self._handlers)})
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name=f"actor-conn-{peer[1]}", daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._closed.is_set():
+                try:
+                    msg = wire.recv_msg(conn)
+                except (wire.WireError, OSError):
+                    return
+                args_blob = None
+                if msg.get("args_len"):
+                    try:
+                        args_blob = wire._recv_exact(conn, msg["args_len"])
+                    except (wire.WireError, OSError):
+                        return
+                # net/rpc services requests concurrently; so do we.
+                threading.Thread(
+                    target=self._handle_request,
+                    args=(conn, send_lock, msg, args_blob),
+                    daemon=True,
+                ).start()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_request(self, conn, send_lock, msg: dict, args_blob) -> None:
+        req_id = msg.get("id")
+        method = msg.get("method", "")
+        try:
+            args = codec.decode(args_blob) if args_blob is not None else ()
+            result = self.dispatch(method, args)
+            result_blob = codec.encode(result)
+            reply = {"id": req_id, "ok": True, "result_len": len(result_blob)}
+        except Exception as e:  # noqa: BLE001 — server must not die
+            reply = {"id": req_id, "ok": False, "error": f"{type(e).__name__}: {e}",
+                     "traceback": traceback.format_exc()}
+            result_blob = b""
+        try:
+            payload = json.dumps(reply, separators=(",", ":")).encode()
+            # One sendall keeps the header frame and result blob adjacent.
+            with send_lock:
+                conn.sendall(struct.pack(">I", len(payload)) + payload + result_blob)
+        except OSError:
+            pass
+
+    def dispatch(self, method: str, args):
+        """Invoke a handler directly (used by the zero-copy local path)."""
+        fn = self._handlers.get(method)
+        if fn is None:
+            raise AttributeError(f"no such method: {method!r}")
+        if isinstance(args, (list, tuple)):
+            return fn(*args)
+        return fn(args)
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with _local_lock:
+            for key in [k for k, v in _local_servers.items() if v is self]:
+                del _local_servers[key]
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,136 @@
+"""Actor RPC payload codec: JSON structure + raw tensor blobs.
+
+The reference marshalled actor payloads with gob (net/rpc default,
+rpc.go:277). The TPU-native requirement (BASELINE.json north star) is that
+tensor payloads land as device buffers, not as generic object graphs — so
+the codec splits every payload into (a) a JSON-safe structure and (b) a list
+of contiguous binary blobs for arrays, which are materialized on the
+receiving side with ``jax.device_put`` (JAX arrays) or ``np.frombuffer``
+(NumPy). Blob bytes are written directly after the header — no base64, no
+copy through a JSON string.
+
+Frame layout::
+
+    [4B header_len][header JSON][blob 0][blob 1]...
+
+Header: ``{"tree": <structure>, "blobs": [len0, len1, ...]}`` where arrays
+appear in the structure as ``{"__tensor__": i, "dtype": ..., "shape": ...,
+"kind": "jax"|"np"}`` and raw bytes as ``{"__bytes__": i}``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+try:
+    # Registers bfloat16/fp8 etc. with NumPy's dtype system so
+    # np.dtype("bfloat16") round-trips; ships with JAX.
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+_LEN = struct.Struct(">I")
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _is_jax_array(x: Any) -> bool:
+    # Avoid importing jax eagerly for pure-control-plane processes.
+    mod = type(x).__module__
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return False
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def encode(payload: Any) -> bytes:
+    """Serialize an arbitrary pytree-ish payload into one frame."""
+    blobs: list[bytes | memoryview] = []
+
+    def enc(x: Any):
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return x
+        if isinstance(x, (bytes, bytearray, memoryview)):
+            blobs.append(bytes(x))
+            return {"__bytes__": len(blobs) - 1}
+        if isinstance(x, np.ndarray):
+            arr = np.ascontiguousarray(x)
+            blobs.append(memoryview(arr).cast("B"))
+            return {"__tensor__": len(blobs) - 1, "dtype": str(arr.dtype),
+                    "shape": list(arr.shape), "kind": "np"}
+        if _is_jax_array(x):
+            arr = np.asarray(x)  # device -> host transfer happens here
+            arr = np.ascontiguousarray(arr)
+            blobs.append(memoryview(arr).cast("B"))
+            return {"__tensor__": len(blobs) - 1,
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape), "kind": "jax"}
+        if isinstance(x, np.generic):
+            return enc(np.asarray(x))
+        if isinstance(x, (list, tuple)):
+            tag = "__list__" if isinstance(x, list) else "__tuple__"
+            return {tag: [enc(v) for v in x]}
+        if isinstance(x, dict):
+            for k in x:
+                if not isinstance(k, str):
+                    raise CodecError(f"dict keys must be str, got {type(k)}")
+                if k.startswith("__") and k.endswith("__"):
+                    raise CodecError(f"reserved key name: {k!r}")
+            return {k: enc(v) for k, v in x.items()}
+        raise CodecError(f"cannot encode {type(x).__name__}")
+
+    tree = enc(payload)
+    header = json.dumps(
+        {"tree": tree, "blobs": [len(b) for b in blobs]},
+        separators=(",", ":"),
+    ).encode("utf-8")
+    parts = [_LEN.pack(len(header)), header]
+    parts.extend(bytes(b) for b in blobs)
+    return b"".join(parts)
+
+
+def decode(frame: bytes | memoryview, device: Any = None) -> Any:
+    """Deserialize a frame.
+
+    ``device``: optional JAX device (or sharding) that ``kind=="jax"``
+    tensors are placed onto; default is JAX's default device. NumPy tensors
+    stay on host either way.
+    """
+    frame = memoryview(frame)
+    (header_len,) = _LEN.unpack(frame[: _LEN.size])
+    header = json.loads(bytes(frame[_LEN.size : _LEN.size + header_len]))
+    blob_lens = header["blobs"]
+    blobs: list[memoryview] = []
+    offset = _LEN.size + header_len
+    for blen in blob_lens:
+        blobs.append(frame[offset : offset + blen])
+        offset += blen
+
+    def dec(x: Any):
+        if isinstance(x, dict):
+            if "__bytes__" in x:
+                return bytes(blobs[x["__bytes__"]])
+            if "__tensor__" in x:
+                arr = np.frombuffer(
+                    blobs[x["__tensor__"]], dtype=np.dtype(x["dtype"])
+                ).reshape(x["shape"])
+                if x.get("kind") == "jax":
+                    import jax
+
+                    return jax.device_put(arr, device)
+                return arr
+            if "__list__" in x:
+                return [dec(v) for v in x["__list__"]]
+            if "__tuple__" in x:
+                return tuple(dec(v) for v in x["__tuple__"])
+            return {k: dec(v) for k, v in x.items()}
+        return x
+
+    return dec(header["tree"])

@@ -1,0 +1,58 @@
+"""Coordination service — the control-plane substrate.
+
+The reference embedded a raft-replicated etcd member in every process
+(cluster/cluster.go:161-196). The TPU-native equivalent is the model JAX's
+own distributed runtime uses: a **single coordinator process** serving a
+linearizable KV with leases and watches, and every other process a client.
+This trades raft availability for the simplicity that matches how TPU pods
+are actually scheduled (a fixed process set with process 0 as coordinator);
+durability comes from Store snapshots to ``data_dir`` rather than a raft log.
+
+Three tiers, mirroring the reference's test seams (SURVEY.md §4):
+
+- :class:`ptype_tpu.coord.core.CoordState` — the authoritative in-memory
+  state machine (KV + revisions, leases + TTL, prefix watches, members,
+  barriers).
+- :class:`ptype_tpu.coord.local.LocalCoord` — in-process backend wrapping a
+  (possibly shared) ``CoordState`` (the embedded-etcd test tier).
+- :class:`ptype_tpu.coord.service.CoordServer` /
+  :class:`ptype_tpu.coord.remote.RemoteCoord` — TCP server + client for real
+  multi-process clusters.
+"""
+
+from ptype_tpu.coord.core import (
+    CoordState,
+    Event,
+    EventType,
+    KVItem,
+    Lease,
+    Member,
+    RangeOptions,
+    SortOrder,
+    SortTarget,
+    Watch,
+)
+from ptype_tpu.coord.local import LocalCoord, local_coord, reset_local_coords
+from ptype_tpu.coord.service import CoordServer
+from ptype_tpu.coord.remote import RemoteCoord
+from ptype_tpu.coord.api import CoordBackend, connect
+
+__all__ = [
+    "CoordBackend",
+    "CoordServer",
+    "CoordState",
+    "Event",
+    "EventType",
+    "KVItem",
+    "Lease",
+    "LocalCoord",
+    "Member",
+    "RangeOptions",
+    "RemoteCoord",
+    "SortOrder",
+    "SortTarget",
+    "Watch",
+    "connect",
+    "local_coord",
+    "reset_local_coords",
+]

@@ -1,0 +1,80 @@
+"""Abstract coordination backend + connection factory.
+
+Everything above the coordination layer (registry, store, cluster) programs
+against :class:`CoordBackend`, never a concrete transport — preserving the
+reference's interface seam that made its RPC layer testable with a mock
+registry (SURVEY.md §4 tier 2, registry.go:17-21).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ptype_tpu.coord.core import Member, RangeOptions, RangeResult, Watch
+
+
+class CoordBackend(abc.ABC):
+    """KV + leases + watches + members + barrier, transport-agnostic."""
+
+    # KV
+    @abc.abstractmethod
+    def put(self, key: str, value: str, lease: int = 0) -> int: ...
+
+    @abc.abstractmethod
+    def range(self, key: str, options: RangeOptions | None = None) -> RangeResult: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str, options: RangeOptions | None = None) -> int: ...
+
+    # Leases
+    @abc.abstractmethod
+    def grant(self, ttl: float) -> int: ...
+
+    @abc.abstractmethod
+    def keepalive(self, lease_id: int) -> float: ...
+
+    @abc.abstractmethod
+    def revoke(self, lease_id: int) -> None: ...
+
+    # Watches
+    @abc.abstractmethod
+    def watch(self, prefix: str) -> Watch: ...
+
+    # Membership
+    @abc.abstractmethod
+    def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member: ...
+
+    @abc.abstractmethod
+    def member_remove(self, member_id: int) -> bool: ...
+
+    @abc.abstractmethod
+    def member_list(self) -> list[Member]: ...
+
+    # Synchronization
+    @abc.abstractmethod
+    def barrier(self, name: str, count: int, timeout: float | None = None) -> bool: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+def connect(
+    address: str,
+    *,
+    dial_timeout: float = 5.0,
+    in_process: bool = False,
+) -> CoordBackend:
+    """Dial a coordination backend.
+
+    ``in_process=True`` (or an address of the form ``local:<name>``) returns
+    the shared in-process backend — the embedded-etcd-style test tier.
+    Otherwise dials the TCP coordination service at ``host:port`` with the
+    reference's 5s default dial timeout (registry.go:37).
+    """
+    from ptype_tpu.coord.local import local_coord
+    from ptype_tpu.coord.remote import RemoteCoord
+
+    if in_process or address.startswith("local:"):
+        name = address.split(":", 1)[1] if address.startswith("local:") else address
+        return local_coord(name)
+    return RemoteCoord(address, dial_timeout=dial_timeout)

@@ -1,0 +1,468 @@
+"""The coordination state machine: KV + revisions, leases, watches, members.
+
+This is the authoritative store behind both the in-process backend
+(:mod:`ptype_tpu.coord.local`) and the TCP service
+(:mod:`ptype_tpu.coord.service`). Linearizability is by construction — every
+mutation takes one lock and bumps one revision counter — which is the role
+raft quorum played for the reference's Store (SURVEY.md §3.4).
+
+Capability parity targets (all behaviors the reference's tests encode):
+- lease-expiry liveness: key granted under a TTL lease disappears after the
+  TTL unless kept alive (ref: registry.go:58-83, registry_test.go:135-147);
+- watch streams that fire on any change under a prefix
+  (ref: registry.go:119-150);
+- range queries with prefix/limit/sort/keys-only/count-only options
+  (ref: store_config.go:33-103).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+from ptype_tpu import logs
+from ptype_tpu.errors import CoordinationError
+
+log = logs.get_logger("coord")
+
+
+class EventType(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+
+
+class SortOrder(enum.Enum):
+    NONE = "none"
+    ASCEND = "ascend"
+    DESCEND = "descend"
+
+
+class SortTarget(enum.Enum):
+    KEY = "key"
+    VERSION = "version"
+    CREATE = "create"
+    MOD = "mod"
+    VALUE = "value"
+
+
+@dataclass(frozen=True)
+class KVItem:
+    key: str
+    value: str
+    create_rev: int
+    mod_rev: int
+    version: int  # number of writes to this key since creation
+    lease: int = 0  # 0 = no lease
+
+
+@dataclass(frozen=True)
+class Event:
+    type: EventType
+    key: str
+    value: str  # empty for DELETE
+    mod_rev: int
+
+
+@dataclass
+class Lease:
+    id: int
+    ttl: float
+    expires_at: float
+    keys: set[str] = field(default_factory=set)
+
+
+@dataclass(frozen=True)
+class Member:
+    id: int
+    name: str
+    peer_addr: str
+    metadata: dict = field(default_factory=dict)
+
+
+def prefix_range_end(prefix: str) -> str:
+    """Smallest key greater than every key with this prefix.
+
+    Mirrors clientv3.GetPrefixRangeEnd (ref: store_config.go:41-58) at the
+    granularity of this keyspace: the reference bumped the last non-0xff
+    *byte*; our keys are unicode strings, so bump the last non-maximal
+    *code point*. Empty / unbumpable prefixes mean "to the end".
+    """
+    for i in reversed(range(len(prefix))):
+        c = ord(prefix[i])
+        if c < 0x10FFFF:
+            return prefix[:i] + chr(c + 1)
+    return "\0"  # etcd's "range to end" sentinel
+
+
+@dataclass
+class RangeOptions:
+    """Query modifiers (ref: store_config.go:33-103 re-exports)."""
+
+    prefix: bool = False
+    range_end: str = ""  # explicit [key, range_end) range
+    from_key: bool = False  # [key, end-of-keyspace)
+    limit: int = 0  # 0 = no limit
+    sort_order: SortOrder = SortOrder.NONE
+    sort_target: SortTarget = SortTarget.KEY
+    keys_only: bool = False
+    count_only: bool = False
+    serializable: bool = False  # no-op here: every read is linearizable
+    min_mod_rev: int = 0
+
+    def to_wire(self) -> dict:
+        return {
+            "prefix": self.prefix,
+            "range_end": self.range_end,
+            "from_key": self.from_key,
+            "limit": self.limit,
+            "sort_order": self.sort_order.value,
+            "sort_target": self.sort_target.value,
+            "keys_only": self.keys_only,
+            "count_only": self.count_only,
+            "serializable": self.serializable,
+            "min_mod_rev": self.min_mod_rev,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "RangeOptions":
+        return RangeOptions(
+            prefix=d.get("prefix", False),
+            range_end=d.get("range_end", ""),
+            from_key=d.get("from_key", False),
+            limit=d.get("limit", 0),
+            sort_order=SortOrder(d.get("sort_order", "none")),
+            sort_target=SortTarget(d.get("sort_target", "key")),
+            keys_only=d.get("keys_only", False),
+            count_only=d.get("count_only", False),
+            serializable=d.get("serializable", False),
+            min_mod_rev=d.get("min_mod_rev", 0),
+        )
+
+
+@dataclass(frozen=True)
+class RangeResult:
+    items: list[KVItem]
+    count: int
+    revision: int
+
+
+class Watch:
+    """A stream of events for keys under a prefix.
+
+    Consumers iterate or call :meth:`get`; producers (CoordState) push.
+    Closing is idempotent; a closed watch raises ``StopIteration`` once
+    drained.
+    """
+
+    _CLOSED = object()
+
+    def __init__(self, watch_id: int, prefix: str, cancel_fn):
+        self.id = watch_id
+        self.prefix = prefix
+        self._cancel_fn = cancel_fn
+        self._cond = threading.Condition()
+        self._events: list[Event] = []
+        self._closed = False
+
+    def _push(self, events: list[Event]) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._events.extend(events)
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> list[Event]:
+        """Block for the next batch of events; [] on timeout or close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._events and not self._closed:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                self._cond.wait(remaining)
+            batch, self._events = self._events, []
+            return batch
+
+    def cancel(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._cancel_fn(self)
+
+    close = cancel
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __iter__(self):
+        while True:
+            batch = self.get()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            for ev in batch:
+                yield ev
+
+
+class CoordState:
+    """Single-lock linearizable KV + leases + watches + members + barriers."""
+
+    def __init__(self, sweep_interval: float = 0.25):
+        self._lock = threading.RLock()
+        self._kv: dict[str, KVItem] = {}
+        self._rev = 0
+        self._leases: dict[int, Lease] = {}
+        self._next_lease = 1
+        self._watches: list[Watch] = []
+        self._next_watch = 1
+        self._members: dict[int, Member] = {}
+        self._next_member = 1
+        self._barriers: dict[str, dict] = {}
+        self._barrier_cond = threading.Condition(self._lock)
+        self._closed = threading.Event()
+        self._sweep_interval = sweep_interval
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="coord-lease-sweeper", daemon=True
+        )
+        self._sweeper.start()
+
+    # ------------------------------------------------------------------ KV
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        if not key:
+            raise CoordinationError("put: empty key")
+        with self._lock:
+            if lease:
+                lr = self._leases.get(lease)
+                if lr is None:
+                    raise CoordinationError(f"put: lease {lease} not found")
+                lr.keys.add(key)
+            self._rev += 1
+            prev = self._kv.get(key)
+            item = KVItem(
+                key=key,
+                value=value,
+                create_rev=prev.create_rev if prev else self._rev,
+                mod_rev=self._rev,
+                version=(prev.version + 1) if prev else 1,
+                lease=lease,
+            )
+            self._kv[key] = item
+            self._notify([Event(EventType.PUT, key, value, self._rev)])
+            return self._rev
+
+    def range(self, key: str, options: RangeOptions | None = None) -> RangeResult:
+        opts = options or RangeOptions()
+        with self._lock:
+            lo, hi = self._bounds(key, opts)
+            items = [
+                it for k, it in self._kv.items()
+                if lo <= k and (hi is None or k < hi)
+            ]
+            if opts.min_mod_rev:
+                items = [it for it in items if it.mod_rev >= opts.min_mod_rev]
+            items = self._sort(items, opts)
+            count = len(items)
+            if opts.limit > 0:
+                items = items[: opts.limit]
+            if opts.count_only:
+                items = []
+            elif opts.keys_only:
+                items = [replace(it, value="") for it in items]
+            return RangeResult(items=items, count=count, revision=self._rev)
+
+    def delete(self, key: str, options: RangeOptions | None = None) -> int:
+        opts = options or RangeOptions()
+        with self._lock:
+            lo, hi = self._bounds(key, opts)
+            doomed = [
+                k for k in self._kv
+                if lo <= k and (hi is None or k < hi)
+            ]
+            if not doomed:
+                return 0
+            self._rev += 1
+            events = []
+            for k in doomed:
+                item = self._kv.pop(k)
+                if item.lease and item.lease in self._leases:
+                    self._leases[item.lease].keys.discard(k)
+                events.append(Event(EventType.DELETE, k, "", self._rev))
+            self._notify(events)
+            return len(doomed)
+
+    @staticmethod
+    def _bounds(key: str, opts: RangeOptions) -> tuple[str, str | None]:
+        """Resolve (lo, hi) key bounds; hi=None means single exact key."""
+        if opts.prefix:
+            end = prefix_range_end(key)
+            return key, (None if end == "\0" else end) or "￿" * 8
+        if opts.range_end:
+            return key, opts.range_end
+        if opts.from_key:
+            return key, "￿" * 8
+        # exact key: model as [key, key+minimal-successor)
+        return key, key + "\0"
+
+    @staticmethod
+    def _sort(items: list[KVItem], opts: RangeOptions) -> list[KVItem]:
+        keyfns = {
+            SortTarget.KEY: lambda it: it.key,
+            SortTarget.VERSION: lambda it: it.version,
+            SortTarget.CREATE: lambda it: it.create_rev,
+            SortTarget.MOD: lambda it: it.mod_rev,
+            SortTarget.VALUE: lambda it: it.value,
+        }
+        if opts.sort_order is SortOrder.NONE:
+            # etcd returns key-ascending by default
+            return sorted(items, key=lambda it: it.key)
+        return sorted(
+            items,
+            key=keyfns[opts.sort_target],
+            reverse=opts.sort_order is SortOrder.DESCEND,
+        )
+
+    # --------------------------------------------------------------- leases
+
+    def grant(self, ttl: float) -> int:
+        if ttl <= 0:
+            raise CoordinationError("grant: ttl must be > 0")
+        with self._lock:
+            lease_id = self._next_lease
+            self._next_lease += 1
+            self._leases[lease_id] = Lease(
+                id=lease_id, ttl=ttl, expires_at=time.monotonic() + ttl
+            )
+            return lease_id
+
+    def keepalive(self, lease_id: int) -> float:
+        """Refresh a lease; returns the new TTL. Raises if expired/unknown."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise CoordinationError(f"keepalive: lease {lease_id} not found")
+            lease.expires_at = time.monotonic() + lease.ttl
+            return lease.ttl
+
+    def revoke(self, lease_id: int) -> None:
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return
+            self._expire_keys(lease)
+
+    def _expire_keys(self, lease: Lease) -> None:
+        events = []
+        if lease.keys:
+            self._rev += 1
+        for k in sorted(lease.keys):
+            if k in self._kv and self._kv[k].lease == lease.id:
+                del self._kv[k]
+                events.append(Event(EventType.DELETE, k, "", self._rev))
+        if events:
+            self._notify(events)
+
+    def _sweep_loop(self) -> None:
+        while not self._closed.wait(self._sweep_interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    l for l in self._leases.values() if l.expires_at <= now
+                ]
+                for lease in expired:
+                    del self._leases[lease.id]
+                    self._expire_keys(lease)
+
+    # -------------------------------------------------------------- watches
+
+    def watch(self, prefix: str) -> Watch:
+        with self._lock:
+            w = Watch(self._next_watch, prefix, self._remove_watch)
+            self._next_watch += 1
+            self._watches.append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _notify(self, events: list[Event]) -> None:
+        # called under self._lock
+        for w in self._watches:
+            batch = [ev for ev in events if ev.key.startswith(w.prefix)]
+            if batch:
+                w._push(batch)
+
+    # -------------------------------------------------------------- members
+
+    def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member:
+        with self._lock:
+            m = Member(
+                id=self._next_member,
+                name=name,
+                peer_addr=peer_addr,
+                metadata=metadata or {},
+            )
+            self._next_member += 1
+            self._members[m.id] = m
+            return m
+
+    def member_remove(self, member_id: int) -> bool:
+        with self._lock:
+            return self._members.pop(member_id, None) is not None
+
+    def member_list(self) -> list[Member]:
+        with self._lock:
+            return sorted(self._members.values(), key=lambda m: m.id)
+
+    # ------------------------------------------------------------- barriers
+
+    def barrier(self, name: str, count: int, timeout: float | None = None) -> bool:
+        """Block until ``count`` participants reach the named barrier.
+
+        The reference got step-ordering for free from raft linearizability;
+        collective Store epochs need an explicit rendezvous (SURVEY.md §7
+        hard part: "barrier/epoch notion absent from the reference").
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._barrier_cond:
+            b = self._barriers.setdefault(name, {"arrived": 0, "gen": 0})
+            gen = b["gen"]
+            b["arrived"] += 1
+            if b["arrived"] >= count:
+                b["arrived"] = 0
+                b["gen"] += 1
+                self._barrier_cond.notify_all()
+                return True
+            while b["gen"] == gen:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        b["arrived"] = max(0, b["arrived"] - 1)
+                        return False
+                self._barrier_cond.wait(remaining)
+            return True
+
+    # ---------------------------------------------------------------- misc
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            watches = list(self._watches)
+        for w in watches:
+            w.cancel()

@@ -1,0 +1,240 @@
+"""TCP client for the coordination service."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ptype_tpu import logs
+from ptype_tpu.coord import wire
+from ptype_tpu.coord.api import CoordBackend
+from ptype_tpu.coord.core import (
+    Event,
+    EventType,
+    KVItem,
+    Member,
+    RangeOptions,
+    RangeResult,
+    Watch,
+)
+from ptype_tpu.errors import CoordinationError
+
+log = logs.get_logger("coord.remote")
+
+
+class _Pending:
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply: dict | None = None
+
+
+class RemoteCoord(CoordBackend):
+    """Client over one persistent connection; safe for concurrent use.
+
+    Dial timeout defaults to the reference's 5 s (registry.go:37,
+    store.go:25, cluster.go:53).
+    """
+
+    def __init__(self, address: str, dial_timeout: float = 5.0,
+                 request_timeout: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self._request_timeout = request_timeout
+        try:
+            self._sock = socket.create_connection(
+                (host, int(port)), timeout=dial_timeout
+            )
+        except OSError as e:
+            raise CoordinationError(
+                f"failed to dial coordination service at {address}: {e}"
+            ) from e
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, _Pending] = {}
+        self._pending_lock = threading.Lock()
+        self._watches: dict[int, Watch] = {}
+        self._watches_lock = threading.Lock()
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"coord-client-{address}", daemon=True
+        )
+        self._reader.start()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _read_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                msg = wire.recv_msg(self._sock)
+            except (wire.WireError, OSError):
+                break
+            if "watch" in msg and "id" not in msg:
+                self._dispatch_watch(msg)
+                continue
+            with self._pending_lock:
+                p = self._pending.pop(msg.get("id"), None)
+            if p is not None:
+                p.reply = msg
+                p.event.set()
+        # Connection is gone: fail everything outstanding.
+        self._closed.set()
+        with self._pending_lock:
+            pending, self._pending = list(self._pending.values()), {}
+        for p in pending:
+            p.event.set()
+        with self._watches_lock:
+            watches, self._watches = list(self._watches.values()), {}
+        for w in watches:
+            w.cancel()
+
+    def _dispatch_watch(self, msg: dict) -> None:
+        with self._watches_lock:
+            w = self._watches.get(msg["watch"])
+        if w is None:
+            return
+        events = [
+            Event(
+                type=EventType(ev["type"]),
+                key=ev["key"],
+                value=ev["value"],
+                mod_rev=ev["mod_rev"],
+            )
+            for ev in msg.get("events", [])
+        ]
+        w._push(events)
+
+    def _call(self, op: str, timeout: float | None = None, **kwargs):
+        if self._closed.is_set():
+            raise CoordinationError(f"coordination connection to {self.address} closed")
+        with self._id_lock:
+            req_id = self._next_id
+            self._next_id += 1
+        p = _Pending()
+        with self._pending_lock:
+            self._pending[req_id] = p
+        try:
+            wire.send_msg(self._sock, self._send_lock, {"id": req_id, "op": op, **kwargs})
+        except (wire.WireError, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise CoordinationError(f"send to {self.address} failed: {e}") from e
+        if not p.event.wait(timeout if timeout is not None else self._request_timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise CoordinationError(f"request {op!r} to {self.address} timed out")
+        if p.reply is None:
+            raise CoordinationError(f"connection to {self.address} lost mid-request")
+        if not p.reply.get("ok"):
+            raise CoordinationError(p.reply.get("error", "unknown coordination error"))
+        return p.reply.get("result")
+
+    # ------------------------------------------------------------------- KV
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        return self._call("put", key=key, value=value, lease=lease)
+
+    def range(self, key: str, options: RangeOptions | None = None) -> RangeResult:
+        res = self._call("range", key=key, options=(options or RangeOptions()).to_wire())
+        return RangeResult(
+            items=[KVItem(**it) for it in res["items"]],
+            count=res["count"],
+            revision=res["revision"],
+        )
+
+    def delete(self, key: str, options: RangeOptions | None = None) -> int:
+        return self._call("delete", key=key, options=(options or RangeOptions()).to_wire())
+
+    # --------------------------------------------------------------- leases
+
+    def grant(self, ttl: float) -> int:
+        return self._call("grant", ttl=ttl)
+
+    def keepalive(self, lease_id: int) -> float:
+        return self._call("keepalive", lease=lease_id)
+
+    def revoke(self, lease_id: int) -> None:
+        self._call("revoke", lease=lease_id)
+
+    # -------------------------------------------------------------- watches
+
+    def watch(self, prefix: str) -> Watch:
+        watch_id = self._call("watch", prefix=prefix)
+        w = Watch(watch_id, prefix, self._cancel_watch)
+        with self._watches_lock:
+            self._watches[watch_id] = w
+        return w
+
+    def _cancel_watch(self, w: Watch) -> None:
+        with self._watches_lock:
+            self._watches.pop(w.id, None)
+        if not self._closed.is_set():
+            try:
+                self._call("watch_cancel", watch=w.id)
+            except CoordinationError:
+                pass
+
+    # -------------------------------------------------------------- members
+
+    def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member:
+        m = self._call("member_add", name=name, peer_addr=peer_addr,
+                       metadata=metadata or {})
+        return Member(**m)
+
+    def member_remove(self, member_id: int) -> bool:
+        return self._call("member_remove", member=member_id)
+
+    def member_list(self) -> list[Member]:
+        return [Member(**m) for m in self._call("member_list")]
+
+    # ------------------------------------------------------------- barriers
+
+    def barrier(self, name: str, count: int, timeout: float | None = None) -> bool:
+        # Give the server-side wait headroom beyond the barrier timeout;
+        # the wire field "timeout" is the barrier's own deadline.
+        call_timeout = (timeout + 5.0) if timeout is not None else None
+        with self._id_lock:
+            req_id = self._next_id
+            self._next_id += 1
+        p = _Pending()
+        with self._pending_lock:
+            self._pending[req_id] = p
+        msg = {"id": req_id, "op": "barrier", "name": name, "count": count,
+               "timeout": timeout}
+        try:
+            wire.send_msg(self._sock, self._send_lock, msg)
+        except (wire.WireError, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise CoordinationError(f"send to {self.address} failed: {e}") from e
+        if not p.event.wait(call_timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise CoordinationError(f"barrier {name!r} rendezvous timed out")
+        if p.reply is None:
+            raise CoordinationError(f"connection to {self.address} lost mid-barrier")
+        if not p.reply.get("ok"):
+            raise CoordinationError(p.reply.get("error", "unknown coordination error"))
+        return p.reply.get("result")
+
+    # ---------------------------------------------------------------- misc
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        try:
+            return self._call("ping", timeout=timeout) == "pong"
+        except CoordinationError:
+            return False
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

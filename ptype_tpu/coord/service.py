@@ -1,0 +1,211 @@
+"""TCP coordination service — hosted by the seed node.
+
+The multi-process deployment model: the coordinator process (platform config
+``is_coordinator: true``) starts a :class:`CoordServer` over its
+:class:`CoordState`; every process (including the coordinator itself)
+connects with :class:`ptype_tpu.coord.remote.RemoteCoord` or, on the
+coordinator, may use :class:`LocalCoord` directly. This mirrors how the JAX
+distributed coordination service is deployed (process 0 hosts), replacing
+the reference's every-process-embeds-etcd model (cluster.go:161-196).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ptype_tpu import logs
+from ptype_tpu.coord import wire
+from ptype_tpu.coord.core import CoordState, RangeOptions, Watch
+
+log = logs.get_logger("coord.service")
+
+
+def _item_wire(it) -> dict:
+    return {
+        "key": it.key,
+        "value": it.value,
+        "create_rev": it.create_rev,
+        "mod_rev": it.mod_rev,
+        "version": it.version,
+        "lease": it.lease,
+    }
+
+
+def _member_wire(m) -> dict:
+    return {
+        "id": m.id,
+        "name": m.name,
+        "peer_addr": m.peer_addr,
+        "metadata": m.metadata,
+    }
+
+
+class CoordServer:
+    """Serves a CoordState over TCP. One instance per cluster seed."""
+
+    def __init__(self, address: str = "127.0.0.1:0", state: CoordState | None = None):
+        self.state = state or CoordState()
+        host, _, port = address.rpartition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "127.0.0.1", int(port)))
+        self._sock.listen(128)
+        self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
+        self._closed = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="coordd-accept", daemon=True
+        )
+        self._accept_thread.start()
+        log.info("coordination service listening", kv={"addr": self.address})
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn, peer),
+                name=f"coordd-conn-{peer[1]}",
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket, peer) -> None:
+        send_lock = threading.Lock()
+        watches: dict[int, Watch] = {}
+        watches_lock = threading.Lock()
+        try:
+            while not self._closed.is_set():
+                try:
+                    msg = wire.recv_msg(conn)
+                except (wire.WireError, OSError):
+                    return
+                # Blocking ops (barrier, watch pumps) must not stall the
+                # reader; dispatch every request to its own thread — control
+                # plane volume is low enough that this is simpler and safer
+                # than a pool.
+                threading.Thread(
+                    target=self._handle,
+                    args=(conn, send_lock, watches, watches_lock, msg),
+                    daemon=True,
+                ).start()
+        finally:
+            with watches_lock:
+                for w in watches.values():
+                    w.cancel()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn, send_lock, watches, watches_lock, msg: dict) -> None:
+        req_id = msg.get("id")
+        op = msg.get("op", "")
+        try:
+            result = self._dispatch(conn, send_lock, watches, watches_lock, op, msg)
+            reply = {"id": req_id, "ok": True, "result": result}
+        except Exception as e:  # noqa: BLE001 — remote surface must not die
+            reply = {"id": req_id, "ok": False, "error": str(e)}
+        try:
+            wire.send_msg(conn, send_lock, reply)
+        except (wire.WireError, OSError):
+            pass
+
+    def _dispatch(self, conn, send_lock, watches, watches_lock, op: str, msg: dict):
+        st = self.state
+        if op == "put":
+            return st.put(msg["key"], msg["value"], msg.get("lease", 0))
+        if op == "range":
+            res = st.range(msg["key"], RangeOptions.from_wire(msg.get("options", {})))
+            return {
+                "items": [_item_wire(it) for it in res.items],
+                "count": res.count,
+                "revision": res.revision,
+            }
+        if op == "delete":
+            return st.delete(msg["key"], RangeOptions.from_wire(msg.get("options", {})))
+        if op == "grant":
+            return st.grant(msg["ttl"])
+        if op == "keepalive":
+            return st.keepalive(msg["lease"])
+        if op == "revoke":
+            st.revoke(msg["lease"])
+            return None
+        if op == "watch":
+            w = st.watch(msg["prefix"])
+            with watches_lock:
+                watches[w.id] = w
+            threading.Thread(
+                target=self._pump_watch,
+                args=(conn, send_lock, watches, watches_lock, w),
+                name=f"coordd-watch-{w.id}",
+                daemon=True,
+            ).start()
+            return w.id
+        if op == "watch_cancel":
+            with watches_lock:
+                w = watches.pop(msg["watch"], None)
+            if w is not None:
+                w.cancel()
+            return None
+        if op == "member_add":
+            m = st.member_add(msg["name"], msg["peer_addr"], msg.get("metadata") or {})
+            return _member_wire(m)
+        if op == "member_remove":
+            return st.member_remove(msg["member"])
+        if op == "member_list":
+            return [_member_wire(m) for m in st.member_list()]
+        if op == "barrier":
+            return st.barrier(msg["name"], msg["count"], msg.get("timeout"))
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
+
+    def _pump_watch(self, conn, send_lock, watches, watches_lock, w: Watch) -> None:
+        while True:
+            batch = w.get(timeout=1.0)
+            if w.closed and not batch:
+                return
+            if not batch:
+                continue
+            push = {
+                "watch": w.id,
+                "events": [
+                    {"type": ev.type.value, "key": ev.key, "value": ev.value,
+                     "mod_rev": ev.mod_rev}
+                    for ev in batch
+                ],
+            }
+            try:
+                wire.send_msg(conn, send_lock, push)
+            except (wire.WireError, OSError):
+                w.cancel()
+                with watches_lock:
+                    watches.pop(w.id, None)
+                return
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.state.close()

@@ -1,0 +1,52 @@
+"""Length-prefixed JSON framing for the coordination protocol.
+
+The control plane is low-volume metadata (service records, small KV state,
+lease heartbeats) — JSON over TCP is the honest choice; tensors NEVER travel
+through here (they ride the actor RPC tensor codec or XLA collectives).
+
+Frame: 4-byte big-endian length, then UTF-8 JSON payload.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+MAX_FRAME = 64 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def send_msg(sock: socket.socket, lock: threading.Lock, msg: dict) -> None:
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(payload)} bytes")
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> dict:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise WireError(f"frame too large: {length} bytes")
+    payload = _recv_exact(sock, length)
+    return json.loads(payload.decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(remaining)
+        if not chunk:
+            raise WireError("connection closed")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

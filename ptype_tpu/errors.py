@@ -1,0 +1,63 @@
+"""Typed errors for ptype_tpu.
+
+The reference exposes two sentinel errors: ``ErrNoKey``
+(cluster/store.go:15) and ``ErrNoClientAvailable`` (cluster/rpc.go:15).
+Python idiom is exception *classes*; we provide those plus aliases with the
+reference names so ported call-sites read naturally.
+"""
+
+
+class ClusterError(Exception):
+    """Base class for every error raised by ptype_tpu."""
+
+
+class ConfigError(ClusterError):
+    """Configuration file missing, unparseable, or invalid."""
+
+
+class NoKeyError(ClusterError, KeyError):
+    """Key could not be found (ref: cluster/store.go:15)."""
+
+    def __init__(self, key: str = ""):
+        super().__init__(key)
+        self.key = key
+
+    def __str__(self) -> str:  # KeyError quotes its arg; keep a message
+        return f"key could not be found: {self.key!r}"
+
+
+class RPCError(ClusterError):
+    """An actor call failed (transport or remote handler error)."""
+
+
+class RemoteError(RPCError):
+    """The remote handler raised; carries the remote traceback text."""
+
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+class NoClientAvailableError(RPCError):
+    """No client nodes available (ref: cluster/rpc.go:15)."""
+
+
+class LeaseExpiredError(ClusterError):
+    """A lease-backed registration expired and was not renewed."""
+
+
+class CoordinationError(ClusterError):
+    """The coordination service is unreachable or rejected a request."""
+
+
+class MeshError(ClusterError):
+    """Device-mesh construction or sharding binding failed."""
+
+
+class CheckpointError(ClusterError):
+    """Checkpoint save/restore failed."""
+
+
+# Reference-named aliases (Go sentinel-error spelling).
+ErrNoKey = NoKeyError
+ErrNoClientAvailable = NoClientAvailableError

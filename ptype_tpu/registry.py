@@ -1,0 +1,290 @@
+"""Service registry: lease-backed discovery with watch streams.
+
+Capability parity with the reference's ``Registry`` (cluster/registry.go:17-21):
+``register`` / ``services`` / ``watch_service``, keys under
+``services/<service>/<node>``, TTL-leased liveness with background keep-alive,
+and watch streams with snapshot-then-delta semantics
+(registry_test.go:164-190 contract).
+
+TPU-native addition: a :class:`Node` carries the process id and **TPU device
+ordinals** owned by that node, so the registry doubles as the pod's mesh map
+(BASELINE.json north star: "registry.go maps actor PIDs onto TPU device
+ordinals so the cluster topology *is* the pod mesh"); see
+``ptype_tpu.parallel.mesh`` for the registry→Mesh lowering.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import threading
+from dataclasses import dataclass, field
+
+from ptype_tpu import logs
+from ptype_tpu.coord.api import CoordBackend
+from ptype_tpu.coord.core import RangeOptions
+from ptype_tpu.errors import CoordinationError
+
+log = logs.get_logger("registry")
+
+SERVICES_PREFIX = "services"
+
+#: Reference hardcoded 2 s (registry.go:58-59); here it is the default,
+#: overridable via platform config ``lease_ttl``.
+DEFAULT_LEASE_TTL = 2.0
+
+
+@dataclass(frozen=True)
+class Node:
+    """A registered service endpoint (ref: registry.go:23-26 + TPU fields)."""
+
+    address: str
+    port: int
+    #: Host process index within the cluster (0-based).
+    process_id: int = 0
+    #: Global JAX device ids owned by this node's process.
+    device_ordinals: tuple[int, ...] = ()
+    #: Free-form extras (e.g. pipeline stage, expert group).
+    metadata: dict = field(default_factory=dict, hash=False, compare=False)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "address": self.address,
+                "port": self.port,
+                "process_id": self.process_id,
+                "device_ordinals": list(self.device_ordinals),
+                "metadata": self.metadata,
+            },
+            separators=(",", ":"),
+            sort_keys=True,
+        )
+
+    @staticmethod
+    def from_json(raw: str) -> "Node":
+        d = json.loads(raw)
+        return Node(
+            address=d["address"],
+            port=d["port"],
+            process_id=d.get("process_id", 0),
+            device_ordinals=tuple(d.get("device_ordinals", ())),
+            metadata=d.get("metadata", {}),
+        )
+
+
+def _service_key(service: str, node: str = "") -> str:
+    key = f"{SERVICES_PREFIX}/{service}"
+    return f"{key}/{node}" if node else key
+
+
+class NodeWatch:
+    """Stream of full node-set snapshots for one service.
+
+    Contract (ref: registry.go:119-150 + registry_test.go:164-190): the
+    current snapshot is delivered immediately on watch start, then a fresh
+    re-listed snapshot per change. Coalescing rapid churn is the RPC
+    balancer's job (debounce), not the registry's.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue: list[list[Node]] = []
+        self._closed = False
+        self._cancel_cb = lambda: None
+
+    def _push(self, nodes: list[Node]) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._queue.append(nodes)
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> list[Node] | None:
+        """Next snapshot, or None on timeout/close."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._closed:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining)
+            if self._queue:
+                return self._queue.pop(0)
+            return None
+
+    def cancel(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._cancel_cb()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __iter__(self):
+        while True:
+            snap = self.get()
+            if snap is None and self._closed:
+                return
+            if snap is not None:
+                yield snap
+
+
+class Registration:
+    """Handle for a live registration; owns the lease keep-alive loop."""
+
+    def __init__(self, registry: "CoordRegistry", service: str, node: str,
+                 lease_id: int, ttl: float):
+        self._registry = registry
+        self.service = service
+        self.node = node
+        self.lease_id = lease_id
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._keepalive_loop,
+            name=f"lease-keepalive-{service}/{node}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _keepalive_loop(self) -> None:
+        # Refresh at half the TTL, the usual heartbeat cadence
+        # (ref: clientv3 KeepAlive drained in a goroutine, registry.go:69-83).
+        interval = self.ttl / 2.0
+        while not self._stop.wait(interval):
+            try:
+                self._registry._coord.keepalive(self.lease_id)
+                log.debug("lease refreshed",
+                          kv={"service": self.service, "node": self.node})
+            except CoordinationError as e:
+                log.warning("lease refresh failed",
+                            kv={"service": self.service, "node": self.node,
+                                "err": str(e)})
+
+    def close(self, revoke: bool = True) -> None:
+        """Stop keeping the registration alive.
+
+        ``revoke=True`` deregisters immediately (an intentional fix over the
+        reference, which only ever let the lease lapse — SURVEY.md §2).
+        ``revoke=False`` abandons the lease so liveness expiry does the work,
+        which is what a crashed process looks like.
+        """
+        self._stop.set()
+        if revoke:
+            try:
+                self._registry._coord.revoke(self.lease_id)
+            except CoordinationError:
+                pass
+
+
+class Registry(abc.ABC):
+    """The mockable seam the reference's tests relied on (SURVEY.md §4)."""
+
+    @abc.abstractmethod
+    def register(self, service_name: str, node_name: str, host: str,
+                 port: int, *, process_id: int = 0,
+                 device_ordinals: tuple[int, ...] = (),
+                 metadata: dict | None = None) -> Registration: ...
+
+    @abc.abstractmethod
+    def services(self) -> dict[str, list[Node]]: ...
+
+    @abc.abstractmethod
+    def watch_service(self, service_name: str) -> NodeWatch: ...
+
+
+class CoordRegistry(Registry):
+    """Registry over a coordination backend (the etcdRegistry analog)."""
+
+    def __init__(self, coord: CoordBackend, lease_ttl: float = DEFAULT_LEASE_TTL):
+        self._coord = coord
+        self._lease_ttl = lease_ttl
+
+    def register(self, service_name: str, node_name: str, host: str,
+                 port: int, *, process_id: int = 0,
+                 device_ordinals: tuple[int, ...] = (),
+                 metadata: dict | None = None) -> Registration:
+        node = Node(
+            address=host,
+            port=port,
+            process_id=process_id,
+            device_ordinals=tuple(device_ordinals),
+            metadata=metadata or {},
+        )
+        lease_id = self._coord.grant(self._lease_ttl)
+        self._coord.put(
+            _service_key(service_name, node_name), node.to_json(), lease=lease_id
+        )
+        log.info("registered service node",
+                 kv={"service": service_name, "node": node_name,
+                     "addr": f"{host}:{port}",
+                     "devices": list(device_ordinals)})
+        return Registration(self, service_name, node_name, lease_id,
+                            self._lease_ttl)
+
+    def services(self) -> dict[str, list[Node]]:
+        res = self._coord.range(
+            SERVICES_PREFIX + "/", RangeOptions(prefix=True)
+        )
+        out: dict[str, list[Node]] = {}
+        for item in res.items:
+            parts = item.key.split("/")
+            if len(parts) < 3:
+                continue
+            service = parts[1]
+            try:
+                out.setdefault(service, []).append(Node.from_json(item.value))
+            except (json.JSONDecodeError, KeyError):
+                log.warning("skipping malformed registry entry",
+                            kv={"key": item.key})
+        for nodes in out.values():
+            nodes.sort(key=lambda n: (n.address, n.port))
+        return out
+
+    def nodes(self, service_name: str) -> list[Node]:
+        res = self._coord.range(
+            _service_key(service_name) + "/", RangeOptions(prefix=True)
+        )
+        nodes = []
+        for item in res.items:
+            try:
+                nodes.append(Node.from_json(item.value))
+            except (json.JSONDecodeError, KeyError):
+                log.warning("skipping malformed registry entry",
+                            kv={"key": item.key})
+        nodes.sort(key=lambda n: (n.address, n.port))
+        return nodes
+
+    def watch_service(self, service_name: str) -> NodeWatch:
+        nw = NodeWatch()
+        coord_watch = self._coord.watch(_service_key(service_name) + "/")
+        nw._cancel_cb = coord_watch.cancel
+
+        def pump():
+            # Initial snapshot first (registry_test.go:164-190 contract),
+            # then one re-listed snapshot per event batch.
+            try:
+                nw._push(self.nodes(service_name))
+                while not nw.closed and not coord_watch.closed:
+                    batch = coord_watch.get(timeout=0.5)
+                    if not batch:
+                        continue
+                    nw._push(self.nodes(service_name))
+            except CoordinationError as e:
+                log.warning("service watch terminated",
+                            kv={"service": service_name, "err": str(e)})
+            finally:
+                nw.cancel()
+
+        threading.Thread(
+            target=pump, name=f"watch-{service_name}", daemon=True
+        ).start()
+        return nw

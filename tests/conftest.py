@@ -1,0 +1,50 @@
+"""Test fixtures.
+
+Environment must be pinned before the first ``import jax`` anywhere in the
+test process: tests run on a virtual 8-device CPU mesh (SURVEY.md §4 — the
+reference's embedded-etcd tier becomes a single-process multi-device
+fixture), so every sharding/collective test runs without a TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_local_coords():
+    """Isolate the process-local coordination states between tests."""
+    yield
+    from ptype_tpu.coord.local import reset_local_coords
+
+    reset_local_coords()
+
+
+@pytest.fixture
+def coord():
+    """A fresh in-process coordination backend (fast lease sweep)."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+
+    state = CoordState(sweep_interval=0.05)
+    backend = LocalCoord(state)
+    yield backend
+    state.close()
+
+
+@pytest.fixture
+def coord_server():
+    """A TCP coordination service on an ephemeral port."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.service import CoordServer
+
+    server = CoordServer("127.0.0.1:0", CoordState(sweep_interval=0.05))
+    yield server
+    server.close()

@@ -1,0 +1,124 @@
+"""Config contract tests (mirrors reference config_test.go:9-45 table)."""
+
+import os
+
+import pytest
+
+from ptype_tpu.config import (
+    Config,
+    ConfigError,
+    PlatformConfig,
+    config_from_env,
+    config_from_file,
+)
+
+
+@pytest.fixture
+def testdata(tmp_path):
+    """Write a known-good two-level config tree (ref: testdata/ping.yml)."""
+    platform = tmp_path / "platform.yaml"
+    platform.write_text(
+        "name: node1\n"
+        "coordinator_address: 127.0.0.1:7070\n"
+        "is_coordinator: true\n"
+        "mesh_axes:\n  data: 8\n"
+    )
+    cfg = tmp_path / "ping.yaml"
+    cfg.write_text(
+        "service_name: ping\n"
+        "node_name: node1\n"
+        "port: 9000\n"
+        "platform_config_file: platform.yaml\n"
+        "debug: true\n"
+    )
+    return tmp_path
+
+
+def test_good_config(testdata):
+    cfg = config_from_file(str(testdata / "ping.yaml"))
+    assert cfg.service_name == "ping"
+    assert cfg.node_name == "node1"
+    assert cfg.port == 9000
+    assert cfg.debug is True
+    assert cfg.platform.name == "node1"
+    assert cfg.platform.is_coordinator is True
+    assert cfg.platform.mesh_axes == {"data": 8}
+    # Reference defaults preserved
+    assert cfg.platform.lease_ttl == 2.0
+    assert cfg.platform.dial_timeout == 5.0
+
+
+def test_missing_file(tmp_path):
+    with pytest.raises(ConfigError, match="failed to read cluster config"):
+        config_from_file(str(tmp_path / "nope.yaml"))
+
+
+def test_bad_yaml(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("service_name: [unclosed\n")
+    with pytest.raises(ConfigError, match="failed to read yaml"):
+        config_from_file(str(bad))
+
+
+def test_missing_platform_file(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        "service_name: s\nnode_name: n\nport: 1\n"
+        "platform_config_file: absent.yaml\n"
+    )
+    with pytest.raises(ConfigError, match="failed to read platform config"):
+        config_from_file(str(cfg))
+
+
+def test_platform_resolved_relative_to_config_dir(tmp_path):
+    # ref contract: config.go:35-37
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "p.yaml").write_text("name: n\ncoordinator_address: 127.0.0.1:1\n")
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        "service_name: s\nnode_name: n\nport: 1\n"
+        "platform_config_file: sub/p.yaml\n"
+    )
+    loaded = config_from_file(str(cfg))
+    assert loaded.platform.name == "n"
+
+
+def test_invalid_platform_rejected(tmp_path):
+    # ref contract: config.go:41-43 (etcd config validated eagerly)
+    (tmp_path / "p.yaml").write_text(
+        "name: n\ncoordinator_address: not-an-address\n"
+    )
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        "service_name: s\nnode_name: n\nport: 1\n"
+        "platform_config_file: p.yaml\n"
+    )
+    with pytest.raises(ConfigError, match="coordinator_address"):
+        config_from_file(str(cfg))
+
+
+def test_unknown_fields_rejected(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("service_name: s\nnode_name: n\nport: 1\ntypo_field: 3\n")
+    with pytest.raises(ConfigError, match="unknown fields"):
+        config_from_file(str(cfg))
+
+
+def test_validation_errors():
+    with pytest.raises(ConfigError, match="service_name"):
+        Config(node_name="n").validate()
+    with pytest.raises(ConfigError, match="node_name"):
+        Config(service_name="s").validate()
+    with pytest.raises(ConfigError, match="mesh axis"):
+        PlatformConfig(mesh_axes={"data": 0}).validate()
+    with pytest.raises(ConfigError, match="process_id"):
+        PlatformConfig(num_processes=2, process_id=2).validate()
+
+
+def test_config_from_env(testdata, monkeypatch):
+    monkeypatch.setenv("CONFIG", str(testdata / "ping.yaml"))
+    assert config_from_env().service_name == "ping"
+    monkeypatch.delenv("CONFIG")
+    with pytest.raises(ConfigError, match="CONFIG"):
+        config_from_env()

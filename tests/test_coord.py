@@ -1,0 +1,257 @@
+"""Coordination state machine + TCP service tests.
+
+Covers the contracts the reference's registry/store tests leaned on:
+lease-expiry liveness, watch streams, range options, plus the member list
+and barrier the TPU build adds.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ptype_tpu.coord.core import (
+    EventType,
+    RangeOptions,
+    SortOrder,
+    SortTarget,
+    prefix_range_end,
+)
+from ptype_tpu.coord.remote import RemoteCoord
+from ptype_tpu.errors import CoordinationError
+
+
+def wait_until(pred, timeout=3.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------------------- KV
+
+
+def test_put_get_delete(coord):
+    rev1 = coord.put("a/x", "1")
+    rev2 = coord.put("a/y", "2")
+    assert rev2 > rev1
+    res = coord.range("a/x")
+    assert [it.value for it in res.items] == ["1"]
+    assert res.items[0].version == 1
+    coord.put("a/x", "1b")
+    item = coord.range("a/x").items[0]
+    assert item.value == "1b"
+    assert item.version == 2
+    assert item.create_rev == rev1
+    assert coord.delete("a/x") == 1
+    assert coord.range("a/x").count == 0
+    assert coord.delete("a/x") == 0
+
+
+def test_prefix_range(coord):
+    for i in range(5):
+        coord.put(f"svc/n{i}", str(i))
+    coord.put("svd/other", "x")
+    res = coord.range("svc/", RangeOptions(prefix=True))
+    assert res.count == 5
+    assert [it.key for it in res.items] == [f"svc/n{i}" for i in range(5)]
+
+
+def test_range_options(coord):
+    for i in range(5):
+        coord.put(f"k/{i}", str(9 - i))
+    # limit
+    res = coord.range("k/", RangeOptions(prefix=True, limit=2))
+    assert len(res.items) == 2 and res.count == 5
+    # sort by value descending
+    res = coord.range(
+        "k/",
+        RangeOptions(prefix=True, sort_order=SortOrder.DESCEND,
+                     sort_target=SortTarget.VALUE),
+    )
+    assert [it.value for it in res.items] == ["9", "8", "7", "6", "5"]
+    # keys only
+    res = coord.range("k/", RangeOptions(prefix=True, keys_only=True))
+    assert all(it.value == "" for it in res.items)
+    # count only
+    res = coord.range("k/", RangeOptions(prefix=True, count_only=True))
+    assert res.count == 5 and res.items == []
+    # from_key
+    res = coord.range("k/3", RangeOptions(from_key=True))
+    assert [it.key for it in res.items] == ["k/3", "k/4"]
+    # explicit range
+    res = coord.range("k/1", RangeOptions(range_end="k/3"))
+    assert [it.key for it in res.items] == ["k/1", "k/2"]
+
+
+def test_prefix_range_end():
+    # ref: store_config.go:41-58 semantics
+    assert prefix_range_end("abc") == "abd"
+    assert prefix_range_end("a\xff") == "a" + chr(0x100)
+    assert prefix_range_end("") == "\0"
+
+
+# ---------------------------------------------------------------- leases
+
+
+def test_lease_expiry(coord):
+    lease = coord.grant(0.2)
+    coord.put("services/s/n1", "v", lease=lease)
+    assert coord.range("services/s/n1").count == 1
+    # no keepalive -> key vanishes after TTL (ref: registry_test.go:135-147)
+    assert wait_until(lambda: coord.range("services/s/n1").count == 0,
+                      timeout=2.0)
+
+
+def test_lease_keepalive(coord):
+    lease = coord.grant(0.3)
+    coord.put("k", "v", lease=lease)
+    for _ in range(5):
+        time.sleep(0.1)
+        coord.keepalive(lease)
+    assert coord.range("k").count == 1
+    coord.revoke(lease)
+    assert coord.range("k").count == 0
+    with pytest.raises(CoordinationError):
+        coord.keepalive(lease)
+
+
+def test_put_with_unknown_lease(coord):
+    with pytest.raises(CoordinationError):
+        coord.put("k", "v", lease=999)
+
+
+# --------------------------------------------------------------- watches
+
+
+def test_watch_events(coord):
+    w = coord.watch("services/")
+    coord.put("services/s/n1", "a")
+    batch = w.get(timeout=2.0)
+    assert len(batch) == 1
+    assert batch[0].type is EventType.PUT
+    assert batch[0].key == "services/s/n1"
+    assert batch[0].value == "a"
+    coord.put("other/key", "x")  # outside prefix: no event
+    coord.delete("services/s/n1")
+    batch = w.get(timeout=2.0)
+    assert [ev.type for ev in batch] == [EventType.DELETE]
+    w.cancel()
+    assert w.get(timeout=0.1) == []
+
+
+def test_watch_lease_expiry_generates_delete(coord):
+    lease = coord.grant(0.2)
+    coord.put("services/s/n1", "v", lease=lease)
+    w = coord.watch("services/")
+    batch = w.get(timeout=2.0)
+    assert batch and batch[0].type is EventType.DELETE
+    w.cancel()
+
+
+# --------------------------------------------------- members and barrier
+
+
+def test_member_lifecycle(coord):
+    m1 = coord.member_add("n1", "127.0.0.1:1", {"process_id": 0})
+    m2 = coord.member_add("n2", "127.0.0.1:2")
+    assert [m.name for m in coord.member_list()] == ["n1", "n2"]
+    assert coord.member_remove(m1.id) is True
+    assert coord.member_remove(m1.id) is False
+    assert [m.name for m in coord.member_list()] == ["n2"]
+    assert m2.metadata == {}
+
+
+def test_barrier(coord):
+    results = []
+
+    def arrive():
+        results.append(coord.barrier("step", 3, timeout=5.0))
+
+    threads = [threading.Thread(target=arrive) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert results == [True, True, True]
+
+
+def test_barrier_timeout(coord):
+    assert coord.barrier("lonely", 2, timeout=0.2) is False
+
+
+# ------------------------------------------------------------ TCP remote
+
+
+def test_remote_kv_roundtrip(coord_server):
+    c = RemoteCoord(coord_server.address)
+    try:
+        c.put("a", "1")
+        assert c.range("a").items[0].value == "1"
+        assert c.delete("a") == 1
+    finally:
+        c.close()
+
+
+def test_remote_watch_push(coord_server):
+    c1 = RemoteCoord(coord_server.address)
+    c2 = RemoteCoord(coord_server.address)
+    try:
+        w = c1.watch("services/")
+        c2.put("services/s/n1", "hello")
+        batch = w.get(timeout=3.0)
+        assert batch and batch[0].value == "hello"
+        w.cancel()
+    finally:
+        c1.close()
+        c2.close()
+
+
+def test_remote_lease_and_members(coord_server):
+    c = RemoteCoord(coord_server.address)
+    try:
+        lease = c.grant(0.2)
+        c.put("k", "v", lease=lease)
+        assert c.keepalive(lease) == 0.2
+        m = c.member_add("n1", "addr", {"x": 1})
+        assert c.member_list()[0].metadata == {"x": 1}
+        assert c.member_remove(m.id)
+        assert wait_until(lambda: c.range("k").count == 0, timeout=2.0)
+    finally:
+        c.close()
+
+
+def test_remote_barrier_across_clients(coord_server):
+    clients = [RemoteCoord(coord_server.address) for _ in range(3)]
+    results = []
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda c=c: results.append(c.barrier("b", 3, timeout=5.0))
+            )
+            for c in clients
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert results == [True, True, True]
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_remote_error_propagates(coord_server):
+    c = RemoteCoord(coord_server.address)
+    try:
+        with pytest.raises(CoordinationError, match="lease"):
+            c.put("k", "v", lease=12345)
+    finally:
+        c.close()
+
+
+def test_remote_dial_failure():
+    with pytest.raises(CoordinationError, match="failed to dial"):
+        RemoteCoord("127.0.0.1:1", dial_timeout=0.3)

@@ -1,0 +1,121 @@
+"""Registry contract tests (mirrors reference registry_test.go:41-236)."""
+
+import time
+
+from ptype_tpu.registry import CoordRegistry, Node
+
+
+def wait_until(pred, timeout=3.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_register_and_services(coord):
+    reg = CoordRegistry(coord, lease_ttl=5.0)
+    r1 = reg.register("calc", "n1", "10.0.0.1", 9000,
+                      device_ordinals=(0, 1), process_id=0)
+    r2 = reg.register("calc", "n2", "10.0.0.2", 9000)
+    r3 = reg.register("prime", "n1", "10.0.0.1", 9001)
+    try:
+        services = reg.services()
+        assert set(services) == {"calc", "prime"}
+        assert services["calc"] == [
+            Node("10.0.0.1", 9000, process_id=0, device_ordinals=(0, 1)),
+            Node("10.0.0.2", 9000),
+        ]
+        assert services["calc"][0].device_ordinals == (0, 1)
+        assert reg.nodes("prime") == [Node("10.0.0.1", 9001)]
+        assert reg.nodes("ghost") == []
+    finally:
+        for r in (r1, r2, r3):
+            r.close()
+
+
+def test_reregister_same_node_overwrites(coord):
+    reg = CoordRegistry(coord, lease_ttl=5.0)
+    r1 = reg.register("calc", "n1", "10.0.0.1", 9000)
+    r2 = reg.register("calc", "n1", "10.0.0.1", 9999)
+    try:
+        assert reg.nodes("calc") == [Node("10.0.0.1", 9999)]
+    finally:
+        r1.close()
+        r2.close()
+
+
+def test_lease_expiry_liveness(coord):
+    """Abandoned registration (process death) vanishes after TTL
+    (ref: registry_test.go:135-147)."""
+    reg = CoordRegistry(coord, lease_ttl=0.2)
+    r = reg.register("calc", "n1", "10.0.0.1", 9000)
+    assert reg.nodes("calc")
+    r.close(revoke=False)  # stop keepalive, don't revoke: crash semantics
+    assert wait_until(lambda: reg.nodes("calc") == [], timeout=2.0)
+
+
+def test_keepalive_keeps_registration_alive(coord):
+    reg = CoordRegistry(coord, lease_ttl=0.3)
+    r = reg.register("calc", "n1", "10.0.0.1", 9000)
+    try:
+        time.sleep(1.0)  # several TTLs: keepalive loop must be refreshing
+        assert reg.nodes("calc") == [Node("10.0.0.1", 9000)]
+    finally:
+        r.close()
+
+
+def test_close_revoke_deregisters_promptly(coord):
+    reg = CoordRegistry(coord, lease_ttl=30.0)
+    r = reg.register("calc", "n1", "10.0.0.1", 9000)
+    r.close(revoke=True)
+    assert reg.nodes("calc") == []  # no 30s wait: the §2 fix
+
+
+def test_watch_snapshot_then_deltas(coord):
+    """Initial snapshot delivered immediately, then one snapshot per change
+    (ref: registry_test.go:164-190)."""
+    reg = CoordRegistry(coord, lease_ttl=5.0)
+    r1 = reg.register("calc", "n1", "10.0.0.1", 9000)
+    w = reg.watch_service("calc")
+    try:
+        snap = w.get(timeout=3.0)
+        assert snap == [Node("10.0.0.1", 9000)]
+        r2 = reg.register("calc", "n2", "10.0.0.2", 9000)
+        snap = w.get(timeout=3.0)
+        assert snap is not None and len(snap) == 2
+        r2.close(revoke=True)
+        snap = w.get(timeout=3.0)
+        assert snap == [Node("10.0.0.1", 9000)]
+    finally:
+        w.cancel()
+        r1.close()
+
+
+def test_watch_empty_service_initial_snapshot(coord):
+    reg = CoordRegistry(coord, lease_ttl=5.0)
+    w = reg.watch_service("ghost")
+    try:
+        assert w.get(timeout=3.0) == []
+    finally:
+        w.cancel()
+
+
+def test_watch_does_not_cross_services(coord):
+    reg = CoordRegistry(coord, lease_ttl=5.0)
+    w = reg.watch_service("calc")
+    try:
+        assert w.get(timeout=3.0) == []  # initial empty snapshot
+        r = reg.register("prime", "n1", "10.0.0.1", 9001)
+        assert w.get(timeout=0.4) is None  # no event for another service
+        r.close()
+    finally:
+        w.cancel()
+
+
+def test_node_json_roundtrip():
+    n = Node("1.2.3.4", 5, process_id=2, device_ordinals=(4, 5),
+             metadata={"stage": 1})
+    assert Node.from_json(n.to_json()) == n
+    assert Node.from_json(n.to_json()).metadata == {"stage": 1}

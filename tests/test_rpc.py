@@ -1,0 +1,353 @@
+"""RPC layer tests (mirrors reference rpc_test.go contracts).
+
+Uses the mock-registry seam (rpc_test.go:16-40): the balancer depends on
+the Registry *interface*, so membership changes are injected
+deterministically without any coordination service.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ptype_tpu.actor import ActorServer
+from ptype_tpu.errors import NoClientAvailableError, RemoteError
+from ptype_tpu.registry import Node, NodeWatch, Registry
+from ptype_tpu.rpc import Client, ConnConfig, fnv32a
+
+
+class MockRegistry(Registry):
+    """Hand-fed node snapshots (ref: rpc_test.go:16-40)."""
+
+    def __init__(self):
+        self.watches: list[NodeWatch] = []
+
+    def register(self, *a, **k):
+        raise NotImplementedError
+
+    def services(self):
+        return {}
+
+    def watch_service(self, service_name: str) -> NodeWatch:
+        w = NodeWatch()
+        self.watches.append(w)
+        return w
+
+    def push(self, nodes: list[Node]):
+        for w in self.watches:
+            w._push(nodes)
+
+
+class Echo:
+    def Echo(self, x):
+        return x
+
+    def Add(self, a, b):
+        return a + b
+
+    def Boom(self):
+        raise ValueError("kaboom")
+
+
+class FailNTimes:
+    """Stateful handler failing its first N calls (ref: rpc_test.go:55-77)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def Flaky(self):
+        with self.lock:
+            self.calls += 1
+            if self.calls <= self.n:
+                raise RuntimeError(f"failure {self.calls}")
+            return "ok"
+
+
+def make_server(handler, name=None):
+    s = ActorServer("127.0.0.1", 0)
+    s.register(handler, name or type(handler).__name__)
+    s.serve()
+    return s
+
+
+def _cfg(**kw):
+    kw.setdefault("max_connections", 3)
+    kw.setdefault("initial_node_timeout", 1.0)
+    kw.setdefault("debounce_time", 0.15)
+    kw.setdefault("retries", 0)
+    kw.setdefault("call_timeout", 5.0)
+    return ConnConfig(**kw)
+
+
+@pytest.fixture
+def echo_cluster():
+    servers = [make_server(Echo()) for _ in range(3)]
+    reg = MockRegistry()
+    nodes = [Node("127.0.0.1", s.port) for s in servers]
+    yield servers, reg, nodes
+    for s in servers:
+        s.close()
+
+
+def start_client(reg, nodes, cfg=None):
+    # Delay the push slightly so the balancer is already waiting: exercises
+    # the initial-node wait path rather than a pre-filled queue.
+    threading.Timer(0.05, reg.push, args=(nodes,)).start()
+    return Client("client-host", "echo", reg, cfg or _cfg())
+
+
+def test_call_roundtrip(echo_cluster):
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes)
+    try:
+        assert client.call("Echo.Add", 2, 3) == 5
+        assert client.call("Echo.Echo", {"k": [1, "two", 3.0]}) == {
+            "k": [1, "two", 3.0]
+        }
+    finally:
+        client.close()
+
+
+def test_tensor_payload_roundtrip(echo_cluster):
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes)
+    try:
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = client.call("Echo.Echo", x)
+        np.testing.assert_array_equal(out, x)
+        assert out.dtype == np.float32
+    finally:
+        client.close()
+
+
+def test_remote_error_surfaces(echo_cluster):
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes)
+    try:
+        with pytest.raises(RemoteError, match="kaboom") as ei:
+            client.call("Echo.Boom")
+        assert "ValueError" in str(ei.value)
+        assert "Boom" in ei.value.remote_traceback
+    finally:
+        client.close()
+
+
+def test_no_initial_nodes_times_out():
+    """Ref: rpc_test.go:307-314."""
+    reg = MockRegistry()
+    t0 = time.monotonic()
+    with pytest.raises(NoClientAvailableError):
+        Client("client-host", "ghost", reg,
+               _cfg(initial_node_timeout=0.3))
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_retry_until_healthy_handler():
+    """Bounded retries reach a success (correct rpc.go:107-116);
+    ref contract: rpc_test.go:55-77 stateful fail-N handler."""
+    handler = FailNTimes(2)
+    server = make_server(handler, "R")
+    reg = MockRegistry()
+    client = start_client(reg, [Node("127.0.0.1", server.port)],
+                          _cfg(retries=2))
+    try:
+        assert client.call("R.Flaky") == "ok"
+        assert handler.calls == 3
+    finally:
+        client.close()
+        server.close()
+
+
+def test_retry_exhaustion_raises():
+    handler = FailNTimes(10)
+    server = make_server(handler, "R")
+    reg = MockRegistry()
+    client = start_client(reg, [Node("127.0.0.1", server.port)],
+                          _cfg(retries=2))
+    try:
+        with pytest.raises(RemoteError, match="failure 3"):
+            client.call("R.Flaky")
+        assert handler.calls == 3  # exactly retries+1 attempts, no spin
+    finally:
+        client.close()
+        server.close()
+
+
+def test_round_robin_spreads_attempts():
+    """Retries land on different nodes (ref intent rpc.go:28-30; uniqueness
+    contract rpc_test.go:390-425)."""
+    hits = []
+
+    class Who:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def Who(self):
+            hits.append(self.tag)
+            return self.tag
+
+    servers = [make_server(Who(i), "W") for i in range(3)]
+    reg = MockRegistry()
+    nodes = [Node("127.0.0.1", s.port) for s in servers]
+    client = start_client(reg, nodes, _cfg(max_connections=0))
+    try:
+        got = {client.call("W.Who") for _ in range(9)}
+        assert got == {0, 1, 2}  # round robin touches every node
+    finally:
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def test_async_go(echo_cluster):
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes)
+    try:
+        done: "queue.Queue" = queue.Queue()
+        fut = client.go("Echo.Add", 20, 22, done=done)
+        assert fut.result(timeout=5.0) == 42
+        completed = done.get(timeout=5.0)
+        assert completed.result() == 42
+    finally:
+        client.close()
+
+
+def test_async_go_error(echo_cluster):
+    """Async errors surface on the future (ref: rpc_test.go:262-292 —
+    whose Go-path retry never worked; ours shares the sync retry loop)."""
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes)
+    try:
+        fut = client.go("Echo.Boom")
+        with pytest.raises(RemoteError, match="kaboom"):
+            fut.result(timeout=5.0)
+    finally:
+        client.close()
+
+
+def test_debounce_coalesces_churn(echo_cluster):
+    """4 rapid updates -> one coalesced rebalance
+    (ref: rpc_test.go:371-387)."""
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes[:1], _cfg(debounce_time=0.3))
+    try:
+        balancer = client._conns
+        rebalances = []
+        original = balancer._handle_new_nodes
+
+        def counting(ns):
+            rebalances.append(len(ns))
+            original(ns)
+
+        balancer._handle_new_nodes = counting
+        for i in range(4):
+            reg.push(nodes[: i % 3 + 1])
+            time.sleep(0.02)
+        time.sleep(0.8)
+        assert len(rebalances) == 1  # coalesced into one rebalance
+        assert rebalances[0] == 1  # ... applying the LATEST snapshot
+    finally:
+        client.close()
+
+
+def test_rebalance_reuses_healthy_connections(echo_cluster):
+    """Membership change must NOT re-dial surviving nodes (§2 fix)."""
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes, _cfg(max_connections=0,
+                                               debounce_time=0.1))
+    try:
+        with client._conns._lock:
+            before = {
+                (c.node.address, c.node.port): c for c in client._conns._conns
+            }
+        reg.push(nodes[:2])  # drop one node
+        time.sleep(0.5)
+        with client._conns._lock:
+            after = {
+                (c.node.address, c.node.port): c for c in client._conns._conns
+            }
+        assert len(after) == 2
+        for key, conn in after.items():
+            assert conn is before[key]  # same objects: reused, not re-dialed
+    finally:
+        client.close()
+
+
+def test_mesh_mode_connects_all():
+    """max_connections=0 -> full mesh (ref: rpc_test.go:427-476)."""
+    servers = [make_server(Echo()) for _ in range(5)]
+    reg = MockRegistry()
+    nodes = [Node("127.0.0.1", s.port) for s in servers]
+    client = start_client(reg, nodes, _cfg(max_connections=0))
+    try:
+        with client._conns._lock:
+            assert len(client._conns._conns) == 5
+    finally:
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def test_max_connections_bounds_fanout(echo_cluster):
+    servers, reg, nodes = echo_cluster
+    client = start_client(reg, nodes, _cfg(max_connections=2))
+    try:
+        with client._conns._lock:
+            assert len(client._conns._conns) == 2
+    finally:
+        client.close()
+
+
+def test_select_nodes_no_duplicates():
+    """The reference could select duplicates (rpc.go:252-264); we must not."""
+    from ptype_tpu.rpc import _ConnectionBalancer
+
+    nodes = [Node("10.0.0.%d" % i, 1) for i in range(4)]
+    selected = _ConnectionBalancer._select_nodes(
+        type("B", (), {"cfg": _cfg(max_connections=4),
+                       "local_addr": "me"})(), nodes
+    )
+    assert len(selected) == 4
+    assert len({(n.address, n.port) for n in selected}) == 4
+
+
+def test_fnv32a_matches_go():
+    # Spot values computed with Go's hash/fnv New32a.
+    assert fnv32a("") == 0x811C9DC5
+    assert fnv32a("a") == 0xE40C292C
+    assert fnv32a("hello") == 0x4F9F2CAB
+
+
+def test_round_robin_seq_wraps():
+    """Counter wraps at 2**64 without crashing (ref: rpc_test.go:390-425)."""
+    reg = MockRegistry()
+    server = make_server(Echo())
+    client = start_client(reg, [Node("127.0.0.1", server.port)])
+    try:
+        client._conns._seq = 0xFFFFFFFFFFFFFFFF
+        assert client.call("Echo.Add", 1, 1) == 2
+        assert client.call("Echo.Add", 2, 2) == 4
+        assert client._conns._seq == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_connection_errs_stream():
+    """Dial failures surface on the error stream (ref: rpc.go:122-124)."""
+    reg = MockRegistry()
+    good = make_server(Echo())
+    nodes = [Node("127.0.0.1", good.port),
+             Node("127.0.0.1", 1)]  # port 1: refused
+    client = start_client(reg, nodes, _cfg(max_connections=0))
+    try:
+        err = client.connection_errs().get(timeout=3.0)
+        assert "dial" in str(err)
+        assert client.call("Echo.Add", 1, 2) == 3  # healthy node still works
+    finally:
+        client.close()
+        good.close()

@@ -1,0 +1,103 @@
+"""KVStore contract tests (mirrors reference store_test.go:17-169)."""
+
+import pytest
+
+from ptype_tpu.coord.core import RangeOptions, SortOrder, SortTarget
+from ptype_tpu.errors import NoKeyError
+from ptype_tpu.store import (
+    KVStore,
+    get_prefix_range_end,
+    with_count_only,
+    with_from_key,
+    with_keys_only,
+    with_limit,
+    with_prefix,
+    with_range,
+    with_serializable,
+    with_sort,
+)
+
+
+@pytest.fixture
+def store(coord):
+    return KVStore(coord)
+
+
+def test_put_get(store):
+    store.put("alpha", "1")
+    assert store.get("alpha") == ["1"]
+    assert store.get_one("alpha") == "1"
+    store.put("alpha", "2")  # overwrite
+    assert store.get("alpha") == ["2"]
+
+
+def test_get_missing_raises_no_key(store):
+    with pytest.raises(NoKeyError):
+        store.get("ghost")
+
+
+def test_delete(store):
+    store.put("k", "v")
+    store.delete("k")
+    with pytest.raises(NoKeyError):
+        store.get("k")
+    with pytest.raises(NoKeyError):
+        store.delete("k")  # ref: store.go:71-73 Deleted==0 -> ErrNoKey
+
+
+def test_prefix_queries(store):
+    for i in range(4):
+        store.put(f"params/layer{i}", f"v{i}")
+    store.put("other", "x")
+    assert store.get("params/", with_prefix()) == ["v0", "v1", "v2", "v3"]
+    assert store.get("params/", with_prefix(), with_limit(2)) == ["v0", "v1"]
+    assert store.count("params/", with_prefix()) == 4
+
+
+def test_sort_descending(store):
+    for i in range(3):
+        store.put(f"k{i}", str(i))
+    vals = store.get(
+        "k", with_prefix(), with_sort(SortTarget.KEY, SortOrder.DESCEND)
+    )
+    assert vals == ["2", "1", "0"]
+
+
+def test_keys_only_and_items(store):
+    store.put("a/1", "x")
+    store.put("a/2", "y")
+    items = store.get_items("a/", with_prefix(), with_keys_only())
+    assert [it.key for it in items] == ["store/a/1", "store/a/2"]
+    assert all(it.value == "" for it in items)
+
+
+def test_count_only(store):
+    store.put("a/1", "x")
+    assert store.count("a/", with_prefix(), with_count_only()) == 1
+    # count_only get() has no values -> still counts as found
+    with pytest.raises(NoKeyError):
+        store.get("zzz", with_count_only())
+
+
+def test_from_key_and_range(store):
+    for k in ["a", "b", "c", "d"]:
+        store.put(k, k)
+    assert store.get("c", with_from_key()) == ["c", "d"]
+    assert store.get("a", with_range("store/c")) == ["a", "b"]
+
+
+def test_serializable_accepted(store):
+    store.put("k", "v")
+    assert store.get("k", with_serializable()) == ["v"]
+
+
+def test_prefix_range_end_reexport():
+    # ref: store_config.go:41-58
+    assert get_prefix_range_end("store/a") == "store/b"
+
+
+def test_store_namespace_isolated(store, coord):
+    """Store keys live under store/, invisible to raw service keys
+    (ref: store.go:12 storePrefix)."""
+    store.put("services", "not-a-service")
+    assert coord.range("services/", RangeOptions(prefix=True)).count == 0
