@@ -109,7 +109,7 @@ class RemoteCoord(CoordBackend):
         ]
         w._push(events)
 
-    def _call(self, op: str, timeout: float | None = None, **kwargs):
+    def _call(self, op: str, reply_timeout: float | None = None, **kwargs):
         if self._closed.is_set():
             raise CoordinationError(f"coordination connection to {self.address} closed")
         with self._id_lock:
@@ -124,7 +124,8 @@ class RemoteCoord(CoordBackend):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             raise CoordinationError(f"send to {self.address} failed: {e}") from e
-        if not p.event.wait(timeout if timeout is not None else self._request_timeout):
+        if not p.event.wait(reply_timeout if reply_timeout is not None
+                            else self._request_timeout):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             raise CoordinationError(f"request {op!r} to {self.address} timed out")
@@ -197,36 +198,15 @@ class RemoteCoord(CoordBackend):
     def barrier(self, name: str, count: int, timeout: float | None = None) -> bool:
         # Give the server-side wait headroom beyond the barrier timeout;
         # the wire field "timeout" is the barrier's own deadline.
-        call_timeout = (timeout + 5.0) if timeout is not None else None
-        with self._id_lock:
-            req_id = self._next_id
-            self._next_id += 1
-        p = _Pending()
-        with self._pending_lock:
-            self._pending[req_id] = p
-        msg = {"id": req_id, "op": "barrier", "name": name, "count": count,
-               "timeout": timeout}
-        try:
-            wire.send_msg(self._sock, self._send_lock, msg)
-        except (wire.WireError, OSError) as e:
-            with self._pending_lock:
-                self._pending.pop(req_id, None)
-            raise CoordinationError(f"send to {self.address} failed: {e}") from e
-        if not p.event.wait(call_timeout):
-            with self._pending_lock:
-                self._pending.pop(req_id, None)
-            raise CoordinationError(f"barrier {name!r} rendezvous timed out")
-        if p.reply is None:
-            raise CoordinationError(f"connection to {self.address} lost mid-barrier")
-        if not p.reply.get("ok"):
-            raise CoordinationError(p.reply.get("error", "unknown coordination error"))
-        return p.reply.get("result")
+        reply_timeout = (timeout + 5.0) if timeout is not None else None
+        return self._call("barrier", reply_timeout=reply_timeout,
+                          name=name, count=count, timeout=timeout)
 
     # ---------------------------------------------------------------- misc
 
     def ping(self, timeout: float = 5.0) -> bool:
         try:
-            return self._call("ping", timeout=timeout) == "pong"
+            return self._call("ping", reply_timeout=timeout) == "pong"
         except CoordinationError:
             return False
 

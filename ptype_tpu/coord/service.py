@@ -110,15 +110,33 @@ class CoordServer:
     def _handle(self, conn, send_lock, watches, watches_lock, msg: dict) -> None:
         req_id = msg.get("id")
         op = msg.get("op", "")
+        pump_watch: Watch | None = None
         try:
-            result = self._dispatch(conn, send_lock, watches, watches_lock, op, msg)
+            if op == "watch":
+                # The pump must not start until the create-reply is on the
+                # wire: the client registers the watch id only after the
+                # reply, and events sent before that would be dropped.
+                pump_watch = self.state.watch(msg["prefix"])
+                with watches_lock:
+                    watches[pump_watch.id] = pump_watch
+                result = pump_watch.id
+            else:
+                result = self._dispatch(conn, send_lock, watches,
+                                        watches_lock, op, msg)
             reply = {"id": req_id, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — remote surface must not die
             reply = {"id": req_id, "ok": False, "error": str(e)}
         try:
             wire.send_msg(conn, send_lock, reply)
         except (wire.WireError, OSError):
-            pass
+            return
+        if pump_watch is not None:
+            threading.Thread(
+                target=self._pump_watch,
+                args=(conn, send_lock, watches, watches_lock, pump_watch),
+                name=f"coordd-watch-{pump_watch.id}",
+                daemon=True,
+            ).start()
 
     def _dispatch(self, conn, send_lock, watches, watches_lock, op: str, msg: dict):
         st = self.state
@@ -140,17 +158,6 @@ class CoordServer:
         if op == "revoke":
             st.revoke(msg["lease"])
             return None
-        if op == "watch":
-            w = st.watch(msg["prefix"])
-            with watches_lock:
-                watches[w.id] = w
-            threading.Thread(
-                target=self._pump_watch,
-                args=(conn, send_lock, watches, watches_lock, w),
-                name=f"coordd-watch-{w.id}",
-                daemon=True,
-            ).start()
-            return w.id
         if op == "watch_cancel":
             with watches_lock:
                 w = watches.pop(msg["watch"], None)

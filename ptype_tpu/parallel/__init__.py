@@ -1,0 +1,16 @@
+"""Parallelism: device meshes, collectives, and the tensor data plane.
+
+This package is the TPU lowering of the reference's data-movement story
+(SURVEY.md §2 parallelism table): the registry becomes the pod's mesh map
+(:mod:`mesh`), the Store's push/pull becomes compiled ICI collectives
+(:mod:`tensorstore`, :mod:`collectives`), and the strategy modules
+(:mod:`sharding`, :mod:`pipeline`, :mod:`ring`) provide DP / FSDP / TP /
+PP / SP / EP as first-class components.
+"""
+
+from ptype_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    local_mesh,
+    mesh_from_registry,
+    named_sharding,
+)

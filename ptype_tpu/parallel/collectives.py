@@ -1,0 +1,216 @@
+"""Compiled XLA collectives over mesh axes — the ICI data plane.
+
+The reference's data plane was gob-encoded ``net/rpc`` over TCP
+(cluster/rpc.go:277); here the equivalent primitive set is XLA collectives
+compiled over ICI (SURVEY.md §2 "Distributed communication backend").
+These wrappers give the *eager* entry points the TensorStore and benches
+use; inside a jit'ed train step you use ``jax.lax`` collectives (under
+``shard_map``) or let GSPMD insert them from sharding annotations.
+
+Conventions: the "stacked" layout carries one leading contribution axis of
+size ``mesh.shape[axis]``, sharded over ``axis`` — the eager analog of
+per-worker values in a multi-controller program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+_REDUCERS = ("sum", "mean", "max", "min")
+
+
+def _rest(ndim: int) -> tuple[None, ...]:
+    return (None,) * (ndim - 1)
+
+
+@functools.lru_cache(maxsize=256)
+def _all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
+    in_spec = P(axis, *_rest(ndim))
+    out_spec = P(*_rest(ndim))
+
+    def f(local):
+        x = jnp.squeeze(local, axis=0)
+        if op == "sum":
+            return lax.psum(x, axis)
+        if op == "mean":
+            return lax.pmean(x, axis)
+        if op == "max":
+            return lax.pmax(x, axis)
+        return lax.pmin(x, axis)
+
+    return jax.jit(
+        shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
+    )
+
+
+def all_reduce(stacked: jax.Array, mesh: Mesh, axis: str = "data",
+               op: str = "sum") -> jax.Array:
+    """Reduce per-worker contributions; result replicated over ``axis``.
+
+    ``stacked``: shape ``(mesh.shape[axis], *rest)``, sharded on dim 0.
+    Returns shape ``rest`` with every device holding the reduction — the
+    Store push lowering (ref Put store.go:56-62 → psum).
+    """
+    if op not in _REDUCERS:
+        raise ValueError(f"all_reduce: op must be one of {_REDUCERS}")
+    n = int(mesh.shape[axis])
+    if stacked.shape[0] != n:
+        raise ValueError(
+            f"all_reduce: leading dim {stacked.shape[0]} != axis size {n}"
+        )
+    stacked = jax.device_put(stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim))))
+    return _all_reduce_fn(mesh, axis, stacked.ndim, op)(stacked)
+
+
+@functools.lru_cache(maxsize=256)
+def _all_gather_fn(mesh: Mesh, axis: str, ndim: int):
+    spec = P(axis, *_rest(ndim))
+
+    def f(local):
+        return lax.all_gather(jnp.squeeze(local, axis=0), axis)
+
+    # all_gather's output is replicated by construction, but the varying-
+    # manual-axes check cannot infer that — disable it for this wrapper.
+    return jax.jit(
+        shard_map(f, mesh=mesh, in_specs=spec,
+                  out_specs=P(*_rest(ndim + 1)), check_vma=False)
+    )
+
+
+def all_gather(stacked: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
+    """Gather per-worker contributions to every device, replicated.
+
+    ``(n, *rest)`` sharded on dim 0 → ``(n, *rest)`` replicated — the Store
+    pull lowering (ref Get store.go:38-53 → allgather).
+    """
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim)))
+    )
+    return _all_gather_fn(mesh, axis, stacked.ndim)(stacked)
+
+
+@functools.lru_cache(maxsize=256)
+def _reduce_scatter_fn(mesh: Mesh, axis: str, ndim: int, op: str):
+    in_spec = P(axis, *_rest(ndim))
+    # Output keeps rank ndim-1; dim 0 of the payload is scattered.
+    out_spec = P(axis, *_rest(ndim - 1))
+
+    def f(local):
+        x = jnp.squeeze(local, axis=0)
+        n = lax.axis_size(axis)
+        red = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+        if op == "mean":
+            red = red / n
+        return red
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
+
+
+def reduce_scatter(stacked: jax.Array, mesh: Mesh, axis: str = "data",
+                   op: str = "sum") -> jax.Array:
+    """Reduce contributions, leaving each device one shard of the result.
+
+    ``(n, *payload)`` with ``payload[0] % n == 0`` → ``payload`` sharded on
+    dim 0 over ``axis``. Half the ICI bytes of an all_reduce when the
+    consumer is itself sharded (ZeRO/FSDP-style grad reduction).
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"reduce_scatter: op must be 'sum' or 'mean', got {op!r}"
+        )
+    n = int(mesh.shape[axis])
+    if stacked.ndim < 2 or stacked.shape[1] % n != 0:
+        raise ValueError(
+            f"reduce_scatter: payload dim 0 ({stacked.shape[1:]}) must "
+            f"divide by axis size {n}"
+        )
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim)))
+    )
+    return _reduce_scatter_fn(mesh, axis, stacked.ndim, op)(stacked)
+
+
+@functools.lru_cache(maxsize=256)
+def _ring_shift_fn(mesh: Mesh, axis: str, ndim: int, shift: int):
+    spec = P(axis, *_rest(ndim))
+
+    def f(local):
+        n = lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(local, axis, perm)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))
+
+
+def ring_shift(stacked: jax.Array, mesh: Mesh, axis: str = "data",
+               shift: int = 1) -> jax.Array:
+    """Rotate shards around the ``axis`` ring by ``shift`` (ppermute) —
+    the building block of ring attention (SURVEY.md §5 long-context)."""
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim)))
+    )
+    return _ring_shift_fn(mesh, axis, stacked.ndim, shift)(stacked)
+
+
+@functools.lru_cache(maxsize=256)
+def _all_to_all_fn(mesh: Mesh, axis: str, ndim: int):
+    spec = P(axis, *_rest(ndim))
+
+    def f(local):
+        # local: (1, n*chunk, *rest) → exchange chunks around the axis.
+        x = jnp.squeeze(local, axis=0)
+        out = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+        return out[None]
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))
+
+
+def all_to_all(stacked: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
+    """Transpose shard ownership: device i's chunk j goes to device j —
+    the EP/Ulysses exchange. ``(n, n*chunk, *rest)`` sharded on dim 0."""
+    n = int(mesh.shape[axis])
+    if stacked.ndim < 2 or stacked.shape[1] % n != 0:
+        raise ValueError(
+            f"all_to_all: payload dim 0 must divide by axis size {n}"
+        )
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim)))
+    )
+    return _all_to_all_fn(mesh, axis, stacked.ndim)(stacked)
+
+
+def broadcast(value: jax.Array, mesh: Mesh) -> jax.Array:
+    """Replicate a host/single-device value across the whole mesh."""
+    return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+def measure_allreduce_gbps(mesh: Mesh, axis: str = "data",
+                           mbytes: int = 64, iters: int = 10) -> float:
+    """Measured algorithmic allreduce bandwidth (GB/s) over ``axis`` — the
+    BASELINE.md "Store push/pull collective bandwidth" metric."""
+    import time
+
+    n = int(mesh.shape[axis])
+    elems = mbytes * 1024 * 1024 // 4
+    # Pre-place the input in the collective's layout so the timed loop
+    # measures only the compiled allreduce, not a per-iteration reshard.
+    x = jax.device_put(
+        jnp.ones((n, elems), jnp.float32),
+        NamedSharding(mesh, P(axis, None)),
+    )
+    fn = _all_reduce_fn(mesh, axis, 2, "sum")
+    fn(x).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # Ring allreduce moves 2*(n-1)/n of the buffer per device.
+    bytes_moved = 2 * (n - 1) / n * elems * 4
+    return bytes_moved / dt / 1e9

@@ -1,0 +1,129 @@
+"""Device meshes: config axes → ``jax.sharding.Mesh``; registry → mesh map.
+
+The reference's registry mapped service names to node endpoints
+(cluster/registry.go:17-26); the north star lowers that map onto TPU device
+ordinals so the cluster topology *is* the pod mesh. Two constructors:
+
+- :func:`build_mesh` — from the platform config's ordered ``mesh_axes``
+  (``{"data": 2, "model": 4}``) over this process's visible devices.
+- :func:`mesh_from_registry` — from the live registry: every node of a
+  service advertises its ``device_ordinals``; nodes sorted by process id
+  define the global device order. This is the multi-host path, where each
+  process sees only its local chips but the mesh must span the pod.
+
+Axis conventions (shared across the framework):
+``data`` (DP), ``fsdp`` (param sharding), ``model`` (TP), ``seq``
+(SP/ring attention), ``stage`` (pipeline), ``expert`` (EP). Any subset may
+appear; strategies look axes up by name and degrade to size-1 when absent.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ptype_tpu.errors import ClusterError
+
+#: Canonical axis names in canonical order (outer → inner). ICI-heavy axes
+#: (model/seq) go innermost so their collectives ride the fastest links.
+CANONICAL_AXES = ("stage", "data", "fsdp", "expert", "seq", "model")
+
+
+def _ordered_axes(axes: dict[str, int]) -> list[tuple[str, int]]:
+    """Config order wins; dicts preserve insertion order since py3.7."""
+    return [(name, int(size)) for name, size in axes.items()]
+
+
+def build_mesh(
+    axes: dict[str, int],
+    axis_names: tuple[str, ...] | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a Mesh whose axis product covers a prefix of ``devices``.
+
+    ``axes`` is ordered (outer → inner). If ``axis_names`` is given, it
+    reorders/subsets the axes. The axis product must not exceed the device
+    count; exceeding devices are left out (e.g. an 8-device host running a
+    4-device test mesh).
+    """
+    if not axes:
+        raise ClusterError("build_mesh: no mesh axes configured")
+    pairs = _ordered_axes(axes)
+    if axis_names is not None:
+        by_name = dict(pairs)
+        missing = [n for n in axis_names if n not in by_name]
+        if missing:
+            raise ClusterError(f"build_mesh: unknown axes {missing}")
+        pairs = [(n, by_name[n]) for n in axis_names]
+    names = tuple(n for n, _ in pairs)
+    shape = tuple(s for _, s in pairs)
+    need = math.prod(shape)
+    devs = list(devices if devices is not None else jax.devices())
+    if need > len(devs):
+        raise ClusterError(
+            f"build_mesh: axes {dict(pairs)} need {need} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.asarray(devs[:need], dtype=object).reshape(shape)
+    return Mesh(grid, names)
+
+
+def local_mesh(**axes: int) -> Mesh:
+    """Convenience: ``local_mesh(data=8)`` over this process's devices."""
+    return build_mesh(axes)
+
+
+def mesh_from_registry(registry, service_name: str,
+                       axes: dict[str, int]) -> Mesh:
+    """Lower a service's registry entries to a Mesh (the mesh-map path).
+
+    Nodes are ordered by ``process_id``; their advertised
+    ``device_ordinals`` concatenate into the global device order. Each
+    entry must correspond to a device visible to this runtime
+    (``jax.devices()`` spans all processes under multi-controller JAX).
+    """
+    nodes = registry.services().get(service_name, [])
+    if not nodes:
+        raise ClusterError(
+            f"mesh_from_registry: no nodes registered for {service_name!r}"
+        )
+    nodes = sorted(nodes, key=lambda n: n.process_id)
+    ordinals: list[int] = []
+    for node in nodes:
+        ordinals.extend(node.device_ordinals)
+    if not ordinals:
+        raise ClusterError(
+            f"mesh_from_registry: nodes of {service_name!r} advertise no "
+            "device ordinals (control-plane-only processes?)"
+        )
+    if len(set(ordinals)) != len(ordinals):
+        raise ClusterError(
+            f"mesh_from_registry: duplicate device ordinals across nodes "
+            f"of {service_name!r}: {ordinals}"
+        )
+    by_id = {d.id: d for d in jax.devices()}
+    try:
+        devices = [by_id[o] for o in ordinals]
+    except KeyError as e:
+        raise ClusterError(
+            f"mesh_from_registry: registry advertises device {e} not "
+            "visible to this runtime"
+        ) from e
+    return build_mesh(axes, devices=devices)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """Shorthand: ``named_sharding(mesh, 'data', None)``."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    """Size of a mesh axis, 1 if the axis is absent (strategy degrade)."""
+    return int(mesh.shape[name]) if name in mesh.axis_names else 1
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

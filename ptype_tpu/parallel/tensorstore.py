@@ -1,0 +1,271 @@
+"""TensorStore — the Store's tensor tier: push/pull as ICI collectives.
+
+The reference Store was a namespaced KV over raft (cluster/store.go:38-74):
+``Put`` replicated a value to every member, ``Get`` read it linearizably.
+The north star (BASELINE.json) lowers exactly that contract onto the mesh:
+
+- ``push(key, contributions)``  → allreduce (``psum``/``pmean``) — every
+  device ends up with the same reduced tensor, like a raft-replicated Put.
+- ``push_scatter(key, ...)``    → reduce-scatter — each device keeps one
+  shard (half the ICI bytes; the FSDP/ZeRO-style reduction).
+- ``pull(key)``                 → the stored array, or an allgathered
+  replicated view (``gather=True``), like a linearizable Get.
+
+Values live device-resident under a per-key **binding** (a PartitionSpec),
+so a pull never round-trips through the host. Ordering, which the
+reference got free from raft linearizability, is provided by an explicit
+**epoch**: every push bumps the key's epoch, and the optional metadata
+KVStore carries ``{shape, dtype, spec, epoch}`` manifests so any member
+(or a checkpointer) can discover the parameter space — the control-plane/
+data-plane split mandated by SURVEY.md §7 stage 6.
+
+Compression hook: ``compress="bf16"`` casts contributions to bfloat16 for
+the wire and restores dtype after the reduce (EQuARX pattern, PAPERS.md) —
+halves ICI bytes at <1 ulp-bf16 cost.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ptype_tpu.errors import NoKeyError
+from ptype_tpu.parallel import collectives
+from ptype_tpu.store import KVStore
+
+TENSOR_PREFIX = "tensors"
+
+
+def spec_to_json(spec: P) -> str:
+    return json.dumps([list(p) if isinstance(p, tuple) else p for p in spec])
+
+
+def spec_from_json(raw: str) -> P:
+    return P(*[tuple(p) if isinstance(p, list) else p for p in json.loads(raw)])
+
+
+@dataclass
+class Binding:
+    """Per-key placement + reduction policy."""
+
+    spec: P = P()
+    reduce_op: str = "mean"
+
+
+@dataclass
+class _Entry:
+    value: jax.Array
+    epoch: int = 0
+    binding: Binding = field(default_factory=Binding)
+
+
+class TensorStore:
+    """Device-resident tensor KV over a mesh (the Store push/pull lowering)."""
+
+    def __init__(self, mesh: Mesh, axis: str = "data",
+                 kv: KVStore | None = None, namespace: str = "params",
+                 compress: str | None = None):
+        if compress not in (None, "bf16"):
+            raise ValueError(f"TensorStore: unknown compression {compress!r}")
+        self.mesh = mesh
+        self.axis = axis
+        self.namespace = namespace
+        self.compress = compress
+        self._kv = kv
+        self._entries: dict[str, _Entry] = {}
+        self._bindings: dict[str, Binding] = {}
+        self._lock = threading.RLock()
+
+    # ---------------------------------------------------------- bindings
+
+    def bind(self, key: str, spec: P = P(), reduce_op: str = "mean") -> None:
+        """Declare a key's sharding + reduction before first use.
+
+        Unbound keys default to replicated placement and mean reduction —
+        the closest analog of the reference's replicate-everywhere Put.
+        """
+        with self._lock:
+            self._bindings[key] = Binding(spec, reduce_op)
+            if key in self._entries:
+                self._entries[key].binding = self._bindings[key]
+
+    def binding(self, key: str) -> Binding:
+        with self._lock:
+            return self._bindings.get(key, Binding())
+
+    # ------------------------------------------------------------- basic
+
+    def put(self, key: str, value, spec: P | None = None) -> jax.Array:
+        """Place a value under the key's binding; no collective, epoch 0
+        reset. The initial-parameters path (ref Put store.go:56-62).
+        Passing ``spec`` records it as the key's binding, same as bind()."""
+        if spec is None:
+            b = self.binding(key)
+        else:
+            b = Binding(spec, self.binding(key).reduce_op)
+        arr = jax.device_put(jnp.asarray(value), NamedSharding(self.mesh, b.spec))
+        with self._lock:
+            if spec is not None:
+                self._bindings[key] = b
+            self._entries[key] = _Entry(arr, 0, b)
+        self._publish(key)
+        return arr
+
+    def get(self, key: str) -> jax.Array:
+        """The stored array in its bound sharding (ref Get store.go:38-53)."""
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None:
+            raise NoKeyError(key)
+        return entry.value
+
+    def pull(self, key: str, gather: bool = False) -> jax.Array:
+        """Get; with ``gather=True``, return a fully-replicated view
+        (allgather lowering of a linearizable read)."""
+        value = self.get(key)
+        if gather:
+            value = jax.device_put(value, NamedSharding(self.mesh, P()))
+        return value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            if key not in self._entries:
+                raise NoKeyError(key)
+            del self._entries[key]
+        if self._kv is not None:
+            try:
+                self._kv.delete(self._manifest_key(key))
+            except NoKeyError:
+                pass
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def epoch(self, key: str) -> int:
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None:
+            raise NoKeyError(key)
+        return entry.epoch
+
+    # ------------------------------------------------------------- push
+
+    def push(self, key: str, stacked, op: str | None = None) -> jax.Array:
+        """Reduce per-worker contributions into the key — the allreduce
+        lowering of Store.Put (north star). ``stacked``'s leading dim is
+        the contribution axis (== mesh axis size); the reduced tensor is
+        stored under the key's binding and returned."""
+        b = self.binding(key)
+        op = op or b.reduce_op
+        stacked = jnp.asarray(stacked)
+        wire = stacked.astype(jnp.bfloat16) if self.compress else stacked
+        reduced = collectives.all_reduce(wire, self.mesh, self.axis, op)
+        if self.compress:
+            reduced = reduced.astype(stacked.dtype)
+        if b.spec != P():
+            reduced = jax.device_put(reduced, NamedSharding(self.mesh, b.spec))
+        return self._commit(key, reduced, b)
+
+    def push_scatter(self, key: str, stacked, op: str | None = None) -> jax.Array:
+        """Reduce-scatter variant: each device keeps one shard of the
+        reduced tensor (binding forced to shard dim 0 over the push axis).
+        Pull with ``gather=True`` to reassemble — together they form the
+        bandwidth-optimal allreduce decomposition."""
+        b = Binding(P(self.axis), op or self.binding(key).reduce_op)
+        stacked = jnp.asarray(stacked)
+        wire = stacked.astype(jnp.bfloat16) if self.compress else stacked
+        reduced = collectives.reduce_scatter(
+            wire, self.mesh, self.axis, b.reduce_op
+        )
+        if self.compress:
+            reduced = reduced.astype(stacked.dtype)
+        return self._commit(key, reduced, b)
+
+    def _commit(self, key: str, value: jax.Array, b: Binding) -> jax.Array:
+        with self._lock:
+            prev = self._entries.get(key)
+            epoch = (prev.epoch + 1) if prev else 1
+            self._entries[key] = _Entry(value, epoch, b)
+        self._publish(key)
+        return value
+
+    # -------------------------------------------------------------- tree
+
+    def put_tree(self, prefix: str, tree) -> None:
+        for key, leaf in _flatten(prefix, tree):
+            self.put(key, leaf)
+
+    def push_tree(self, prefix: str, stacked_tree, op: str | None = None):
+        """Push every leaf of a pytree of stacked contributions."""
+        return {
+            key: self.push(key, leaf, op)
+            for key, leaf in _flatten(prefix, stacked_tree)
+        }
+
+    def get_tree(self, prefix: str) -> dict[str, jax.Array]:
+        """All keys under ``prefix/`` as a flat dict."""
+        sep = prefix + "/"
+        with self._lock:
+            hits = {k: e.value for k, e in self._entries.items()
+                    if k.startswith(sep)}
+        if not hits:
+            raise NoKeyError(prefix)
+        return dict(sorted(hits.items()))
+
+    # ---------------------------------------------------------- manifest
+
+    def _manifest_key(self, key: str) -> str:
+        return f"{TENSOR_PREFIX}/{self.namespace}/{key}"
+
+    def _publish(self, key: str) -> None:
+        if self._kv is None:
+            return
+        with self._lock:
+            entry = self._entries[key]
+        self._kv.put(
+            self._manifest_key(key),
+            json.dumps({
+                "shape": list(entry.value.shape),
+                "dtype": str(entry.value.dtype),
+                "spec": spec_to_json(entry.binding.spec),
+                "epoch": entry.epoch,
+            }, separators=(",", ":")),
+        )
+
+    def manifest(self) -> dict[str, dict]:
+        """Key → {shape, dtype, spec, epoch} for the whole namespace —
+        what a checkpointer or late joiner reads to discover the space."""
+        out = {}
+        with self._lock:
+            for key, entry in self._entries.items():
+                out[key] = {
+                    "shape": list(entry.value.shape),
+                    "dtype": str(entry.value.dtype),
+                    "spec": spec_to_json(entry.binding.spec),
+                    "epoch": entry.epoch,
+                }
+        return out
+
+
+def _flatten(prefix: str, tree) -> list[tuple[str, jax.Array]]:
+    """Pytree → sorted (key, leaf) pairs with path-derived key names."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in leaves:
+        parts = [prefix] + [_path_part(p) for p in path]
+        out.append(("/".join(parts), leaf))
+    return sorted(out)
+
+
+def _path_part(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)

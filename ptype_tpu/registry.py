@@ -141,12 +141,13 @@ class Registration:
     """Handle for a live registration; owns the lease keep-alive loop."""
 
     def __init__(self, registry: "CoordRegistry", service: str, node: str,
-                 lease_id: int, ttl: float):
+                 lease_id: int, ttl: float, node_json: str):
         self._registry = registry
         self.service = service
         self.node = node
         self.lease_id = lease_id
         self.ttl = ttl
+        self._node_json = node_json
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._keepalive_loop,
@@ -159,15 +160,43 @@ class Registration:
         # Refresh at half the TTL, the usual heartbeat cadence
         # (ref: clientv3 KeepAlive drained in a goroutine, registry.go:69-83).
         interval = self.ttl / 2.0
+        failures = 0
         while not self._stop.wait(interval):
             try:
                 self._registry._coord.keepalive(self.lease_id)
+                if failures:
+                    log.info("lease refresh recovered",
+                             kv={"service": self.service, "node": self.node})
+                failures = 0
                 log.debug("lease refreshed",
                           kv={"service": self.service, "node": self.node})
             except CoordinationError as e:
-                log.warning("lease refresh failed",
-                            kv={"service": self.service, "node": self.node,
-                                "err": str(e)})
+                failures += 1
+                if failures <= 3 or failures % 10 == 0:  # bound log spam
+                    log.warning("lease refresh failed",
+                                kv={"service": self.service, "node": self.node,
+                                    "err": str(e), "failures": failures})
+                # If the lease itself is gone (expired server-side during a
+                # partition), a retry can never succeed — re-register with a
+                # fresh lease instead of heartbeating a dead registration.
+                if "not found" in str(e).lower():
+                    self._reregister()
+
+    def _reregister(self) -> None:
+        try:
+            lease_id = self._registry._coord.grant(self.ttl)
+            self._registry._coord.put(
+                _service_key(self.service, self.node), self._node_json,
+                lease=lease_id,
+            )
+            self.lease_id = lease_id
+            log.info("re-registered after lease loss",
+                     kv={"service": self.service, "node": self.node,
+                         "lease": lease_id})
+        except CoordinationError as e:
+            log.warning("re-registration failed",
+                        kv={"service": self.service, "node": self.node,
+                            "err": str(e)})
 
     def close(self, revoke: bool = True) -> None:
         """Stop keeping the registration alive.
@@ -228,7 +257,7 @@ class CoordRegistry(Registry):
                      "addr": f"{host}:{port}",
                      "devices": list(device_ordinals)})
         return Registration(self, service_name, node_name, lease_id,
-                            self._lease_ttl)
+                            self._lease_ttl, node.to_json())
 
     def services(self) -> dict[str, list[Node]]:
         res = self._coord.range(

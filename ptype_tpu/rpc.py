@@ -25,6 +25,7 @@ import struct
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 
 from ptype_tpu import actor as actor_mod
@@ -135,6 +136,7 @@ class _Conn:
             req_id = self._next_id
             self._next_id += 1
         fut = Future()
+        fut.req_id = req_id  # lets the caller forget() a timed-out call
         with self._pending_lock:
             self._pending[req_id] = fut
         header = json.dumps(
@@ -150,6 +152,14 @@ class _Conn:
             self.close()
             fut.set_exception(RPCError(f"send failed: {e}"))
         return fut
+
+    def forget(self, fut: Future) -> None:
+        """Drop a timed-out call's pending entry so abandoned futures are
+        not resolved by late replies and _pending cannot grow unboundedly."""
+        req_id = getattr(fut, "req_id", None)
+        if req_id is not None:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
 
     def close(self) -> None:
         if self._closed.is_set():
@@ -197,6 +207,9 @@ class _LocalConn:
         threading.Thread(target=run, daemon=True).start()
         return fut
 
+    def forget(self, fut: Future) -> None:
+        pass
+
     def close(self) -> None:
         pass
 
@@ -229,7 +242,20 @@ class _ConnectionBalancer:
         self.conns_updated = threading.Event()
 
         self._watch: NodeWatch = registry.watch_service(service_name)
-        initial = self._watch.get(timeout=cfg.initial_node_timeout)
+        # The registry pushes an immediate initial snapshot which may be
+        # empty (service not registered yet — a normal startup race); keep
+        # absorbing snapshots until one has nodes or the timeout passes
+        # (ref contract: InitialNodeTimeout, rpc.go:155-160).
+        deadline = time.monotonic() + cfg.initial_node_timeout
+        initial: list[Node] | None = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            got = self._watch.get(timeout=remaining)
+            if got:
+                initial = got
+                break
         if not initial:
             self._watch.cancel()
             raise NoClientAvailableError(
@@ -394,9 +420,15 @@ class Client:
             if conn is None:
                 last_err = NoClientAvailableError("no client nodes available")
                 continue
+            fut = conn.call_async(method, args)
             try:
-                fut = conn.call_async(method, args)
                 return fut.result(timeout=self.cfg.call_timeout)
+            except FuturesTimeoutError:
+                conn.forget(fut)
+                last_err = RPCError(
+                    f"call {method!r} timed out after {self.cfg.call_timeout}s"
+                )
+                self._conns._report(last_err)
             except Exception as e:  # noqa: BLE001
                 # Both transport errors and remote handler errors retry —
                 # "retries are possibly done on different nodes"

@@ -1,0 +1,257 @@
+"""Mesh, collectives, and TensorStore on the virtual 8-device CPU mesh.
+
+This is the numerics tier SURVEY.md §4 calls for: collective results
+checked against NumPy references, plus the registry→mesh lowering.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ptype_tpu.errors import ClusterError, NoKeyError
+from ptype_tpu.parallel import collectives as C
+from ptype_tpu.parallel import mesh as M
+from ptype_tpu.parallel.tensorstore import (
+    TensorStore,
+    spec_from_json,
+    spec_to_json,
+)
+from ptype_tpu.registry import Node
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return M.build_mesh({"data": 8})
+
+
+class TestMesh:
+    def test_build_mesh_shape_and_order(self):
+        m = M.build_mesh({"data": 2, "model": 4})
+        assert m.axis_names == ("data", "model")
+        assert dict(m.shape) == {"data": 2, "model": 4}
+
+    def test_build_mesh_prefix_of_devices(self):
+        m = M.build_mesh({"data": 4})
+        assert m.devices.size == 4
+
+    def test_build_mesh_too_many_devices(self):
+        with pytest.raises(ClusterError, match="need 16"):
+            M.build_mesh({"data": 16})
+
+    def test_build_mesh_axis_names_reorder(self):
+        m = M.build_mesh({"data": 2, "model": 4},
+                         axis_names=("model", "data"))
+        assert m.axis_names == ("model", "data")
+
+    def test_build_mesh_unknown_axis(self):
+        with pytest.raises(ClusterError, match="unknown axes"):
+            M.build_mesh({"data": 2}, axis_names=("bogus",))
+
+    def test_axis_size_degrades_to_one(self, mesh8):
+        assert M.axis_size(mesh8, "data") == 8
+        assert M.axis_size(mesh8, "model") == 1
+
+
+class _FakeRegistry:
+    def __init__(self, nodes):
+        self._nodes = nodes
+
+    def services(self):
+        return {"trainer": self._nodes}
+
+
+class TestMeshFromRegistry:
+    def test_orders_by_process_id(self):
+        nodes = [
+            Node("h1", 1, process_id=1, device_ordinals=(4, 5, 6, 7)),
+            Node("h0", 1, process_id=0, device_ordinals=(0, 1, 2, 3)),
+        ]
+        m = M.mesh_from_registry(_FakeRegistry(nodes), "trainer", {"data": 8})
+        assert [d.id for d in m.devices.flat] == list(range(8))
+
+    def test_no_nodes(self):
+        with pytest.raises(ClusterError, match="no nodes"):
+            M.mesh_from_registry(_FakeRegistry([]), "trainer", {"data": 8})
+
+    def test_duplicate_ordinals(self):
+        nodes = [
+            Node("h0", 1, process_id=0, device_ordinals=(0, 1)),
+            Node("h1", 1, process_id=1, device_ordinals=(1, 2)),
+        ]
+        with pytest.raises(ClusterError, match="duplicate"):
+            M.mesh_from_registry(_FakeRegistry(nodes), "trainer", {"data": 3})
+
+    def test_no_ordinals(self):
+        nodes = [Node("h0", 1, process_id=0)]
+        with pytest.raises(ClusterError, match="no device ordinals"):
+            M.mesh_from_registry(_FakeRegistry(nodes), "trainer", {"data": 1})
+
+
+class TestCollectives:
+    """Numerics vs NumPy references (SURVEY.md §4 TPU translation)."""
+
+    def test_all_reduce_sum(self, mesh8):
+        x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        out = C.all_reduce(jnp.asarray(x), mesh8, "data", "sum")
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+    def test_all_reduce_mean_max_min(self, mesh8):
+        x = np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32)
+        for op, ref in [("mean", x.mean(0)), ("max", x.max(0)),
+                        ("min", x.min(0))]:
+            out = C.all_reduce(jnp.asarray(x), mesh8, "data", op)
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+    def test_all_reduce_result_replicated(self, mesh8):
+        out = C.all_reduce(jnp.ones((8, 4)), mesh8)
+        assert out.sharding.is_fully_replicated
+
+    def test_all_reduce_shape_mismatch(self, mesh8):
+        with pytest.raises(ValueError, match="leading dim"):
+            C.all_reduce(jnp.ones((4, 2)), mesh8)
+
+    def test_all_gather(self, mesh8):
+        x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        out = C.all_gather(jnp.asarray(x), mesh8)
+        np.testing.assert_array_equal(np.asarray(out), x)
+        assert out.sharding.is_fully_replicated
+
+    def test_reduce_scatter_matches_all_reduce(self, mesh8):
+        x = np.random.default_rng(2).normal(size=(8, 32)).astype(np.float32)
+        rs = C.reduce_scatter(jnp.asarray(x), mesh8, op="sum")
+        np.testing.assert_allclose(np.asarray(rs), x.sum(0), rtol=1e-5)
+        # and it is actually scattered, one shard per device
+        assert not rs.sharding.is_fully_replicated
+
+    def test_reduce_scatter_mean(self, mesh8):
+        x = np.ones((8, 16), np.float32)
+        rs = C.reduce_scatter(jnp.asarray(x), mesh8, op="mean")
+        np.testing.assert_allclose(np.asarray(rs), np.ones(16), rtol=1e-6)
+
+    def test_ring_shift(self, mesh8):
+        x = jnp.arange(8, dtype=jnp.float32)[:, None]
+        out = np.asarray(C.ring_shift(x, mesh8, shift=1))
+        # device i's value moves to i+1: position 0 now holds row 7
+        np.testing.assert_array_equal(out[:, 0], np.roll(np.arange(8), 1))
+
+    def test_all_to_all_is_transpose(self, mesh8):
+        x = np.arange(8 * 8, dtype=np.float32).reshape(8, 8, 1)
+        out = np.asarray(C.all_to_all(jnp.asarray(x), mesh8))
+        np.testing.assert_array_equal(out[..., 0], x[..., 0].T)
+
+    def test_measure_allreduce_gbps_positive(self, mesh8):
+        assert C.measure_allreduce_gbps(mesh8, mbytes=1, iters=1) > 0
+
+
+class TestTensorStore:
+    def test_put_get_roundtrip(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.put("w", jnp.ones((4, 4)))
+        np.testing.assert_array_equal(np.asarray(ts.get("w")), np.ones((4, 4)))
+
+    def test_get_missing_raises(self, mesh8):
+        with pytest.raises(NoKeyError):
+            TensorStore(mesh8).get("nope")
+
+    def test_push_is_allreduce(self, mesh8):
+        ts = TensorStore(mesh8)
+        x = np.random.default_rng(3).normal(size=(8, 8)).astype(np.float32)
+        out = ts.push("g", jnp.asarray(x), op="sum")
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ts.get("g")), x.sum(0), rtol=1e-5)
+
+    def test_push_default_mean(self, mesh8):
+        ts = TensorStore(mesh8)
+        out = ts.push("g", jnp.ones((8, 4)))
+        np.testing.assert_allclose(np.asarray(out), np.ones(4), rtol=1e-6)
+
+    def test_push_respects_binding_spec(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.bind("w", P("data"), reduce_op="sum")
+        out = ts.push("w", jnp.ones((8, 16)))
+        assert not out.sharding.is_fully_replicated
+        np.testing.assert_allclose(np.asarray(out), 8 * np.ones(16))
+
+    def test_push_scatter_then_gather(self, mesh8):
+        ts = TensorStore(mesh8)
+        x = np.random.default_rng(4).normal(size=(8, 32)).astype(np.float32)
+        ts.push_scatter("g", jnp.asarray(x), op="sum")
+        gathered = ts.pull("g", gather=True)
+        np.testing.assert_allclose(np.asarray(gathered), x.sum(0), rtol=1e-5)
+        assert gathered.sharding.is_fully_replicated
+
+    def test_epoch_increments(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.put("w", jnp.zeros(4))
+        assert ts.epoch("w") == 0
+        ts.push("w", jnp.ones((8, 4)))
+        assert ts.epoch("w") == 1
+        ts.push("w", jnp.ones((8, 4)))
+        assert ts.epoch("w") == 2
+
+    def test_bf16_compression_roundtrip(self, mesh8):
+        ts = TensorStore(mesh8, compress="bf16")
+        x = np.full((8, 8), 0.5, np.float32)
+        out = ts.push("g", jnp.asarray(x), op="sum")
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-2)
+
+    def test_tree_push_and_get(self, mesh8):
+        ts = TensorStore(mesh8)
+        grads = {"layer0": {"w": jnp.ones((8, 2)), "b": jnp.ones((8,))},
+                 "layer1": {"w": jnp.ones((8, 2))}}
+        ts.push_tree("grads", grads, op="sum")
+        got = ts.get_tree("grads")
+        assert set(got) == {"grads/layer0/w", "grads/layer0/b",
+                            "grads/layer1/w"}
+        np.testing.assert_allclose(np.asarray(got["grads/layer0/b"]), 8.0)
+
+    def test_delete(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.put("w", jnp.zeros(2))
+        ts.delete("w")
+        with pytest.raises(NoKeyError):
+            ts.get("w")
+        with pytest.raises(NoKeyError):
+            ts.delete("w")
+
+    def test_manifest_published_to_kv(self, mesh8, coord):
+        from ptype_tpu.store import KVStore
+
+        kv = KVStore(coord)
+        ts = TensorStore(mesh8, kv=kv, namespace="m0")
+        ts.bind("w", P("data"))
+        ts.push("w", jnp.ones((8, 16)), op="sum")
+        import json
+
+        meta = json.loads(kv.get_one("tensors/m0/w"))
+        assert meta["shape"] == [16]
+        assert meta["epoch"] == 1
+        assert spec_from_json(meta["spec"]) == P("data")
+
+    def test_spec_json_roundtrip(self):
+        for spec in (P(), P("data"), P(None, "model"), P(("data", "fsdp"))):
+            assert spec_from_json(spec_to_json(spec)) == spec
+
+    def test_manifest_local(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.put("w", jnp.zeros((2, 3), jnp.bfloat16))
+        m = ts.manifest()
+        assert m["w"]["shape"] == [2, 3]
+        assert m["w"]["dtype"] == "bfloat16"
+
+
+class TestReviewRegressions:
+    def test_reduce_scatter_rejects_unsupported_op(self, mesh8):
+        with pytest.raises(ValueError, match="sum.*mean"):
+            C.reduce_scatter(jnp.ones((8, 16)), mesh8, op="max")
+
+    def test_put_with_spec_records_binding(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.put("w", jnp.ones((16,)), spec=P("data"))
+        assert ts.binding("w").spec == P("data")
+        out = ts.push("w", jnp.ones((8, 16)), op="sum")
+        # the binding's sharding survives the push
+        assert not out.sharding.is_fully_replicated

@@ -119,3 +119,24 @@ def test_node_json_roundtrip():
              metadata={"stage": 1})
     assert Node.from_json(n.to_json()) == n
     assert Node.from_json(n.to_json()).metadata == {"stage": 1}
+
+
+def test_reregisters_after_lease_loss(coord):
+    """A server-side lease expiry (partition longer than TTL) must lead to
+    re-registration with a fresh lease, not an eternal warn loop."""
+    import time as _t
+
+    from ptype_tpu.registry import CoordRegistry
+
+    reg = CoordRegistry(coord, lease_ttl=0.4)
+    handle = reg.register("svc", "n1", "h", 1)
+    # Simulate server-side expiry: revoke behind the keepalive loop's back.
+    coord.revoke(handle.lease_id)
+    deadline = _t.monotonic() + 3.0
+    while _t.monotonic() < deadline:
+        nodes = reg.services().get("svc", [])
+        if nodes:
+            break
+        _t.sleep(0.05)
+    assert reg.services().get("svc"), "registration did not come back"
+    handle.close()

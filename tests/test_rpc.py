@@ -351,3 +351,58 @@ def test_connection_errs_stream():
     finally:
         client.close()
         good.close()
+
+
+def test_empty_initial_snapshot_then_nodes():
+    """An immediate empty snapshot (service not yet registered — the real
+    CoordRegistry always pushes one) must not consume the whole
+    initial_node_timeout: the balancer keeps waiting for nodes."""
+    srv = make_server(Echo())
+    node = Node("127.0.0.1", srv.port)
+    reg = MockRegistry()
+
+    def feed():
+        time.sleep(0.05)
+        reg.push([])  # the registry's immediate empty initial snapshot
+        time.sleep(0.2)
+        reg.push([node])
+
+    threading.Thread(target=feed, daemon=True).start()
+    client = Client("client-host", "echo", reg, _cfg(initial_node_timeout=2.0))
+    try:
+        assert client.call("Echo.Echo", "hi") == "hi"
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_call_timeout_forgets_pending():
+    """A timed-out call must not leak its pending future (late replies
+    would otherwise resolve abandoned futures and grow _pending forever)."""
+    srv = make_server(Echo())
+    # Advertise an address lookup_local() does not alias, forcing the real
+    # socket transport (_Conn) whose _pending map is under test.
+    node = Node("localhost", srv.port)
+    block = threading.Event()
+    srv.register_function("Slow.Wait", lambda: block.wait(5))
+    reg = MockRegistry()
+
+    def feed():
+        time.sleep(0.05)
+        reg.push([node])
+
+    threading.Thread(target=feed, daemon=True).start()
+    client = Client("client-host", "echo", reg,
+                    _cfg(call_timeout=0.2, retries=0))
+    try:
+        from ptype_tpu.errors import RPCError
+
+        with pytest.raises(RPCError, match="timed out"):
+            client.call("Slow.Wait")
+        conn = client._conns.get()
+        assert hasattr(conn, "_pending"), "expected the socket transport"
+        assert not conn._pending  # forgotten at timeout, not on late reply
+    finally:
+        block.set()
+        client.close()
+        srv.close()
