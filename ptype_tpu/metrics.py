@@ -1,0 +1,166 @@
+"""Metrics / observability.
+
+The reference had logging only — zap globals, no metrics surface
+(SURVEY.md §5 "Metrics": `Client.ConnectionErrs` was the entire
+observability API, cluster/rpc.go:122-124). The BASELINE.json metrics
+(tokens/sec/chip, MFU, collective GB/s) need a real counter/timing
+module; this is it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+#: Peak bf16 matmul TFLOP/s per chip, by PJRT device_kind substring.
+#: Public numbers (cloud.google.com/tpu docs); CPU entry is a nominal
+#: figure so MFU stays defined (and obviously tiny) in CPU test runs.
+PEAK_TFLOPS = {
+    "v6e": 918.0,
+    "v5p": 459.0,
+    "v5e": 197.0,  # v5 litepod
+    "v5": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+    "cpu": 0.5,
+}
+
+
+def device_peak_tflops(device=None) -> float:
+    """Best-effort peak bf16 TFLOP/s for a device (default: devices()[0])."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or device.platform
+    kind = kind.lower()
+    for key, tf in PEAK_TFLOPS.items():
+        if key in kind:
+            return tf
+    return PEAK_TFLOPS["cpu"] if device.platform == "cpu" else 197.0
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        n_chips: int, peak_tflops: float | None = None) -> float:
+    """Model FLOPs utilization in [0, 1]: achieved / peak."""
+    peak = (peak_tflops or device_peak_tflops()) * 1e12 * n_chips
+    return tokens_per_sec * flops_per_token / peak
+
+
+@dataclass
+class Counter:
+    name: str
+    value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self.value += delta
+
+
+@dataclass
+class Timing:
+    name: str
+    total: float = 0.0
+    count: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.total += seconds
+            self.count += 1
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Process-local named counters/timings with a JSON dump — the
+    metrics surface the reference never had (SURVEY.md §5)."""
+
+    def __init__(self):
+        self._counters: dict[str, Counter] = {}
+        self._timings: dict[str, Timing] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter(name))
+
+    def timing(self, name: str) -> Timing:
+        with self._lock:
+            return self._timings.setdefault(name, Timing(name))
+
+    def timed(self, name: str):
+        """Context manager recording wall time into a Timing."""
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.timing(name).observe(time.perf_counter() - self._t0)
+                return False
+
+        return _Ctx()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "timings": {
+                    n: {"mean_s": t.mean, "count": t.count}
+                    for n, t in self._timings.items()
+                },
+            }
+
+    def dump_json(self) -> str:
+        return json.dumps(self.snapshot(), separators=(",", ":"))
+
+
+#: Default process-global registry.
+metrics = MetricsRegistry()
+
+
+@dataclass
+class StepStats:
+    """Rolling per-step throughput tracker for training loops."""
+
+    flops_per_token: float
+    n_chips: int
+    peak_tflops: float | None = None
+    tokens: int = 0
+    seconds: float = 0.0
+    steps: int = 0
+    _t0: float = field(default=0.0, repr=False)
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def step(self, n_tokens: int) -> None:
+        now = time.perf_counter()
+        self.seconds += now - self._t0
+        self._t0 = now
+        self.tokens += n_tokens
+        self.steps += 1
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens / self.seconds if self.seconds else 0.0
+
+    @property
+    def tokens_per_sec_per_chip(self) -> float:
+        return self.tokens_per_sec / max(self.n_chips, 1)
+
+    @property
+    def mfu(self) -> float:
+        return mfu(self.tokens_per_sec, self.flops_per_token,
+                   self.n_chips, self.peak_tflops)

@@ -1,0 +1,31 @@
+"""Model families.
+
+The reference contains no ML code (SURVEY.md §2) — its "model" was the
+`Prime.Check` worker handler (example/optimus/prime.go:15-25). The north
+star (BASELINE.json `configs`) demands real model families trained through
+the cluster's Store/actor surface; they live here, built TPU-first:
+scan-over-layers stacked parameters, bf16 MXU compute, PartitionSpec trees
+for GSPMD sharding.
+"""
+
+from ptype_tpu.models.transformer import (
+    TransformerConfig,
+    PRESETS,
+    init_params,
+    forward,
+    loss_fn,
+    param_specs,
+    count_params,
+    flops_per_token,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "PRESETS",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_specs",
+    "count_params",
+    "flops_per_token",
+]

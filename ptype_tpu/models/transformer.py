@@ -1,0 +1,313 @@
+"""Decoder-only transformer, TPU-first.
+
+The reference framework ships no model (SURVEY.md §2: "no ML code"); the
+optimus example's worker compute was ``Prime.Check``'s simulated 250 ms
+scan (example/optimus/prime.go:15-25). This module supplies the real
+compute the north star demands — "optimus trains a 125M-param transformer"
+(BASELINE.json) — designed for the MXU and XLA, not translated from
+anything:
+
+- **Scan over layers.** All blocks' parameters are stacked on a leading
+  layer dim and the body is ``lax.scan``-ed: one compiled layer body
+  regardless of depth (compile time O(1) in layers, XLA-friendly static
+  control flow).
+- **bf16 compute, f32 params.** Matmuls run in bfloat16 on the MXU;
+  parameters and the softmax/logit paths stay f32 for stability.
+- **RMSNorm + RoPE + SwiGLU + GQA** — one architecture covers the
+  125M optimus preset and the Llama-3-8B FSDP baseline config.
+- **Sharding by annotation.** :func:`param_specs` returns a PartitionSpec
+  pytree (fsdp/model axes); the train layer jits with those shardings and
+  GSPMD inserts the collectives (ICI-mapped; scaling-book recipe).
+- **Remat.** ``cfg.remat`` wraps the block body in ``jax.checkpoint`` to
+  trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    #: KV heads for grouped-query attention; None → MHA (== n_heads).
+    n_kv_heads: int | None = None
+    #: SwiGLU hidden size (LLaMA sizing ≈ 8/3 · d_model, MXU-aligned).
+    d_ff: int = 2048
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    #: Compute dtype for MXU matmuls; params stay in param_dtype.
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    #: Tie the LM head to the token embedding (GPT-2-style).
+    tie_embeddings: bool = True
+    #: Rematerialize each block in backward (jax.checkpoint).
+    remat: bool = False
+    #: "xla" (fused by the compiler) or "ring" (shard_map ring attention
+    #: over the "seq" mesh axis — see parallel/ring_attention.py).
+    attn_impl: str = "xla"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+#: Named presets for the BASELINE.json configs. "tiny" is the test-size
+#: model every CPU-mesh test uses.
+PRESETS: dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq=128,
+    ),
+    "optimus-125m": TransformerConfig(),  # defaults above ≈ 110M params
+    "optimus-350m": TransformerConfig(
+        d_model=1024, n_layers=24, n_heads=16, d_ff=2816,
+    ),
+    "llama-3-8b": TransformerConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq=8192, rope_theta=500000.0,
+        tie_embeddings=False, remat=True,
+    ),
+}
+
+
+def preset(name: str, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return replace(PRESETS[name], **overrides)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Initialize the stacked-parameter pytree.
+
+    Block params carry a leading ``n_layers`` dim — the scan axis. Weight
+    init: truncated-normal-free simple scaled normals (0.02 embed / GPT
+    residual scaling on the out-projections).
+    """
+    L, D, H, K = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.kv_heads
+    Dh, F, V = cfg.head_dim, cfg.d_ff, cfg.vocab_size
+    pd = cfg.param_dtype
+    keys = jax.random.split(rng, 8)
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, pd) * scale).astype(pd)
+
+    resid_scale = 0.02 / jnp.sqrt(2.0 * L)
+    params = {
+        "embed": norm(keys[0], (V, D), 0.02),
+        "blocks": {
+            "attn_norm": jnp.ones((L, D), pd),
+            "wq": norm(keys[1], (L, D, H, Dh), 0.02),
+            "wk": norm(keys[2], (L, D, K, Dh), 0.02),
+            "wv": norm(keys[3], (L, D, K, Dh), 0.02),
+            "wo": norm(keys[4], (L, H, Dh, D), resid_scale),
+            "mlp_norm": jnp.ones((L, D), pd),
+            "w_gate": norm(keys[5], (L, D, F), 0.02),
+            "w_up": norm(keys[6], (L, D, F), 0.02),
+            "w_down": norm(keys[7], (L, F, D), resid_scale),
+        },
+        "final_norm": jnp.ones((D,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(jax.random.split(keys[0])[0], (D, V), 0.02)
+    return params
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int,
+                    n_params: int | None = None) -> float:
+    """Fwd+bwd training FLOPs per token (PaLM appendix B convention):
+    ``6·N_matmul + 12·L·D·S`` — the MFU denominator."""
+    if n_params is None:
+        # matmul params only (norms excluded — negligible anyway)
+        L, D = cfg.n_layers, cfg.d_model
+        H, K, Dh, F = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_ff
+        per_layer = D * Dh * (H + 2 * K) + H * Dh * D + 3 * D * F
+        n_params = cfg.vocab_size * D + L * per_layer
+        if not cfg.tie_embeddings:
+            n_params += D * cfg.vocab_size
+    return 6.0 * n_params + 12.0 * cfg.n_layers * cfg.d_model * seq_len
+
+
+# ----------------------------------------------------------------- forward
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def rope_tables(cfg: TransformerConfig, seq_len: int):
+    """(sin, cos) tables, shape (S, head_dim/2), f32."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # (S, half)
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """Rotate pairs (x1, x2) of the head dim. x: (B, S, H, Dh)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    sin = sin[None, :, None, :]
+    cos = cos[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig):
+    """Causal attention; q:(B,S,H,Dh) k,v:(B,S,K,Dh). Softmax in f32."""
+    B, S, H, Dh = q.shape
+    K = k.shape[2]
+    if K != H:  # GQA: broadcast kv heads across query groups
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(Dh))
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, layer, sin, cos, cfg: TransformerConfig, attn_fn):
+    """One transformer block; x: (B, S, D) in compute dtype."""
+    dt = cfg.dtype
+    h = rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    o = attn_fn(q, k, v, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+
+    h = rms_norm(x, layer["mlp_norm"])
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+    x = x + jnp.einsum(
+        "bsf,fd->bsd", jax.nn.silu(gate) * up, layer["w_down"].astype(dt)
+    )
+    return x
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """Logits (B, S, V) in f32. ``attn_fn`` overrides the attention
+    implementation (ring attention injects itself here)."""
+    attn_fn = attn_fn or _attention
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"][tokens].astype(dt)
+    sin, cos = rope_tables(cfg, S)
+
+    def body(x, layer):
+        return _block(x, layer, sin, cos, cfg, attn_fn), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"])
+    if cfg.tie_embeddings:
+        head = params["embed"].T
+    else:
+        head = params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                      head.astype(jnp.float32))
+
+
+def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """Mean next-token cross-entropy. ``batch``: tokens (B,S) int32,
+    targets (B,S) int32, optional loss_mask (B,S)."""
+    logits = forward(params, batch["tokens"], cfg, attn_fn)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, batch["targets"][..., None], axis=-1
+    )[..., 0]
+    nll = logz - gold
+    mask = batch.get("loss_mask")
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+# ---------------------------------------------------------------- sharding
+
+
+def _maybe(axis: str | None, size: int, axis_sizes: dict[str, int]):
+    """Use the axis in a spec only if present and it divides ``size`` —
+    strategies degrade to replication when an axis is absent
+    (mesh.py axis conventions)."""
+    if axis is None or axis not in axis_sizes:
+        return None
+    return axis if size % axis_sizes[axis] == 0 else None
+
+
+def param_specs(cfg: TransformerConfig,
+                axis_sizes: dict[str, int]) -> dict:
+    """PartitionSpec pytree matching :func:`init_params`.
+
+    Conventions (scaling-book layout): ``model`` (TP) shards head and ff
+    dims — megatron-style column/row pairing so each block needs exactly
+    one psum on each residual write; ``fsdp`` shards the d_model dim of
+    every matmul weight (ZeRO-3-style, allgathered by GSPMD per layer).
+    Block specs carry a leading None for the scan/layer dim.
+    """
+    D, F, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    H, K = cfg.n_heads, cfg.kv_heads
+    fsdp = partial(_maybe, "fsdp", axis_sizes=axis_sizes)
+    tp = partial(_maybe, "model", axis_sizes=axis_sizes)
+    specs = {
+        "embed": P(tp(V), fsdp(D)),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fsdp(D), tp(H), None),
+            "wk": P(None, fsdp(D), tp(K), None),
+            "wv": P(None, fsdp(D), tp(K), None),
+            "wo": P(None, tp(H), None, fsdp(D)),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fsdp(D), tp(F)),
+            "w_up": P(None, fsdp(D), tp(F)),
+            "w_down": P(None, tp(F), fsdp(D)),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(fsdp(D), tp(V))
+    return specs
+
+
+def batch_spec(axis_sizes: dict[str, int], seq_axis: bool = False) -> P:
+    """Token batch sharding: batch dim over every data-like axis present
+    (data + fsdp both act as data for activations); optionally the seq
+    dim over ``seq`` (ring attention)."""
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in axis_sizes)
+    first = batch_axes if batch_axes else None
+    second = "seq" if (seq_axis and "seq" in axis_sizes) else None
+    return P(first, second)

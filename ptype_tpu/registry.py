@@ -183,6 +183,10 @@ class Registration:
                     self._reregister()
 
     def _reregister(self) -> None:
+        # A close() racing with an in-flight keepalive must not resurrect
+        # the registration with a fresh lease after the deliberate revoke.
+        if self._stop.is_set():
+            return
         try:
             lease_id = self._registry._coord.grant(self.ttl)
             self._registry._coord.put(

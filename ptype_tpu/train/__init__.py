@@ -1,0 +1,29 @@
+"""Training layer: jit train steps over meshes + Store-backed modes.
+
+The reference's "training loop" shape is the optimus scatter-gather
+(SURVEY.md §3.3): coordinator fans work out, gathers replies. Here the
+fan-out is the mesh's data axes and the gather is a compiled ICI
+collective — either implicit (GSPMD inserts it from sharding annotations,
+the fast path) or explicit through the TensorStore (the Store push/pull
+lowering, BASELINE.json north star).
+"""
+
+from ptype_tpu.train.trainer import (
+    Trainer,
+    TrainState,
+    make_train_step,
+    init_state,
+    default_optimizer,
+)
+from ptype_tpu.train.store_dp import StoreDPTrainer
+from ptype_tpu.train.data import synthetic_batches
+
+__all__ = [
+    "Trainer",
+    "TrainState",
+    "make_train_step",
+    "init_state",
+    "default_optimizer",
+    "StoreDPTrainer",
+    "synthetic_batches",
+]

@@ -1,0 +1,37 @@
+"""Synthetic token streams for benches and tests.
+
+The reference's workload generator was the prime-candidate range splitter
+(example/optimus/coordinator.go:67-73); the training equivalent is an
+infinite stream of (tokens, targets) batches. Synthetic data is generated
+ON DEVICE (jit'd PRNG) so the input pipeline never bottlenecks a bench —
+host→device transfer is part of what BASELINE.md's tokens/sec measures,
+and a real loader would hide it with prefetch; here there is nothing to
+hide.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def synthetic_batches(vocab_size: int, batch: int, seq: int,
+                      seed: int = 0):
+    """Infinite iterator of {"tokens", "targets"} int32 device arrays.
+
+    targets = tokens shifted by one (next-token LM), generated from a
+    counter-derived PRNG key so the stream is reproducible and stateless.
+    """
+
+    @jax.jit
+    def make(step):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        toks = jax.random.randint(
+            key, (batch, seq + 1), 0, vocab_size, jnp.int32
+        )
+        return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    step = 0
+    while True:
+        yield make(step)
+        step += 1

@@ -1,0 +1,127 @@
+"""Store-backed data-parallel training — the north-star lowering, literal.
+
+BASELINE.json: "`cluster/store.go`'s replicated KV becomes an
+XLA-collective parameter store whose push/pull lowers to allreduce/
+allgather over ICI". This trainer exercises that contract exactly:
+
+- each data-parallel worker computes grads on its shard,
+- ``TensorStore.push_tree("grads", stacked)`` reduces them (psum/pmean
+  over the mesh's data axis — the Put that raft used to replicate,
+  store.go:56-62),
+- the optimizer applies the reduced grads and ``put``s params back, and
+  workers ``pull`` them (the linearizable Get, store.go:38-53).
+
+It is deliberately eager between the compiled pieces so the Store
+semantics stay observable (epochs bump per push, manifests publish to the
+KV tier). The fully-fused GSPMD path in trainer.py is the throughput
+choice; this mode exists for Store-semantics parity + the async
+param-server family built on it (train/param_server.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
+from ptype_tpu.train.trainer import default_optimizer
+
+
+class StoreDPTrainer:
+    """Data-parallel trainer whose gradient exchange IS the Store."""
+
+    def __init__(self, cfg: tfm.TransformerConfig, store: TensorStore,
+                 optimizer=None, rng: jax.Array | None = None):
+        self.cfg = cfg
+        self.store = store
+        self.mesh: Mesh = store.mesh
+        self.axis = store.axis
+        self.n_workers = int(self.mesh.shape[self.axis])
+        self.optimizer = optimizer or default_optimizer()
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        params = jax.jit(lambda r: tfm.init_params(r, cfg))(rng)
+        self.opt_state = self.optimizer.init(params)
+        self.store.put_tree("params", params)
+        self._treedef = jax.tree_util.tree_structure(params)
+        # Keys in TREEDEF leaf order (tree_flatten_with_path order), NOT
+        # the Store's string-sorted order — string sort permutes numeric
+        # path components ('10' < '2'), which would silently cross-wire
+        # leaves on unflatten.
+        self._keys = [
+            "params/" + "/".join(_path_part(p) for p in path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]
+        ]
+        self.step_count = 0
+
+        # Per-worker grad fn, vmapped over the stacked worker batch dim —
+        # one compiled program computing every worker's local grads, laid
+        # out sharded over the data axis (SPMD over the mesh).
+        def local_grads(params, batch):
+            loss, grads = jax.value_and_grad(tfm.loss_fn)(
+                params, batch, cfg
+            )
+            return loss, grads
+
+        self._grads_fn = jax.jit(jax.vmap(local_grads, in_axes=(None, 0)))
+        self._apply_fn = jax.jit(
+            lambda params, grads, opt_state: _apply(
+                self.optimizer, params, grads, opt_state
+            )
+        )
+
+    def params(self) -> dict:
+        flat = self.store.get_tree("params")
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [flat[k] for k in self._keys]
+        )
+
+    def step(self, batch: dict) -> dict:
+        """One DP step. ``batch`` leaves are (B, S); B splits evenly into
+        n_workers stacked shards (the scatter, coordinator.go:67-73)."""
+        B = batch["tokens"].shape[0]
+        if B % self.n_workers:
+            raise ValueError(
+                f"batch size {B} not divisible by {self.n_workers} workers"
+            )
+        sh = NamedSharding(self.mesh, P(self.axis, None, None))
+        stacked = {
+            k: jax.device_put(
+                jnp.reshape(v, (self.n_workers, B // self.n_workers, -1)),
+                sh,
+            )
+            for k, v in batch.items()
+        }
+        params = self.params()
+        losses, grads = self._grads_fn(params, stacked)
+
+        # The gather: Store push == pmean allreduce over the data axis.
+        self.store.push_tree("grads", grads, op="mean")
+        reduced_flat = self.store.get_tree("grads")
+        reduced = jax.tree_util.tree_unflatten(
+            self._treedef,
+            [reduced_flat[k.replace("params/", "grads/", 1)]
+             for k in self._keys],
+        )
+
+        new_params, self.opt_state = self._apply_fn(
+            params, reduced, self.opt_state
+        )
+        self.store.put_tree("params", new_params)
+        self.step_count += 1
+        return {
+            "loss": float(jnp.mean(losses)),
+            "step": self.step_count,
+            "grad_epoch": self.store.epoch(self._grad_key0()),
+        }
+
+    def _grad_key0(self) -> str:
+        return self._keys[0].replace("params/", "grads/", 1)
+
+
+def _apply(optimizer, params, grads, opt_state):
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
