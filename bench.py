@@ -1,0 +1,78 @@
+"""Headline benchmark: optimus-125M data-parallel training throughput.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+
+The metric is tokens/sec/chip on the north-star config (BASELINE.json:
+"optimus-125M tokens/sec/chip"); ``vs_baseline`` is achieved MFU divided
+by the 0.30 MFU target (the only quantitative baseline the reference
+world defines — SURVEY.md §6: the reference publishes no numbers).
+
+On TPU this runs the real 125M model with a chip-sized batch; on CPU
+(driver smoke runs, local dev) it scales the model and step count down so
+the line still prints in seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.mesh import build_mesh
+from ptype_tpu.train.data import synthetic_batches
+from ptype_tpu.train.trainer import Trainer
+
+MFU_TARGET = 0.30  # BASELINE.json north_star: ">=30% MFU on v5e-8"
+
+
+def main() -> None:
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n_chips = len(devices)
+
+    if on_tpu:
+        cfg = tfm.preset("optimus-125m")
+        per_chip_batch, seq, steps, warmup = 16, 1024, 20, 3
+    else:
+        cfg = tfm.preset("tiny")
+        per_chip_batch, seq, steps, warmup = 4, 128, 5, 1
+
+    mesh = build_mesh({"data": n_chips}, devices=devices)
+    trainer = Trainer(cfg, mesh)
+    batch = per_chip_batch * n_chips
+    stream = synthetic_batches(cfg.vocab_size, batch, seq)
+
+    for _ in range(warmup):
+        trainer.step(next(stream))
+
+    t0 = time.perf_counter()
+    tokens = 0
+    for _ in range(steps):
+        out = trainer.step(next(stream))
+        tokens += batch * seq
+    dt = time.perf_counter() - t0
+
+    tps_chip = tokens / dt / n_chips
+    from ptype_tpu.metrics import device_peak_tflops, mfu as mfu_of
+
+    achieved_mfu = mfu_of(
+        tokens / dt, tfm.flops_per_token(cfg, seq), n_chips,
+        device_peak_tflops(devices[0]),
+    )
+    print(json.dumps({
+        "metric": "optimus-125M tokens/sec/chip"
+        if on_tpu else "optimus-tiny tokens/sec/chip (cpu smoke)",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
+        "mfu": round(achieved_mfu, 4),
+        "n_chips": n_chips,
+        "final_loss": out["loss"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

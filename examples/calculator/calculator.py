@@ -1,0 +1,24 @@
+"""The Calculator actor (ref: example/calculator/calculator.go:9-12).
+
+TPU twist: ``Multiply`` accepts scalars OR arrays — tensor args arrive as
+device buffers via the actor codec, and the multiply runs as a jitted XLA
+program, so the same endpoint that multiplied two ints in the reference
+multiplies device-resident matrices here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Calculator:
+    def Multiply(self, a, b):
+        if isinstance(a, jax.Array) or isinstance(b, jax.Array):
+            return _mul(jnp.asarray(a), jnp.asarray(b))
+        return a * b
+
+
+@jax.jit
+def _mul(a, b):
+    return a * b

@@ -1,0 +1,31 @@
+"""Calculator client (ref: example/calculator/client.go:14-45).
+
+Join → new_client("calculator") → call. Demonstrates both the scalar
+call the reference made and a device-tensor call (the payload rides the
+tensor codec as a device buffer).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ptype_tpu.cluster import join
+from ptype_tpu.config import config_from_env
+
+
+def main() -> None:
+    cluster = join(config_from_env())
+    try:
+        client = cluster.new_client("calculator")
+        print("3 * 7 =", client.call("Calculator.Multiply", 3, 7))
+
+        a = jnp.arange(4, dtype=jnp.float32)
+        b = jnp.full((4,), 2.0, jnp.float32)
+        print("tensor multiply:", client.call("Calculator.Multiply", a, b))
+        client.close()
+    finally:
+        cluster.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Calculator server (ref: example/calculator/server.go:15-41).
+
+Register handlers → join → serve. ``CONFIG`` selects the YAML
+(ref: server.go:22).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from calculator import Calculator  # noqa: E402
+
+from ptype_tpu.actor import ActorServer  # noqa: E402
+from ptype_tpu.cluster import join  # noqa: E402
+from ptype_tpu.config import config_from_env  # noqa: E402
+
+
+def main() -> None:
+    cfg = config_from_env()
+    server = ActorServer(port=cfg.port)
+    server.register(Calculator())
+    server.serve()
+    cfg.port = server.port  # port 0 → advertise the bound port
+
+    cluster = join(cfg)
+    print(f"calculator server {cfg.node_name} serving on :{server.port}",
+          flush=True)
+    try:
+        threading.Event().wait()  # serve forever (ref blocked on ListenAndServe)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.close()
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

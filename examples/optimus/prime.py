@@ -1,0 +1,49 @@
+"""The Prime actor (ref: example/optimus/prime.go:15-25).
+
+``Check(min, max, target)`` scans [min, max) for a factor of ``target``
+and returns the first one, or ``target`` when none divides it. The
+reference simulated compute with a 250 ms sleep per candidate
+(prime.go:17); here the scan is a real jitted ``lax.while_loop`` on the
+accelerator — compiled control flow instead of a Python loop, so a range
+chunk is one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+# Factor targets exceed int32 (e.g. 600851475149); the device scan needs
+# real int64. Set before any tracing — this is a worker binary, so the
+# flag is process-local.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=())
+def _scan_factors(lo, hi, target):
+    """First i in [lo, hi) dividing target, else 0."""
+
+    def cond(state):
+        i, found = state
+        return (i < hi) & (found == 0)
+
+    def body(state):
+        i, found = state
+        divides = (target % i) == 0
+        return i + 1, jnp.where(divides, i, found)
+
+    _, found = lax.while_loop(cond, body, (lo, jnp.int64(0)))
+    return found
+
+
+class Prime:
+    def Check(self, lo: int, hi: int, target: int) -> int:
+        lo = max(int(lo), 2)
+        found = int(_scan_factors(
+            jnp.int64(lo), jnp.int64(hi), jnp.int64(target)
+        ))
+        return found if found else int(target)

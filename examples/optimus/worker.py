@@ -1,0 +1,37 @@
+"""Prime worker (ref: example/optimus/worker.go:15-41)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from prime import Prime  # noqa: E402
+
+from ptype_tpu.actor import ActorServer  # noqa: E402
+from ptype_tpu.cluster import join  # noqa: E402
+from ptype_tpu.config import config_from_env  # noqa: E402
+
+
+def main() -> None:
+    cfg = config_from_env()
+    server = ActorServer(port=cfg.port)
+    server.register(Prime())
+    server.serve()
+    cfg.port = server.port
+
+    cluster = join(cfg)
+    print(f"prime worker {cfg.node_name} serving on :{server.port}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.close()
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,19 @@ The compute path is JAX/XLA/pjit/shard_map/Pallas; the host-side runtime is
 pure-Python threads + sockets (the reference's runtime was pure Go + TCP).
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor an explicit JAX_PLATFORMS even on hosts whose site hooks
+    # override jax_platforms at interpreter startup (env vars lose to
+    # config there). No-op once a backend is initialized.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # noqa: BLE001 — never block import on this
+        pass
+
 from ptype_tpu.config import (
     Config,
     ConfigError,

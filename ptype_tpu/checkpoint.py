@@ -1,0 +1,326 @@
+"""Checkpoint / resume — sharded, async, Store-aware.
+
+The reference had no application-level checkpointing; durability was
+etcd's raft data-dir (SURVEY.md §5 "Checkpoint/resume": Store contents
+survive restarts via ``data-dir``). The TPU-native equivalent owed there:
+"first-class sharded checkpoint of the Store's parameter space
+(Orbax-style async save of jax.Array shards), resume = Join + Store
+pull". This module provides both tiers:
+
+- :class:`Checkpointer` — save/restore any jax pytree. Each leaf is
+  written **per addressable shard** (device→host copy of exactly this
+  process's shards), so an 8B FSDP state never materializes unsharded.
+  Restore takes a sharding pytree and ``device_put``s each leaf back
+  into placement, and verifies the manifest covers every element (a
+  partial save fails loudly, never zero-fills). ``async_save``
+  snapshots to host synchronously (cheap, device→host DMA) and writes
+  files on a background thread — the train loop resumes while bytes hit
+  disk. Scope: one writer per directory — in multi-controller runs,
+  process 0 saves (addressable shards of a fully-sharded state are the
+  whole state only on a single host; cross-host manifest merge is a
+  later tier).
+- :class:`StoreCheckpoint` — the Store tier: persists a TensorStore
+  namespace (values + spec/epoch manifest) into the platform
+  ``data_dir``; ``resume()`` re-puts every key with its binding, which
+  is exactly "Join + Store pull".
+
+Layout (one directory per step, manifest-first like an orbax step dir):
+
+    <dir>/step_<N>/manifest.json
+    <dir>/step_<N>/<flat-key>.shard<i>.npy
+    <dir>/step_<N>/.complete          (commit marker, written last)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ptype_tpu import logs
+from ptype_tpu.errors import ClusterError
+
+log = logs.get_logger("checkpoint")
+
+_MANIFEST = "manifest.json"
+_COMPLETE = ".complete"
+
+
+def _flat_key(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            part = str(p.key)
+        elif hasattr(p, "idx"):
+            part = str(p.idx)
+        else:
+            part = str(p)
+        # Keys become filenames: store keys like "params/w" must not
+        # introduce directories.
+        parts.append(part.replace("/", "%2F"))
+    return ".".join(parts) or "_root"
+
+
+class Checkpointer:
+    """Sharded pytree checkpoints under ``directory``."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+        self._pending: threading.Thread | None = None
+
+    # ------------------------------------------------------------- save
+
+    def save(self, step: int, tree: Any,
+             extras: dict[str, str] | None = None) -> str:
+        """Synchronous save; returns the step directory. ``extras`` are
+        additional ``{filename: json-text}`` committed WITH the step
+        (written before the completion marker). Waits for any pending
+        async save first — one writer at a time per Checkpointer."""
+        self.wait()
+        host = self._snapshot(tree)
+        return self._write(step, host, extras)
+
+    def async_save(self, step: int, tree: Any) -> None:
+        """Snapshot now (device→host), write in the background. At most
+        one pending write: a second call waits for the first (backpressure
+        rather than unbounded host copies)."""
+        self.wait()
+        host = self._snapshot(tree)
+        self._pending = threading.Thread(
+            target=self._write, args=(step, host),
+            name=f"ckpt-{step}", daemon=True,
+        )
+        self._pending.start()
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+
+    def _snapshot(self, tree: Any) -> list[tuple[str, list, dict]]:
+        """Pull this process's addressable shards to host memory.
+
+        Returns [(key, [(shard_index, np_array), ...], meta)] where
+        shard_index identifies the shard's position so any process set
+        can reassemble.
+        """
+        out = []
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in leaves:
+            key = _flat_key(path)
+            arr = jax.numpy.asarray(leaf) if np.isscalar(leaf) else leaf
+            shards = []
+            if isinstance(arr, jax.Array) and arr.addressable_shards:
+                # Dedup by start offset: replication (full or partial)
+                # puts identical shards on several devices — write one.
+                seen: set[tuple] = set()
+                for s in arr.addressable_shards:
+                    start = _index_start(s.index, arr.shape)
+                    if start in seen:
+                        continue
+                    seen.add(start)
+                    shards.append((list(start), np.asarray(s.data)))
+            else:
+                shards = [([0] * np.ndim(arr), np.asarray(arr))]
+            meta = {
+                "shape": list(np.shape(arr)),
+                "dtype": str(np.asarray(shards[0][1]).dtype),
+            }
+            out.append((key, shards, meta))
+        return out
+
+    def _write(self, step: int, host: list,
+               extras: dict[str, str] | None = None) -> str:
+        final = self._step_dir(step)
+        # Unique per process AND per write: a sync save racing a stale
+        # async writer must never share (or rmtree) the other's tmp dir.
+        self._seq = getattr(self, "_seq", 0) + 1
+        tmp = f"{final}.tmp.{os.getpid()}.{self._seq}"
+        os.makedirs(tmp)
+        manifest = {"step": step, "leaves": {}}
+        for key, shards, meta in host:
+            files = []
+            for i, (start, data) in enumerate(shards):
+                fname = f"{key}.shard{i}.npy"
+                np.save(os.path.join(tmp, fname), data)
+                files.append({"file": fname, "start": start,
+                              "shape": list(data.shape)})
+            manifest["leaves"][key] = {**meta, "shards": files}
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        for fname, text in (extras or {}).items():
+            with open(os.path.join(tmp, fname), "w") as f:
+                f.write(text)
+        with open(os.path.join(tmp, _COMPLETE), "w") as f:
+            f.write("ok\n")
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._gc()
+        log.info("checkpoint saved", kv={"step": step, "dir": final})
+        return final
+
+    # ---------------------------------------------------------- restore
+
+    def steps(self) -> list[int]:
+        """Complete checkpoint steps, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and os.path.exists(
+                os.path.join(self.directory, name, _COMPLETE)
+            ):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, treedef_like: Any, step: int | None = None,
+                shardings: Any | None = None) -> Any:
+        """Rebuild the pytree saved at ``step`` (default: latest).
+
+        ``treedef_like`` supplies the tree structure (e.g. an abstract
+        state from ``jax.eval_shape`` or a live pytree); ``shardings``,
+        when given, is a matching pytree of NamedSharding for device
+        placement (the resume-into-mesh path).
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise ClusterError(
+                    f"no complete checkpoint under {self.directory}"
+                )
+        sdir = self._step_dir(step)
+        with open(os.path.join(sdir, _MANIFEST)) as f:
+            manifest = json.load(f)
+
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(treedef_like)
+        shard_leaves = (
+            jax.tree_util.tree_leaves(shardings) if shardings is not None
+            else [None] * len(leaves)
+        )
+        if len(shard_leaves) != len(leaves):
+            raise ClusterError(
+                "restore: shardings tree does not match state tree"
+            )
+        out = []
+        for (path, _), sh in zip(leaves, shard_leaves):
+            key = _flat_key(path)
+            entry = manifest["leaves"].get(key)
+            if entry is None:
+                raise ClusterError(
+                    f"restore: checkpoint {step} has no leaf {key!r}"
+                )
+            full = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
+            if full.ndim == 0:
+                full = np.asarray(
+                    np.load(os.path.join(sdir, entry["shards"][0]["file"]))
+                )
+            else:
+                covered = 0
+                for rec in entry["shards"]:
+                    data = np.load(os.path.join(sdir, rec["file"]))
+                    sl = tuple(
+                        slice(st, st + sz)
+                        for st, sz in zip(rec["start"], data.shape)
+                    )
+                    full[sl] = data
+                    covered += data.size
+                # Disjoint-by-construction shards must tile the array;
+                # fail loudly rather than hand back zero-filled params.
+                if covered < full.size:
+                    raise ClusterError(
+                        f"restore: leaf {key!r} shards cover {covered} of "
+                        f"{full.size} elements — partial checkpoint "
+                        "(saved from a different process set?)"
+                    )
+            arr = jax.device_put(full, sh) if sh is not None else (
+                jax.numpy.asarray(full)
+            )
+            out.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ----------------------------------------------------------- intern
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        for old in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)
+
+
+def _index_start(index: tuple, shape: tuple) -> tuple[int, ...]:
+    """Shard slice → start offsets (None start = 0)."""
+    out = []
+    for sl, _ in zip(index, shape):
+        out.append(0 if sl.start is None else int(sl.start))
+    return tuple(out)
+
+
+class StoreCheckpoint:
+    """Persist / resume a TensorStore namespace (the Store tier).
+
+    Resume is "Join + Store pull" (SURVEY.md §5): a fresh member calls
+    ``resume()`` and the parameter space reappears with its bindings —
+    the durability role etcd's data-dir played for the reference Store.
+    """
+
+    def __init__(self, store, directory: str, keep: int = 3):
+        from ptype_tpu.parallel.tensorstore import TensorStore  # typing
+
+        assert isinstance(store, TensorStore)
+        self.store = store
+        self._ckpt = Checkpointer(directory, keep=keep)
+
+    def save(self, step: int | None = None) -> str:
+        from ptype_tpu.parallel.tensorstore import spec_to_json
+
+        keys = self.store.keys()
+        tree = {k: self.store.get(k) for k in keys}
+        step = step if step is not None else max(
+            (self.store.epoch(k) for k in keys), default=0
+        )
+        meta = {
+            k: {"spec": spec_to_json(self.store.binding(k).spec),
+                "epoch": self.store.epoch(k)}
+            for k in keys
+        }
+        # Meta rides the step's atomic commit (written before .complete),
+        # so a crash can never leave a "complete" step resume() rejects.
+        return self._ckpt.save(
+            step, tree, extras={"store_meta.json": json.dumps(meta)}
+        )
+
+    def resume(self, step: int | None = None) -> list[str]:
+        """Load the latest (or given) step back into the store; returns
+        the restored keys."""
+        from ptype_tpu.parallel.tensorstore import spec_from_json
+
+        step = step if step is not None else self._ckpt.latest_step()
+        if step is None:
+            raise ClusterError("StoreCheckpoint: nothing to resume from")
+        sdir = self._ckpt._step_dir(step)
+        with open(os.path.join(sdir, "store_meta.json")) as f:
+            meta = json.load(f)
+        # 0 (not None — None is an empty pytree, not a leaf) marks slots.
+        skeleton = {k: 0 for k in meta}
+        tree = self._ckpt.restore(skeleton, step=step)
+        for key, value in tree.items():
+            spec = spec_from_json(meta[key]["spec"])
+            self.store.put(key, value, spec=spec,
+                           epoch=int(meta[key].get("epoch", 0)))
+        return sorted(tree)

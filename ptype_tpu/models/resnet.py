@@ -1,0 +1,270 @@
+"""ResNet-50, TPU-first — the actor-per-layer pipeline model family.
+
+BASELINE.json configs: "ResNet-50 actor-per-layer pipeline (registry
+PID→stage)". The reference has no vision model (no ML code at all); this
+is a clean functional implementation designed for the MXU:
+
+- **NHWC layout** (TPU-native conv layout; XLA tiles the C dim onto the
+  MXU lanes), bf16 compute / f32 params like the transformer.
+- **Functional BN**: batch-norm statistics are explicit state — ``train=
+  True`` normalizes with batch stats and returns updated running stats;
+  ``train=False`` uses the stored running stats. No hidden mutation, so
+  every stage stays a pure function jit/pipeline/actor can move around.
+- **Stage split for the actor pipeline**: :func:`stage_split` cuts the
+  network into stem / c2 / c3 / c4 / c5 / head — the unit the registry
+  maps onto actors (train/actor_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_LAYOUT = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    n_classes: int = 1000
+    #: Blocks per stage; (3,4,6,3) = ResNet-50.
+    depths: tuple = (3, 4, 6, 3)
+    #: Bottleneck output channels per stage.
+    widths: tuple = (256, 512, 1024, 2048)
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+
+PRESETS = {
+    "resnet-50": ResNetConfig(),
+    "resnet-26": ResNetConfig(depths=(2, 2, 2, 2)),
+    "tiny": ResNetConfig(n_classes=10, depths=(1, 1), widths=(32, 64)),
+}
+
+
+def preset(name: str, **overrides) -> ResNetConfig:
+    from dataclasses import replace
+
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return replace(PRESETS[name], **overrides)
+
+
+# ------------------------------------------------------------------ params
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * std
+
+
+def _bn_init(c, dtype):
+    return {
+        "scale": jnp.ones((c,), dtype),
+        "bias": jnp.zeros((c,), dtype),
+        "mean": jnp.zeros((c,), dtype),
+        "var": jnp.ones((c,), dtype),
+    }
+
+
+def _bottleneck_init(key, cin, cout, dtype):
+    mid = cout // 4
+    k = jax.random.split(key, 4)
+    p = {
+        "conv1": _conv_init(k[0], 1, 1, cin, mid, dtype),
+        "bn1": _bn_init(mid, dtype),
+        "conv2": _conv_init(k[1], 3, 3, mid, mid, dtype),
+        "bn2": _bn_init(mid, dtype),
+        "conv3": _conv_init(k[2], 1, 1, mid, cout, dtype),
+        "bn3": _bn_init(cout, dtype),
+    }
+    if cin != cout:
+        p["proj"] = _conv_init(k[3], 1, 1, cin, cout, dtype)
+        p["bn_proj"] = _bn_init(cout, dtype)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig) -> dict:
+    pd = cfg.param_dtype
+    keys = jax.random.split(rng, 3 + len(cfg.depths))
+    params: dict = {
+        "stem": {
+            "conv": _conv_init(keys[0], 7, 7, 3, 64, pd),
+            "bn": _bn_init(64, pd),
+        },
+        "head": {
+            "w": jax.random.normal(
+                keys[1], (cfg.widths[-1], cfg.n_classes), pd) * 0.01,
+            "b": jnp.zeros((cfg.n_classes,), pd),
+        },
+    }
+    cin = 64
+    for si, (depth, cout) in enumerate(zip(cfg.depths, cfg.widths)):
+        bkeys = jax.random.split(keys[3 + si], depth)
+        blocks = []
+        for bi in range(depth):
+            blocks.append(_bottleneck_init(
+                bkeys[bi], cin if bi == 0 else cout, cout, pd))
+        params[f"stage{si + 1}"] = blocks
+        cin = cout
+    return params
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _conv(x, w, stride=1, dtype=jnp.bfloat16):
+    return lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=_LAYOUT,
+    )
+
+
+def _bn(x, p, cfg: ResNetConfig, train: bool):
+    """Returns (y, new_stats). Stats math in f32."""
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new = {"mean": m * p["mean"] + (1 - m) * mean,
+               "var": m * p["var"] + (1 - m) * var}
+    else:
+        mean, var = p["mean"].astype(jnp.float32), p["var"].astype(jnp.float32)
+        new = {"mean": p["mean"], "var": p["var"]}
+    y = (x32 - mean) * lax.rsqrt(var + cfg.bn_eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new
+
+
+def _bottleneck(x, p, cfg, stride, train, stats_out):
+    dt = cfg.dtype
+    y, s1 = _bn(_conv(x, p["conv1"], 1, dt), p["bn1"], cfg, train)
+    y = jax.nn.relu(y)
+    y, s2 = _bn(_conv(y, p["conv2"], stride, dt), p["bn2"], cfg, train)
+    y = jax.nn.relu(y)
+    y, s3 = _bn(_conv(y, p["conv3"], 1, dt), p["bn3"], cfg, train)
+    stats_out.update({"bn1": s1, "bn2": s2, "bn3": s3})
+    if "proj" in p:
+        sc, sp = _bn(_conv(x, p["proj"], stride, dt), p["bn_proj"], cfg,
+                     train)
+        stats_out["bn_proj"] = sp
+    else:
+        sc = x if stride == 1 else x[:, ::stride, ::stride, :]
+    return jax.nn.relu(y + sc)
+
+
+def stem_apply(p, x, cfg, train=False):
+    stats: dict = {}
+    y, s = _bn(_conv(x, p["conv"], 2, cfg.dtype), p["bn"], cfg, train)
+    stats["bn"] = s
+    y = jax.nn.relu(y)
+    y = lax.reduce_window(
+        y, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    return y, stats
+
+
+def stage_apply(blocks, x, cfg, stage_idx, train=False):
+    """One residual stage (list of bottlenecks); stride 2 on the first
+    block of every stage but the first."""
+    stats = []
+    for bi, p in enumerate(blocks):
+        s: dict = {}
+        stride = 2 if (bi == 0 and stage_idx > 0) else 1
+        x = _bottleneck(x, p, cfg, stride, train, s)
+        stats.append(s)
+    return x, stats
+
+
+def head_apply(p, x, cfg):
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global avg pool
+    return x @ p["w"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+
+
+def forward(params: dict, x: jax.Array, cfg: ResNetConfig,
+            train: bool = False):
+    """Logits (B, n_classes); x: (B, H, W, 3). Returns (logits, stats)
+    where ``stats`` mirrors the BN running-stat leaves (train=True) —
+    merge with :func:`update_stats`."""
+    stats: dict = {}
+    y, stats["stem"] = stem_apply(params["stem"], x, cfg, train)
+    for si in range(len(cfg.depths)):
+        y, stats[f"stage{si + 1}"] = stage_apply(
+            params[f"stage{si + 1}"], y, cfg, si, train
+        )
+    return head_apply(params["head"], y, cfg), stats
+
+
+def update_stats(params: dict, stats: dict) -> dict:
+    """Merge BN stat updates back into the param tree (pure)."""
+
+    def merge(p, s):
+        if isinstance(p, dict):
+            out = {}
+            for k, v in p.items():
+                if k in ("mean", "var") and k in s:
+                    out[k] = s[k].astype(v.dtype)
+                elif isinstance(s, dict) and k in s:
+                    out[k] = merge(v, s[k])
+                else:
+                    out[k] = v
+            return out
+        if isinstance(p, list):
+            return [merge(pi, si) for pi, si in zip(p, s)]
+        return p
+
+    merged = dict(params)
+    for key in stats:
+        merged[key] = merge(params[key], stats[key])
+    return merged
+
+
+def loss_fn(params, batch, cfg, train=True):
+    """Softmax cross-entropy; batch: {"images": (B,H,W,3), "labels": (B,)}.
+    Returns (loss, stats)."""
+    logits, stats = forward(params, batch["images"], cfg, train)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, batch["labels"][:, None], axis=-1
+    )[:, 0]
+    return jnp.mean(logz - gold), stats
+
+
+# ------------------------------------------------------------ stage split
+
+
+def stage_split(params: dict, cfg: ResNetConfig, train: bool = False):
+    """[(name, apply_fn, params)] — the actor-per-layer pipeline units.
+
+    Each ``apply_fn(params, x) -> y`` is pure; the registry maps each
+    entry to an actor (PID→stage, north star). ``train=True`` normalizes
+    with batch statistics (the correct training behavior — gradients
+    flow through the batch moments); running-stat updates are dropped in
+    this mode, so recompute them post-training (one ``forward(...,
+    train=True)`` + :func:`update_stats` sweep) before switching to
+    inference."""
+    parts: list = [
+        ("stem", lambda p, x: stem_apply(p, x, cfg, train)[0],
+         params["stem"]),
+    ]
+    for si in range(len(cfg.depths)):
+        name = f"stage{si + 1}"
+        parts.append((
+            name,
+            (lambda si_: lambda p, x: stage_apply(p, x, cfg, si_, train)[0])(si),
+            params[name],
+        ))
+    parts.append(("head", lambda p, x: head_apply(p, x, cfg),
+                  params["head"]))
+    return parts

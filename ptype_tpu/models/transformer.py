@@ -52,6 +52,8 @@ class TransformerConfig:
     tie_embeddings: bool = True
     #: Rematerialize each block in backward (jax.checkpoint).
     remat: bool = False
+    #: Causal (decoder) vs. bidirectional (encoder/BERT) attention.
+    causal: bool = True
     #: "xla" (fused by the compiler) or "ring" (shard_map ring attention
     #: over the "seq" mesh axis — see parallel/ring_attention.py).
     attn_impl: str = "xla"
@@ -75,6 +77,13 @@ PRESETS: dict[str, TransformerConfig] = {
     "optimus-125m": TransformerConfig(),  # defaults above ≈ 110M params
     "optimus-350m": TransformerConfig(
         d_model=1024, n_layers=24, n_heads=16, d_ff=2816,
+    ),
+    # Encoder config for the async param-server baseline ("BERT-base async
+    # param-server mode", BASELINE.json configs) — bidirectional attention,
+    # MLM-style masked loss via loss_mask.
+    "bert-base": TransformerConfig(
+        vocab_size=30592, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+        max_seq=512, causal=False, tie_embeddings=True,
     ),
     "llama-3-8b": TransformerConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
@@ -187,8 +196,9 @@ def _attention(q, k, v, cfg: TransformerConfig):
         v = jnp.repeat(v, H // K, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(Dh))
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    scores = jnp.where(causal[None, None], scores, jnp.float32(-1e30))
+    if cfg.causal:
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        scores = jnp.where(causal[None, None], scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -240,11 +250,9 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                       head.astype(jnp.float32))
 
 
-def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
-            attn_fn=None) -> jax.Array:
-    """Mean next-token cross-entropy. ``batch``: tokens (B,S) int32,
-    targets (B,S) int32, optional loss_mask (B,S)."""
-    logits = forward(params, batch["tokens"], cfg, attn_fn)
+def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
+    """(Masked) mean cross-entropy from precomputed logits — shared by
+    the dense forward, the pipelined forward, and eval paths."""
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, batch["targets"][..., None], axis=-1
@@ -255,6 +263,14 @@ def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
         return jnp.mean(nll)
     mask = mask.astype(nll.dtype)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """Mean next-token cross-entropy. ``batch``: tokens (B,S) int32,
+    targets (B,S) int32, optional loss_mask (B,S)."""
+    return nll_from_logits(forward(params, batch["tokens"], cfg, attn_fn),
+                           batch)
 
 
 # ---------------------------------------------------------------- sharding

@@ -1,0 +1,250 @@
+"""Pipeline parallelism over the ``stage`` mesh axis — compiled SPMD.
+
+The reference's closest analog was actor-per-service topology
+(cluster/registry.go:17-21; SURVEY.md §2 parallelism table "PP"). The
+TPU-native lowering is NOT per-layer RPC: all stages run ONE compiled
+SPMD program; microbatches flow around the ``stage`` ring via
+``lax.ppermute`` inside a ``lax.scan`` over pipeline ticks (GPipe-style
+schedule, bubble = (S-1)/(M+S-1)). Autodiff through the scan+ppermute
+gives the reverse pipeline for free — ppermute's transpose is the
+reverse rotation, so one ``jax.grad`` yields forward AND backward
+pipelining with no hand-written schedule.
+
+Layer split: the transformer's stacked blocks (leading ``n_layers`` dim,
+models/transformer.py init_params) reshape to ``(S, L/S, ...)`` and
+shard dim 0 over ``stage`` — each device holds only its stage's layers,
+the actor-per-layer memory model without the RPC hops.
+
+(The registry-driven actor pipeline — PID→stage over real RPC — lives in
+ptype_tpu/train/actor_pipeline.py; this module is the throughput path.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ptype_tpu.errors import ClusterError
+
+
+def split_stages(blocks: dict, n_stages: int) -> dict:
+    """Reshape stacked block params (L, ...) → (S, L/S, ...)."""
+
+    def resh(x):
+        L = x.shape[0]
+        if L % n_stages:
+            raise ClusterError(
+                f"pipeline: {L} layers not divisible into {n_stages} stages"
+            )
+        return x.reshape(n_stages, L // n_stages, *x.shape[1:])
+
+    return jax.tree.map(resh, blocks)
+
+
+def merge_stages(blocks: dict) -> dict:
+    """Inverse of :func:`split_stages`: (S, L/S, ...) → (L, ...)."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), blocks
+    )
+
+
+def _spmd_pipeline(stage_fn, stage_params, x_mb, *, axis: str,
+                   n_stages: int, n_microbatches: int):
+    """Run the pipeline on one device (inside shard_map over ``axis``).
+
+    ``stage_params``: (1, L/S, ...) — this stage's layers (leading stage
+    shard dim of size 1). ``x_mb``: (M, mb, ...) microbatched activations
+    (replicated over the stage axis). Returns (M, mb, ...) outputs of the
+    LAST stage (replicated via collective broadcast at the end).
+    """
+    stage = lax.axis_index(axis)
+    S, M = n_stages, n_microbatches
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    mb_shape = x_mb.shape[1:]
+
+    state = jnp.zeros(mb_shape, x_mb.dtype)  # activation in flight
+    outputs = jnp.zeros_like(x_mb)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 ingests microbatch t (while t < M); other stages keep
+        # the activation that just arrived from their predecessor.
+        inject = x_mb[jnp.minimum(t, M - 1) % M]
+        state = jnp.where(stage == 0, jnp.where(t < M, inject, state),
+                          state)
+        state = stage_fn(params, state)
+        # The LAST stage has just finished microbatch t-(S-1).
+        out_t = t - (S - 1)
+        is_out = (stage == S - 1) & (out_t >= 0)
+        outputs = jnp.where(
+            is_out,
+            jax.lax.dynamic_update_index_in_dim(
+                outputs, state.astype(outputs.dtype),
+                jnp.maximum(out_t, 0) % M, 0),
+            outputs,
+        )
+        state = lax.ppermute(state, axis, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(M + S - 1)
+    )
+    # Outputs live on the last stage only; broadcast around the ring so
+    # every stage returns the same (replicated out_spec).
+    outputs = lax.psum(
+        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), axis
+    )
+    return outputs
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
+                   n_microbatches: int, axis: str = "stage"):
+    """Apply a stage-sharded layer stack to ``x`` through the pipeline.
+
+    ``stage_fn(params_one_stage, x_mb) -> y_mb`` runs this stage's layer
+    chunk on one microbatch. ``stage_params`` leaves carry a leading
+    ``n_stages`` dim (from :func:`split_stages`), sharded over ``axis``.
+    ``x``: (B, ...) with B divisible by ``n_microbatches``.
+    """
+    S = int(mesh.shape[axis])
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ClusterError(
+            f"pipeline: batch {B} not divisible into {n_microbatches} "
+            "microbatches"
+        )
+    x_mb = x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *(None,) * (p.ndim - 1)), stage_params
+    )
+    fn = shard_map(
+        partial(_spmd_pipeline, stage_fn, axis=axis, n_stages=S,
+                n_microbatches=n_microbatches),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    y_mb = fn(stage_params, x_mb)
+    return y_mb.reshape(B, *y_mb.shape[2:])
+
+
+# ------------------------------------------------- transformer integration
+
+
+def transformer_pipeline_forward(params: dict, tokens: jax.Array, cfg,
+                                 mesh: Mesh, n_microbatches: int,
+                                 axis: str = "stage") -> jax.Array:
+    """models/transformer.forward with the block stack pipelined.
+
+    Embedding and the LM head stay outside the pipeline (they are one
+    matmul each); the L blocks split into ``stage``-many chunks. Same
+    logits as the dense forward, modulo bf16 accumulation order.
+    """
+    from ptype_tpu.models import transformer as tfm
+
+    S = int(mesh.shape[axis])
+    B, T = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"][tokens].astype(dt)
+    sin, cos = tfm.rope_tables(cfg, T)
+    stage_blocks = split_stages(params["blocks"], S)
+
+    def stage_fn(blocks, x_mb):
+        def body(x, layer):
+            return tfm._block(x, layer, sin, cos, cfg, tfm._attention), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x_mb, _ = lax.scan(body, x_mb, blocks)
+        return x_mb
+
+    x = pipeline_apply(stage_fn, stage_blocks, x, mesh, n_microbatches,
+                       axis)
+    x = tfm.rms_norm(x, params["final_norm"])
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                      head.astype(jnp.float32))
+
+
+def pipeline_state_shardings(params_like, mesh: Mesh, optimizer,
+                             axis: str = "stage"):
+    """NamedSharding pytree for a pipelined TrainState: block leaves
+    shard their leading layer dim over ``axis`` (L = S·L/S, so the
+    per-stage split is a local reshape), everything else replicated;
+    optax moments mirror the params."""
+    from ptype_tpu.train.trainer import TrainState
+
+    def param_sh(path, leaf):
+        top = getattr(path[0], "key", None) if path else None
+        if top == "blocks":
+            return NamedSharding(mesh, P(axis, *(None,) * (leaf.ndim - 1)))
+        return NamedSharding(mesh, P())
+
+    params_shape = jax.eval_shape(lambda: params_like) \
+        if not hasattr(jax.tree.leaves(params_like)[0], "shape") \
+        else params_like
+    p_sh = jax.tree_util.tree_map_with_path(param_sh, params_shape)
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+
+    flat_p, _ = jax.tree_util.tree_flatten(params_shape)
+    flat_sh = jax.tree_util.tree_flatten(p_sh)[0]
+    by_shape = {}
+    for leaf, sh in zip(flat_p, flat_sh):
+        by_shape.setdefault(tuple(leaf.shape), sh)
+    repl = NamedSharding(mesh, P())
+    o_sh = jax.tree.map(
+        lambda l: by_shape.get(tuple(l.shape), repl), opt_shape
+    )
+    return TrainState(p_sh, o_sh, repl)
+
+
+def make_pipeline_train_step(cfg, mesh: Mesh, n_microbatches: int,
+                             optimizer=None, axis: str = "stage",
+                             state_shardings=None):
+    """(state, batch) → (state, metrics) with the block stack pipelined.
+
+    State layout matches train/trainer.py's TrainState, so checkpoints
+    interchange between pipelined and dense training. Pass
+    ``state_shardings`` (from :func:`pipeline_state_shardings`) to pin
+    each stage's layers — and their Adam moments — to that stage's
+    devices; without it the state is replicated (fine for tests, wrong
+    for models sized to per-stage memory).
+    """
+    import optax
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.train.trainer import TrainState, default_optimizer
+
+    optimizer = optimizer or default_optimizer()
+
+    def loss_fn(p, batch):
+        logits = transformer_pipeline_forward(
+            p, batch["tokens"], cfg, mesh, n_microbatches, axis
+        )
+        return tfm.nll_from_logits(logits, batch)
+
+    def step(state: TrainState, batch: dict):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new = TrainState(new_params, opt_state, state.step + 1)
+        return new, {"loss": loss, "step": new.step}
+
+    kw = {}
+    if state_shardings is not None:
+        kw = {"in_shardings": (state_shardings,
+                               NamedSharding(mesh, P())),
+              "out_shardings": (state_shardings,
+                                {"loss": NamedSharding(mesh, P()),
+                                 "step": NamedSharding(mesh, P())})}
+    return jax.jit(step, donate_argnums=(0,), **kw)

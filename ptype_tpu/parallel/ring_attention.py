@@ -1,0 +1,178 @@
+"""Ring attention over the ``seq`` mesh axis — long-context sequence
+parallelism.
+
+The reference has no long-context story (SURVEY.md §5: absent — no ML
+code); this is the TPU-native build target it mandates: "sequence-axis
+sharding with ``ppermute`` ring collectives over ICI (blockwise K/V
+rotation)". Each device holds one sequence block of Q, K, V; K/V blocks
+rotate around the ICI ring while a flash-style online softmax accumulates
+the output, so attention over sequence length S costs O(S/n) memory per
+chip and the rotation overlaps with the block matmuls.
+
+Causality is enforced at two levels: whole K/V blocks from later ring
+positions are skipped-by-masking, and the diagonal block applies the
+usual triangular mask on global positions.
+
+Usage: ``attn_fn = make_ring_attention(mesh)`` → pass to
+``transformer.forward``/``make_train_step`` with ``seq_axis=True`` so the
+batch's sequence dim is sharded over ``seq``. Degrades to dense attention
+when the mesh has no ``seq`` axis (mesh.py axis conventions).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: broadcast KV heads across query groups. (B,S,K,Dh)→(B,S,K*r,Dh)."""
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def _ring_body(q, k, v, *, axis: str, n_blocks: int, causal: bool = True):
+    """Per-device ring attention. q,k,v: (B, S_loc, H, Dh) local blocks.
+
+    Online-softmax accumulators (all f32): o (B,S,H,Dh), running max m and
+    denominator l (B,H,S). K/V rotate via ppermute; at scan step t this
+    device holds the block originating at ring position (idx - t) mod n.
+    """
+    idx = lax.axis_index(axis)
+    B, S, H, Dh = q.shape
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(Dh))
+
+    q_pos = idx * S + jnp.arange(S)  # global query positions
+    local_pos = jnp.arange(S)
+
+    o0 = jnp.zeros((B, S, H, Dh), jnp.float32)
+    m0 = jnp.full((B, H, S), NEG_INF)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    def step(carry, t):
+        o, m, l, k, v = carry
+        src = (idx - t) % n_blocks  # origin block of the K/V we hold now
+        k_pos = src * S + local_pos
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            # (S_q, S_k) causal mask on GLOBAL positions; whole-block skip
+            # for future blocks falls out of the same comparison.
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(allowed[None, None], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])  # (B,H,Q,K) f32
+        l = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        o = o * correction.transpose(0, 2, 1)[..., None] + pv
+
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return (o, m_new, l, k, v), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n_blocks)
+    )
+    o = o / l.transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "seq"):
+    """Build an ``attn_fn(q, k, v, cfg)`` running ring attention over
+    ``axis``. Call sites pass GLOBAL (B, S, H|K, Dh) arrays under jit;
+    the shard_map shards S over the ring and B/H over whatever data/model
+    axes the mesh has. Falls back to dense attention if the axis is
+    absent or trivial."""
+    from ptype_tpu.models.transformer import _attention
+
+    n = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+    if n <= 1:
+        return _attention
+
+    batch_axes = tuple(
+        a for a in ("data", "fsdp") if a in mesh.axis_names
+    ) or None
+    head_axis = "model" if "model" in mesh.axis_names else None
+    spec = P(batch_axes, axis, head_axis, None)
+
+    def attn_fn(q, k, v, cfg):
+        H, K = q.shape[2], k.shape[2]
+        k = _repeat_kv(k, H // K)
+        v = _repeat_kv(v, H // K)
+        body = shard_map(
+            partial(_ring_body, axis=axis, n_blocks=n, causal=cfg.causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return body(q, k, v)
+
+    return attn_fn
+
+
+# ------------------------------------------------------- Ulysses variant
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = "seq"):
+    """Ulysses-style sequence parallelism: ``all_to_all`` head-scatter.
+
+    Instead of rotating K/V, each device trades its sequence shard for a
+    head shard (all_to_all over ``axis``), runs DENSE attention on full
+    sequence × (H/n) heads, then trades back. One collective pair per
+    attention instead of n−1 ppermutes — wins when heads ≥ ring size and
+    ICI all_to_all bandwidth is good (SURVEY.md §5 "Ulysses-style
+    head-scatter all_to_all")."""
+    from ptype_tpu.models.transformer import _attention
+
+    n = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+    if n <= 1:
+        return _attention
+
+    batch_axes = tuple(
+        a for a in ("data", "fsdp") if a in mesh.axis_names
+    ) or None
+    spec = P(batch_axes, axis, None, None)
+
+    def body(q, k, v, *, cfg):
+        # (B, S/n, H, Dh) → (B, S, H/n, Dh): scatter heads, gather seq.
+        def exch(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        oq, ok, ov = exch(q), exch(k), exch(v)
+        o = _attention(oq, ok, ov, cfg)
+        # inverse: scatter seq, gather heads
+        return lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def attn_fn(q, k, v, cfg):
+        H, K = q.shape[2], k.shape[2]
+        if H % n:
+            raise ValueError(
+                f"ulysses: n_heads {H} must divide by seq axis size {n}"
+            )
+        k = _repeat_kv(k, H // K)
+        v = _repeat_kv(v, H // K)
+        sm = shard_map(
+            partial(body, cfg=cfg),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return sm(q, k, v)
+
+    return attn_fn

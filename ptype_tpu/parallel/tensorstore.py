@@ -100,10 +100,13 @@ class TensorStore:
 
     # ------------------------------------------------------------- basic
 
-    def put(self, key: str, value, spec: P | None = None) -> jax.Array:
-        """Place a value under the key's binding; no collective, epoch 0
-        reset. The initial-parameters path (ref Put store.go:56-62).
-        Passing ``spec`` records it as the key's binding, same as bind()."""
+    def put(self, key: str, value, spec: P | None = None,
+            epoch: int = 0) -> jax.Array:
+        """Place a value under the key's binding; no collective, epoch
+        reset to ``epoch`` (default 0 — a checkpoint resume passes the
+        saved epoch so versions never go backwards). The
+        initial-parameters path (ref Put store.go:56-62). Passing
+        ``spec`` records it as the key's binding, same as bind()."""
         if spec is None:
             b = self.binding(key)
         else:
@@ -112,7 +115,7 @@ class TensorStore:
         with self._lock:
             if spec is not None:
                 self._bindings[key] = b
-            self._entries[key] = _Entry(arr, 0, b)
+            self._entries[key] = _Entry(arr, epoch, b)
         self._publish(key)
         return arr
 

@@ -1,0 +1,210 @@
+"""Actor-per-layer pipeline — registry PID→stage (the north-star config
+"ResNet-50 actor-per-layer pipeline (registry PID→stage)", BASELINE.json).
+
+Unlike parallel/pipeline.py (one compiled SPMD program over the ``stage``
+mesh axis — the throughput path), this is the reference-shaped topology:
+each stage is an ACTOR owning its layer chunk, discovered through the
+registry, called over the balanced RPC client. Activations flow
+stage→stage as tensor-codec payloads (device buffers, zero-copy when
+co-located). It trades ICI-speed pipelining for elasticity: stages can
+live in different processes/hosts, die, and be re-registered — the
+scatter-gather failure model of the reference's optimus
+(coordinator.go:67-99), applied layer-wise.
+
+Training semantics (GPipe-equivalent): within one ``train_step`` sweep
+the stage parameters are FROZEN. ``Forward(mb, x)`` stashes the stage
+input per microbatch id; ``Backward(mb, g)`` replays the stage under
+``jax.vjp`` against the frozen params and ACCUMULATES the parameter
+gradient; ``Apply()`` — called once per sweep after every microbatch's
+backward — applies the stage-local optimizer to the summed grads. Each
+stage owns its optimizer state (per-stage Adam, no global state).
+Microbatches traverse the stages concurrently (one in-flight chain per
+microbatch), so stage i works on microbatch m while stage i+1 works on
+m-1 — the pipeline overlap, bounded by RPC latency rather than ICI.
+
+Service naming: ``<pipeline>-stage<i>`` (see :func:`stage_service`) —
+the registry's service map IS the pipeline topology; the client requires
+the discovered indices to be contiguous from 0 and refuses to run a
+pipeline with a hole where a stage should be.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+import jax
+import optax
+
+from ptype_tpu import logs
+from ptype_tpu.errors import ClusterError
+
+log = logs.get_logger("actor_pipeline")
+
+SERVICE_SEP = "-stage"
+
+
+def stage_service(pipeline: str, idx: int) -> str:
+    return f"{pipeline}{SERVICE_SEP}{idx}"
+
+
+def discover_stages(registry, pipeline: str) -> list[str]:
+    """Stage service names of a pipeline, in stage order, from the live
+    registry (the PID→stage map). Raises if the indices are not
+    contiguous from 0 — a hole means a dead/unregistered stage, and
+    piping around it would silently compute garbage."""
+    prefix = pipeline + SERVICE_SEP
+    found = []
+    for svc in registry.services():
+        if svc.startswith(prefix):
+            try:
+                found.append((int(svc[len(prefix):]), svc))
+            except ValueError:
+                continue
+    found.sort()
+    indices = [i for i, _ in found]
+    if indices and indices != list(range(len(indices))):
+        raise ClusterError(
+            f"pipeline {pipeline!r} has non-contiguous stages {indices} "
+            "— a stage is missing/unregistered"
+        )
+    return [svc for _, svc in found]
+
+
+class StageActor:
+    """One pipeline stage: params + a pure ``fn(params, x) -> y``.
+
+    Drops into an ActorServer (``server.register(stage, "Stage")``).
+    Thread-safe; per-microbatch stashes allow several microbatches in
+    flight. Params are frozen between ``Apply`` calls, so concurrent
+    Forward/Backward of different microbatches all see one version.
+    """
+
+    def __init__(self, fn: Callable, params, optimizer=None):
+        from ptype_tpu.train.trainer import make_apply_fn
+
+        self.fn = fn
+        self.params = params
+        self.optimizer = optimizer or optax.adam(1e-3)
+        self.opt_state = self.optimizer.init(params)
+        self._stash: dict[int, object] = {}
+        self._accum = None
+        self._accum_count = 0
+        self._lock = threading.Lock()
+
+        self._jit_fwd = jax.jit(lambda params, x: self.fn(params, x))
+
+        def bwd(params, x, g):
+            _, vjp = jax.vjp(self.fn, params, x)
+            return vjp(g)
+
+        self._jit_bwd = jax.jit(bwd)
+        self._jit_add = jax.jit(
+            lambda a, b: jax.tree.map(jax.numpy.add, a, b))
+        self._jit_scale = jax.jit(
+            lambda t, s: jax.tree.map(lambda l: l * s, t))
+        self._jit_apply = make_apply_fn(self.optimizer)
+
+    def Forward(self, mb: int, x):
+        """Run the stage on microbatch ``mb``, stashing x for backward."""
+        with self._lock:
+            self._stash[mb] = x
+            params = self.params
+        return self._jit_fwd(params, x)
+
+    def Backward(self, mb: int, g):
+        """VJP for microbatch ``mb`` against the frozen params;
+        accumulates the param grad, returns the upstream gradient."""
+        with self._lock:
+            x = self._stash.pop(mb)
+            params = self.params
+        dparams, dx = self._jit_bwd(params, x, g)
+        with self._lock:
+            if self._accum is None:
+                self._accum = dparams
+            else:
+                self._accum = self._jit_add(self._accum, dparams)
+            self._accum_count += 1
+        return dx
+
+    def Apply(self, mean: bool = True):
+        """One optimizer step on the grads accumulated this sweep
+        (mean over microbatches by default — matches the dense loss's
+        mean reduction). Returns the number of microbatches folded in."""
+        with self._lock:
+            grads, n = self._accum, self._accum_count
+            self._accum, self._accum_count = None, 0
+            if grads is None:
+                return 0
+            if mean and n > 1:
+                grads = self._jit_scale(grads, 1.0 / n)
+            self.params, self.opt_state = self._jit_apply(
+                self.params, grads, self.opt_state)
+            return n
+
+    def Infer(self, x):
+        """Stateless forward (no stash) — the inference path."""
+        with self._lock:
+            params = self.params
+        return self._jit_fwd(params, x)
+
+
+class PipelineClient:
+    """Drives microbatches through registry-discovered stage actors."""
+
+    def __init__(self, cluster, pipeline: str,
+                 stages: Sequence[str] | None = None, conn_cfg=None):
+        names = list(stages) if stages is not None else discover_stages(
+            cluster.registry, pipeline)
+        if not names:
+            raise ClusterError(
+                f"no stages registered for pipeline {pipeline!r}")
+        self.stage_names = names
+        self._clients = [cluster.new_client(n, conn_cfg) for n in names]
+
+    @property
+    def n_stages(self) -> int:
+        return len(self._clients)
+
+    def infer(self, x):
+        for c in self._clients:
+            x = c.call("Stage.Infer", x)
+        return x
+
+    def train_step(self, x, loss_grad_fn, n_microbatches: int = 1):
+        """One pipelined fwd+bwd sweep + per-stage Apply.
+
+        ``loss_grad_fn(y) -> (loss, dy)`` computes the loss and its
+        gradient at the pipeline output (the driver owns the loss, the
+        stages own the layers). One concurrent chain per microbatch:
+        each walks forward through the stages, through the loss, then
+        backward — so stage i processes microbatch m while stage i+1
+        processes m-1 (wall-clock ≈ (S+M-1)·t, not S·M·t). Grads
+        accumulate server-side; Apply once per sweep keeps params frozen
+        during the sweep (GPipe semantics, reproducible)."""
+        B = x.shape[0]
+        if B % n_microbatches:
+            raise ValueError(
+                f"batch {B} not divisible by {n_microbatches}")
+        mbs = x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+        def chain(m):
+            a = mbs[m]
+            for c in self._clients:
+                a = c.call("Stage.Forward", m, a)
+            loss, g = loss_grad_fn(a)
+            for c in reversed(self._clients):
+                g = c.call("Stage.Backward", m, g)
+            return float(loss)
+
+        with ThreadPoolExecutor(max_workers=n_microbatches) as pool:
+            losses = list(pool.map(chain, range(n_microbatches)))
+
+        applied = [c.call("Stage.Apply") for c in self._clients]
+        if any(n != n_microbatches for n in applied):
+            raise ClusterError(
+                f"pipeline sweep incomplete: stages applied {applied} "
+                f"microbatch grads, expected {n_microbatches}"
+            )
+        return sum(losses) / len(losses)

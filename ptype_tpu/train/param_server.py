@@ -1,0 +1,155 @@
+"""Async param-server training — Store put/get WITHOUT a barrier.
+
+The BASELINE.json config "BERT-base async param-server mode (Store
+push/pull, no allreduce)" is the reference's Store used in its raw form:
+``Put``/``Get`` with no ordering between writers beyond raft
+linearizability (cluster/store.go:38-62). Here:
+
+- The **server** owns the canonical parameters in a :class:`TensorStore`
+  namespace and applies gradient pushes as they arrive — no barrier, no
+  allreduce; each push is an optimizer step (Hogwild/Downpour-style).
+- **Workers** ``pull`` a (possibly stale) parameter snapshot, compute
+  grads on their own batch, and ``push`` them back. A staleness bound
+  rejects pushes computed against parameters more than
+  ``max_staleness`` versions old — the knob the reference never had
+  (its writers could never be stale: raft serialized them).
+
+The server's methods are plain callables, so it drops straight into an
+:class:`ptype_tpu.actor.ActorServer` (``register(ParamServer(...),
+"ParamServer")``) — the multi-host deployment is workers calling
+``ParamServer.Push``/``ParamServer.Pull`` over the balanced RPC client,
+payloads riding the tensor codec.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.tensorstore import TensorStore
+from ptype_tpu.train.trainer import default_optimizer, make_apply_fn
+
+
+class StalePushError(Exception):
+    """Grad push rejected: computed against too-old parameters."""
+
+
+def _is_stale(e: Exception) -> bool:
+    """True for a staleness rejection, local or remote. Over the actor
+    wire the server's StalePushError arrives as a RemoteError carrying
+    the exception name (actor.py error serialization) — the worker must
+    treat both forms as the same recoverable signal."""
+    return isinstance(e, StalePushError) or "StalePushError" in str(e)
+
+
+class ParamServer:
+    """Canonical parameter owner; applies async gradient pushes.
+
+    Thread-safe: concurrent worker pushes serialize on a lock (the
+    in-process analog of the reference Store serializing writes through
+    the raft leader).
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, store: TensorStore,
+                 optimizer=None, rng: jax.Array | None = None,
+                 max_staleness: int = 8):
+        self.cfg = cfg
+        self.store = store
+        self.optimizer = optimizer or default_optimizer()
+        self.max_staleness = max_staleness
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        params = jax.jit(lambda r: tfm.init_params(r, cfg))(rng)
+        self._params = params
+        self._opt_state = self.optimizer.init(params)
+        self._version = 0
+        self._applied = 0
+        self._rejected = 0
+        self._lock = threading.Lock()
+        self._treedef = jax.tree_util.tree_structure(params)
+        self.store.put_tree("params", params)
+
+        self._apply_fn = make_apply_fn(self.optimizer)
+
+    # Methods are Capitalized where they form the actor RPC surface
+    # (net/rpc naming, ref calculator.go:9-12).
+
+    def Pull(self) -> dict:
+        """Parameter snapshot + its version (the un-barriered Get)."""
+        with self._lock:
+            return {"params": self._params, "version": self._version}
+
+    def Push(self, grads: Any, version: int) -> dict:
+        """Apply one worker's grads (the un-barriered Put). ``version``
+        is the parameter version the grads were computed against."""
+        with self._lock:
+            staleness = self._version - int(version)
+            if staleness > self.max_staleness:
+                self._rejected += 1
+                raise StalePushError(
+                    f"push at version {version} is {staleness} behind "
+                    f"(max_staleness={self.max_staleness})"
+                )
+            self._params, self._opt_state = self._apply_fn(
+                self._params, grads, self._opt_state
+            )
+            self._version += 1
+            self._applied += 1
+            return {"version": self._version, "staleness": staleness}
+
+    def Sync(self) -> dict:
+        """Publish current params into the TensorStore namespace (for
+        checkpointers / late joiners reading the manifest)."""
+        with self._lock:
+            self.store.put_tree("params", self._params)
+            return {"version": self._version}
+
+    def Stats(self) -> dict:
+        with self._lock:
+            return {
+                "version": self._version,
+                "applied": self._applied,
+                "rejected": self._rejected,
+            }
+
+
+class AsyncWorker:
+    """Pull → local grads → push, against a ParamServer-shaped peer.
+
+    ``server`` is anything exposing Pull/Push — the in-process object or
+    a balanced RPC client proxy (``client.call("ParamServer.Pull")``).
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, server, worker_id: int = 0):
+        self.cfg = cfg
+        self.server = server
+        self.worker_id = worker_id
+        self.steps = 0
+        self.stale_rejections = 0
+        self._grads_fn = jax.jit(
+            lambda params, batch: jax.value_and_grad(tfm.loss_fn)(
+                params, batch, cfg
+            )
+        )
+
+    def step(self, batch: dict) -> dict:
+        snap = self.server.Pull()
+        loss, grads = self._grads_fn(snap["params"], batch)
+        try:
+            out = self.server.Push(grads, snap["version"])
+        except Exception as e:  # noqa: BLE001 — see _is_stale
+            if not _is_stale(e):
+                raise
+            self.stale_rejections += 1
+            return {"loss": float(loss), "applied": False,
+                    "worker": self.worker_id}
+        self.steps += 1
+        return {"loss": float(loss), "applied": True,
+                "version": out["version"], "staleness": out["staleness"],
+                "worker": self.worker_id}
+
+    def run(self, batches, n_steps: int) -> list[dict]:
+        return [self.step(next(batches)) for _ in range(n_steps)]

@@ -22,12 +22,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
-from ptype_tpu.train.trainer import default_optimizer
+from ptype_tpu.train.trainer import default_optimizer, make_apply_fn
 
 
 class StoreDPTrainer:
@@ -67,11 +66,7 @@ class StoreDPTrainer:
             return loss, grads
 
         self._grads_fn = jax.jit(jax.vmap(local_grads, in_axes=(None, 0)))
-        self._apply_fn = jax.jit(
-            lambda params, grads, opt_state: _apply(
-                self.optimizer, params, grads, opt_state
-            )
-        )
+        self._apply_fn = make_apply_fn(self.optimizer)
 
     def params(self) -> dict:
         flat = self.store.get_tree("params")
@@ -120,8 +115,3 @@ class StoreDPTrainer:
 
     def _grad_key0(self) -> str:
         return self._keys[0].replace("params/", "grads/", 1)
-
-
-def _apply(optimizer, params, grads, opt_state):
-    updates, opt_state = optimizer.update(grads, opt_state, params)
-    return optax.apply_updates(params, updates), opt_state

@@ -54,6 +54,18 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
     )
 
 
+def make_apply_fn(optimizer):
+    """Jitted ``(params, grads, opt_state) -> (params, opt_state)`` —
+    the one optimizer-step helper every eager trainer shares (store_dp,
+    param_server, actor_pipeline)."""
+
+    def apply(params, grads, opt_state):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    return jax.jit(apply)
+
+
 def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
                      optimizer) -> TrainState:
     """Sharding pytree for TrainState: optax mirrors param specs."""

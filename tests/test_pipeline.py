@@ -1,0 +1,124 @@
+"""Compiled pipeline parallelism: numerics vs. dense, training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ptype_tpu.errors import ClusterError
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.mesh import build_mesh
+from ptype_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    merge_stages,
+    pipeline_apply,
+    split_stages,
+    transformer_pipeline_forward,
+)
+
+CFG = tfm.preset("tiny", n_layers=4, dtype=jnp.float32)
+
+
+def test_split_merge_roundtrip():
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    staged = split_stages(params["blocks"], 2)
+    assert staged["wq"].shape[:2] == (2, 2)
+    merged = merge_stages(staged)
+    for a, b in zip(jax.tree.leaves(merged),
+                    jax.tree.leaves(params["blocks"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_split_indivisible_raises():
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    with pytest.raises(ClusterError):
+        split_stages(params["blocks"], 3)
+
+
+def test_pipeline_apply_linear_chain():
+    """4-stage pipeline of y = x @ w against the sequential product."""
+    mesh = build_mesh({"stage": 4})
+    ws = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8)) * 0.5
+
+    def stage_fn(w_chunk, x):  # w_chunk: (1, 8, 8) — one layer per stage
+        return x @ w_chunk[0]
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (6, 8))
+    stage_params = ws.reshape(4, 1, 8, 8)
+    got = pipeline_apply(stage_fn, stage_params, x, mesh,
+                         n_microbatches=3)
+    want = x
+    for i in range(4):
+        want = want @ ws[i]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n_mb", [2, 4])
+def test_transformer_pipeline_matches_dense(n_mb):
+    mesh = build_mesh({"stage": 2})
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, CFG.vocab_size, jnp.int32
+    )
+    got = jax.jit(
+        lambda p, t: transformer_pipeline_forward(p, t, CFG, mesh, n_mb)
+    )(params, toks)
+    want = tfm.forward(params, toks, CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_grads_match_dense():
+    """Backward through the pipeline (scan+ppermute transpose) equals
+    dense grads — the free reverse-pipeline property."""
+    mesh = build_mesh({"stage": 2})
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, CFG.vocab_size, jnp.int32
+    )
+    batch = {"tokens": toks, "targets": toks}
+
+    def pipe_loss(p):
+        logits = transformer_pipeline_forward(p, toks, CFG, mesh, 2)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, toks[..., None], -1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    def dense_loss(p):
+        return tfm.loss_fn(p, batch, CFG)
+
+    gp = jax.jit(jax.grad(pipe_loss))(params)
+    gd = jax.grad(dense_loss)(params)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_train_step():
+    from ptype_tpu.parallel.pipeline import pipeline_state_shardings
+
+    mesh = build_mesh({"stage": 4})
+    cfg = tfm.preset("tiny", n_layers=4)  # bf16 path
+    from ptype_tpu.train.trainer import TrainState, default_optimizer
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = default_optimizer()
+    state = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+    sh = pipeline_state_shardings(params, mesh, opt)
+    state = jax.device_put(state, sh)
+    # Stage-sharded placement: each device holds 1/4 of the layer stack
+    # (and of its Adam moments).
+    assert state.params["blocks"]["wq"].sharding.spec[0] == "stage"
+    step = make_pipeline_train_step(cfg, mesh, n_microbatches=4,
+                                    optimizer=opt, state_shardings=sh)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size, jnp.int32
+    )
+    losses = []
+    for _ in range(3):
+        state, out = step(state, {"tokens": toks, "targets": toks})
+        losses.append(float(out["loss"]))
+    assert int(state.step) == 3
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # it learns the (repeated) batch

@@ -1,0 +1,119 @@
+"""Ring/Ulysses attention vs. the dense reference on the CPU mesh.
+
+The numerics tier the reference never needed (SURVEY.md §4 "TPU
+translation"): collective results checked against the single-device
+implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.mesh import build_mesh
+from ptype_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    make_ulysses_attention,
+)
+
+CFG = tfm.preset("tiny", dtype=jnp.float32)  # f32 for tight comparison
+
+
+def _qkv(rng, B=2, S=32, H=4, K=None, Dh=16):
+    K = K or H
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, Dh), jnp.float32)
+    k = jax.random.normal(kk, (B, S, K, Dh), jnp.float32)
+    v = jax.random.normal(kv, (B, S, K, Dh), jnp.float32)
+    return q, k, v
+
+
+def _dense(q, k, v):
+    return tfm._attention(q, k, v, CFG)
+
+
+@pytest.mark.parametrize("seq_n", [2, 4])
+def test_ring_matches_dense(seq_n):
+    mesh = build_mesh({"seq": seq_n})
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    attn = make_ring_attention(mesh)
+    got = jax.jit(lambda q, k, v: attn(q, k, v, CFG))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_dense(q, k, v)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_gqa_matches_dense():
+    mesh = build_mesh({"seq": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(1), H=4, K=2)
+    attn = make_ring_attention(mesh)
+    got = jax.jit(lambda q, k, v: attn(q, k, v, CFG))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_dense(q, k, v)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_with_data_axis():
+    mesh = build_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+    attn = make_ring_attention(mesh)
+    got = jax.jit(lambda q, k, v: attn(q, k, v, CFG))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_dense(q, k, v)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_degrades_without_axis():
+    mesh = build_mesh({"data": 2})
+    attn = make_ring_attention(mesh)
+    assert attn is tfm._attention
+
+
+def test_ulysses_matches_dense():
+    mesh = build_mesh({"seq": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(3))
+    attn = make_ulysses_attention(mesh)
+    got = jax.jit(lambda q, k, v: attn(q, k, v, CFG))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_dense(q, k, v)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_grads_match_dense():
+    """Backward through the ring (scan + ppermute) matches dense grads."""
+    mesh = build_mesh({"seq": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(4))
+    attn = make_ring_attention(mesh)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(attn(q, k, v, CFG) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4
+        )
+
+
+def test_train_step_with_ring_attention():
+    """Full train step with the sequence axis sharded — the long-context
+    training path end to end."""
+    from ptype_tpu.train import trainer as tr
+
+    mesh = build_mesh({"data": 2, "seq": 4})
+    cfg = tfm.preset("tiny")
+    attn = make_ring_attention(mesh)
+    state, _ = tr.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = tr.make_train_step(cfg, mesh, attn_fn=attn, seq_axis=True)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(5), (4, 64), 0, cfg.vocab_size, jnp.int32
+    )
+    batch = {"tokens": toks, "targets": toks}
+    state, out = step(state, batch)
+    assert np.isfinite(float(out["loss"]))
+    assert int(out["step"]) == 1
