@@ -2,7 +2,7 @@
 # wall-clock budget, Makefile:1-6) — Python's analog: the full suite on the
 # virtual 8-device CPU mesh with a hard timeout.
 
-.PHONY: test bench lint
+.PHONY: test bench lint native
 
 test:
 	python -m pytest tests/ -x -q
@@ -12,3 +12,8 @@ bench:
 
 lint:
 	python -m compileall -q ptype_tpu
+
+# Native wire transport (writev frame sends, GIL-free reads, crc32c).
+# ptype_tpu.native also builds this lazily on first load.
+native:
+	g++ -O3 -fPIC -shared -o ptype_tpu/_ptype_wire.so native/ptype_wire.cpp

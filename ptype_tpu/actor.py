@@ -150,17 +150,23 @@ class ActorServer:
         try:
             args = codec.decode(args_blob) if args_blob is not None else ()
             result = self.dispatch(method, args)
-            result_blob = codec.encode(result)
-            reply = {"id": req_id, "ok": True, "result_len": len(result_blob)}
+            result_parts = codec.encode_parts(result)
+            reply = {"id": req_id, "ok": True,
+                     "result_len": sum(len(p) for p in result_parts)}
         except Exception as e:  # noqa: BLE001 — server must not die
             reply = {"id": req_id, "ok": False, "error": f"{type(e).__name__}: {e}",
                      "traceback": traceback.format_exc()}
-            result_blob = b""
+            result_parts = []
         try:
             payload = json.dumps(reply, separators=(",", ":")).encode()
-            # One sendall keeps the header frame and result blob adjacent.
+            # One writev (native) / one sendall keeps the header frame and
+            # result blobs adjacent without a concatenation copy.
+            from ptype_tpu import native
+
             with send_lock:
-                conn.sendall(struct.pack(">I", len(payload)) + payload + result_blob)
+                if not native.send_frame(conn, payload, result_parts):
+                    conn.sendall(struct.pack(">I", len(payload)) + payload
+                                 + b"".join(result_parts))
         except OSError:
             pass
 

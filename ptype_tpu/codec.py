@@ -50,8 +50,8 @@ def _is_jax_array(x: Any) -> bool:
     return isinstance(x, jax.Array)
 
 
-def encode(payload: Any) -> bytes:
-    """Serialize an arbitrary pytree-ish payload into one frame."""
+def _encode_impl(payload: Any) -> tuple[bytes, list]:
+    """(header JSON bytes, blob list) — the frame minus assembly."""
     blobs: list[bytes | memoryview] = []
 
     def enc(x: Any):
@@ -91,9 +91,23 @@ def encode(payload: Any) -> bytes:
         {"tree": tree, "blobs": [len(b) for b in blobs]},
         separators=(",", ":"),
     ).encode("utf-8")
-    parts = [_LEN.pack(len(header)), header]
-    parts.extend(bytes(b) for b in blobs)
-    return b"".join(parts)
+    return header, blobs
+
+
+def encode(payload: Any) -> bytes:
+    """Serialize an arbitrary pytree-ish payload into one frame."""
+    return b"".join(encode_parts(payload))
+
+
+def encode_parts(payload: Any) -> list[bytes]:
+    """Like :func:`encode` but WITHOUT the final join: the frame as
+    ``[4B header-len, header, blob0, ...]`` pieces. The native wire tier
+    (ptype_tpu.native.send_frame) hands these to one writev(), so a
+    multi-hundred-MB parameter payload is never copied into a second
+    contiguous bytes object. ``b"".join(encode_parts(x)) == encode(x)``.
+    """
+    header, blobs = _encode_impl(payload)
+    return [_LEN.pack(len(header)), header, *(bytes(b) for b in blobs)]
 
 
 def decode(frame: bytes | memoryview, device: Any = None) -> Any:

@@ -37,16 +37,31 @@ def recv_msg(sock: socket.socket) -> dict:
     if length > MAX_FRAME:
         raise WireError(f"frame too large: {length} bytes")
     payload = _recv_exact(sock, length)
-    return json.loads(payload.decode("utf-8"))
+    return json.loads(bytes(payload).decode("utf-8"))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    remaining = n
-    while remaining:
-        chunk = sock.recv(remaining)
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    """Read exactly n bytes into one preallocated buffer (no chunk list
+    + join). Uses the native GIL-free reader when built (ptype_tpu.native,
+    the compiled-runtime tier); recv_into otherwise."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    try:
+        from ptype_tpu import native
+
+        if native.available():
+            got = native.recv_exact_into(sock, view)
+            if got < n:
+                raise WireError("connection closed")
+            return view
+    except NotImplementedError:
+        pass
+    except ImportError:
+        pass
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             raise WireError("connection closed")
-        chunks.append(chunk)
-        remaining -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return view

@@ -1,0 +1,218 @@
+"""Failure detection + elastic recovery: stop → reshard → resume.
+
+The reference's elasticity was per-call: lease expiry (2 s TTL,
+registry.go:58-83) dropped dead nodes from the balancer, and round-robin
+retries routed around them (SURVEY.md §5 "Failure detection"). XLA
+collectives cannot fail over per call — the device set is baked into the
+compiled program — so the TPU-native contract is the one SURVEY.md §7
+names the hardest: separate "membership event" from "mesh rebuild", and
+on member loss run checkpoint → rebuild mesh over the survivors →
+restore (resharded) → resume.
+
+- :class:`FailureDetector` — watches a service's registry stream
+  (snapshot-then-deltas) and reports joins/losses. Liveness is lease
+  expiry, exactly the reference mechanism.
+- :class:`ElasticTrainer` — wraps the GSPMD trainer: ``step`` raises
+  :class:`MembershipChanged` when the detector saw churn; ``recover()``
+  checkpoints the current state, rebuilds the mesh from the surviving
+  workers' device ordinals, restores into the new shardings, and
+  recompiles the step. The Checkpointer's reshard-on-restore does the
+  heavy lifting (checkpoint.py).
+- Fault injection for tests/drills: ``inject_loss`` revokes a
+  registration the way a SIGKILL would (lease revoke ⇒ immediate
+  expiry), so the whole path is exercisable in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import jax
+
+from ptype_tpu import logs
+from ptype_tpu.errors import ClusterError
+
+log = logs.get_logger("elastic")
+
+
+class MembershipChanged(Exception):
+    """Raised by ElasticTrainer.step when the worker set changed; call
+    ``recover()`` and retry the step."""
+
+    def __init__(self, lost: list[str], joined: list[str]):
+        super().__init__(f"lost={lost} joined={joined}")
+        self.lost = lost
+        self.joined = joined
+
+
+class FailureDetector:
+    """Watch a service; track node churn (lease-expiry liveness)."""
+
+    def __init__(self, registry, service_name: str,
+                 on_change: Callable | None = None):
+        self.service_name = service_name
+        self._watch = registry.watch_service(service_name)
+        self._on_change = on_change
+        self._lock = threading.Lock()
+        self._current: dict[str, object] = {}
+        self._lost: list[str] = []
+        self._joined: list[str] = []
+        self._seeded = threading.Event()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"fd-{service_name}", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _key(node) -> str:
+        return f"{node.address}:{node.port}"
+
+    def _run(self) -> None:
+        for nodes in self._watch:
+            if self._closed.is_set():
+                break
+            new = {self._key(n): n for n in nodes}
+            with self._lock:
+                if self._seeded.is_set():
+                    lost = sorted(set(self._current) - set(new))
+                    joined = sorted(set(new) - set(self._current))
+                    self._lost.extend(lost)
+                    self._joined.extend(joined)
+                else:
+                    lost, joined = [], []
+                self._current = new
+            self._seeded.set()
+            if (lost or joined) and self._on_change is not None:
+                self._on_change(lost, joined)
+            if lost or joined:
+                log.info("membership change",
+                         kv={"service": self.service_name,
+                             "lost": lost, "joined": joined})
+
+    def wait_seeded(self, timeout: float = 5.0) -> None:
+        if not self._seeded.wait(timeout):
+            raise ClusterError(
+                f"FailureDetector: no initial snapshot for "
+                f"{self.service_name!r} within {timeout}s")
+
+    def current(self) -> list:
+        with self._lock:
+            return sorted(self._current.values(),
+                          key=lambda n: (n.process_id, n.address, n.port))
+
+    def drain_changes(self) -> tuple[list[str], list[str]]:
+        """(lost, joined) since the last drain; empties the buffers."""
+        with self._lock:
+            lost, self._lost = self._lost, []
+            joined, self._joined = self._joined, []
+        return lost, joined
+
+    @property
+    def changed(self) -> bool:
+        with self._lock:
+            return bool(self._lost or self._joined)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._watch.cancel()
+
+
+def inject_loss(registration) -> None:
+    """Fault injection: kill a member the lease way (revoke ⇒ expiry ⇒
+    watch event), the in-process stand-in for SIGKILLing its host."""
+    registration.close(revoke=True)
+
+
+class ElasticTrainer:
+    """GSPMD trainer + failure detector + checkpoint-reshard-resume."""
+
+    def __init__(self, cfg, registry, service_name: str, ckpt_dir: str,
+                 mesh_axis: str = "data", optimizer=None,
+                 rng: jax.Array | None = None):
+        from ptype_tpu.checkpoint import Checkpointer
+        from ptype_tpu.train.trainer import default_optimizer
+
+        self.cfg = cfg
+        self.mesh_axis = mesh_axis
+        self.optimizer = optimizer or default_optimizer()
+        self.detector = FailureDetector(registry, service_name)
+        self.detector.wait_seeded()
+        self.ckpt = Checkpointer(ckpt_dir)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._build(fresh=True)
+
+    # ------------------------------------------------------------ build
+
+    def _devices_from_nodes(self) -> list:
+        nodes = self.detector.current()
+        ordinals: list[int] = []
+        for n in nodes:
+            ordinals.extend(n.device_ordinals)
+        if not ordinals:
+            raise ClusterError(
+                "elastic: surviving workers advertise no devices")
+        by_id = {d.id: d for d in jax.devices()}
+        missing = [o for o in ordinals if o not in by_id]
+        if missing:
+            raise ClusterError(
+                f"elastic: registry devices {missing} not visible")
+        return [by_id[o] for o in sorted(set(ordinals))]
+
+    def _build(self, fresh: bool) -> None:
+        from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.train import trainer as tr
+
+        devices = self._devices_from_nodes()
+        self.mesh = build_mesh({self.mesh_axis: len(devices)},
+                               devices=devices)
+        self._step_fn = tr.make_train_step(self.cfg, self.mesh,
+                                           self.optimizer)
+        if fresh:
+            self.state, self.state_shardings = tr.init_state(
+                self._rng, self.cfg, self.mesh, self.optimizer)
+        else:
+            # Shardings for the NEW mesh; state restored by recover().
+            self.state_shardings = tr._state_shardings(
+                self.mesh, self.cfg, self.optimizer)
+        log.info("elastic mesh built",
+                 kv={"devices": len(devices), "fresh": fresh})
+
+    # ------------------------------------------------------------- step
+
+    def step(self, batch: dict):
+        if self.detector.changed:
+            lost, joined = self.detector.drain_changes()
+            raise MembershipChanged(lost, joined)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ptype_tpu.models import transformer as tfm
+
+        axis_sizes = {n: int(self.mesh.shape[n])
+                      for n in self.mesh.axis_names}
+        sh = NamedSharding(self.mesh, tfm.batch_spec(axis_sizes))
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+        self.state, out = self._step_fn(self.state, batch)
+        return out
+
+    def checkpoint(self) -> int:
+        step = int(self.state.step)
+        self.ckpt.save(step, self.state)
+        return step
+
+    def recover(self) -> dict:
+        """Checkpoint-restore-reshard after MembershipChanged.
+
+        The state in memory is still valid (single-controller: the
+        controller survived; what died is worker capacity), so we save
+        it, rebuild the mesh over the survivors, and restore into the
+        new shardings."""
+        saved = self.checkpoint()
+        old = self.mesh.devices.size
+        self._build(fresh=False)
+        self.state = self.ckpt.restore(
+            self.state, step=saved, shardings=self.state_shardings)
+        log.info("elastic recovery complete",
+                 kv={"step": saved, "old_devices": old,
+                     "new_devices": self.mesh.devices.size})
+        return {"restored_step": saved, "devices": self.mesh.devices.size}

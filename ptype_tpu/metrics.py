@@ -130,6 +130,47 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+# ------------------------------------------------------------- profiling
+# The reference had zap logging only (SURVEY.md §5 "Tracing/profiling:
+# Absent"); the TPU build owes JAX profiler traces (XPlane/TensorBoard)
+# with annotated steps so Store collective time is attributable.
+
+
+class trace:
+    """Context manager: capture a JAX profiler trace (XPlane) to
+    ``logdir`` — view with TensorBoard's profile plugin or xprof.
+
+    >>> with metrics.trace("/tmp/trace"):
+    ...     trainer.step(batch)
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        jax.profiler.stop_trace()
+        return False
+
+
+def annotate(name: str, **kwargs):
+    """Named region in profiler traces (host + device timeline). Use
+    around Store pushes so allreduce time is attributable:
+
+    >>> with metrics.annotate("store.push/grads"):
+    ...     store.push_tree("grads", grads)
+    """
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotation(step: int):
+    """Mark one training step in the trace (XProf groups by these)."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
 @dataclass
 class StepStats:
     """Rolling per-step throughput tracker for training loops."""

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7: "performance-
+critical kernels go to Pallas")."""
+
+from ptype_tpu.ops.flash_attention import flash_attention, make_flash_attn_fn
+
+__all__ = ["flash_attention", "make_flash_attn_fn"]

@@ -164,11 +164,14 @@ class TensorStore:
         lowering of Store.Put (north star). ``stacked``'s leading dim is
         the contribution axis (== mesh axis size); the reduced tensor is
         stored under the key's binding and returned."""
+        from ptype_tpu.metrics import annotate
+
         b = self.binding(key)
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
         wire = stacked.astype(jnp.bfloat16) if self.compress else stacked
-        reduced = collectives.all_reduce(wire, self.mesh, self.axis, op)
+        with annotate(f"store.push/{key}"):
+            reduced = collectives.all_reduce(wire, self.mesh, self.axis, op)
         if self.compress:
             reduced = reduced.astype(stacked.dtype)
         if b.spec != P():

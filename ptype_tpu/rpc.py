@@ -131,7 +131,8 @@ class _Conn:
             fut.set_exception(RPCError(f"connection to {self.node.address}:"
                                        f"{self.node.port} closed"))
             return fut
-        blob = codec.encode(args)
+        parts = codec.encode_parts(args)
+        args_len = sum(len(p) for p in parts)
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
@@ -140,12 +141,19 @@ class _Conn:
         with self._pending_lock:
             self._pending[req_id] = fut
         header = json.dumps(
-            {"id": req_id, "method": method, "args_len": len(blob)},
+            {"id": req_id, "method": method, "args_len": args_len},
             separators=(",", ":"),
         ).encode("utf-8")
         try:
             with self._send_lock:
-                self._sock.sendall(_LEN.pack(len(header)) + header + blob)
+                # One writev (native) / one sendall: the header frame and
+                # every tensor blob go out without a concatenation copy.
+                from ptype_tpu import native
+
+                if not native.send_frame(self._sock, header, parts):
+                    self._sock.sendall(
+                        _LEN.pack(len(header)) + header + b"".join(parts)
+                    )
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)

@@ -213,7 +213,7 @@ class Trainer:
                 if k in self._BATCH_KEYS}
 
     def step(self, batch: dict) -> dict:
-        from ptype_tpu.metrics import StepStats
+        from ptype_tpu.metrics import StepStats, step_annotation
 
         batch = self.shard_batch(batch)
         train_step = self._step_for(batch)
@@ -225,7 +225,8 @@ class Trainer:
                 peak_tflops=self._peak,
             )
             self._stats.start()
-        self.state, out = train_step(self.state, batch)
+        with step_annotation(int(self.state.step)):
+            self.state, out = train_step(self.state, batch)
         jax.block_until_ready(out["loss"])
         self._stats.step(batch["tokens"].size)
         return {
