@@ -28,18 +28,8 @@ from ptype_tpu.train.trainer import Trainer
 MFU_TARGET = 0.30  # BASELINE.json north_star: ">=30% MFU on v5e-8"
 
 
-def main() -> None:
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
+def _run(cfg, devices, per_chip_batch, seq, steps, warmup):
     n_chips = len(devices)
-
-    if on_tpu:
-        cfg = tfm.preset("optimus-125m")
-        per_chip_batch, seq, steps, warmup = 16, 1024, 20, 3
-    else:
-        cfg = tfm.preset("tiny")
-        per_chip_batch, seq, steps, warmup = 4, 128, 5, 1
-
     mesh = build_mesh({"data": n_chips}, devices=devices)
     trainer = Trainer(cfg, mesh)
     batch = per_chip_batch * n_chips
@@ -54,12 +44,42 @@ def main() -> None:
         out = trainer.step(next(stream))
         tokens += batch * seq
     dt = time.perf_counter() - t0
+    return out, tokens, dt
+
+
+def main() -> None:
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n_chips = len(devices)
+
+    if on_tpu:
+        cfg = tfm.preset("optimus-125m")
+        plans = [(16, 1024, 20, 3), (8, 1024, 20, 3)]
+    else:
+        cfg = tfm.preset("tiny")
+        plans = [(4, 128, 5, 1)]
+
+    # The bench runs unattended: fall back to the smaller batch (and
+    # remat as a last resort) rather than dying on an HBM OOM.
+    last_err = None
+    for i, (pcb, seq, steps, warmup) in enumerate(plans):
+        try:
+            run_cfg = cfg if i == 0 else tfm.preset(
+                "optimus-125m", remat=True) if on_tpu else cfg
+            out, tokens, dt = _run(run_cfg, devices, pcb, seq, steps,
+                                   warmup)
+            batch_used, seq_used = pcb * n_chips, seq
+            break
+        except Exception as e:  # noqa: BLE001 — report, try next plan
+            last_err = e
+    else:
+        raise SystemExit(f"bench: all plans failed: {last_err}")
 
     tps_chip = tokens / dt / n_chips
     from ptype_tpu.metrics import device_peak_tflops, mfu as mfu_of
 
     achieved_mfu = mfu_of(
-        tokens / dt, tfm.flops_per_token(cfg, seq), n_chips,
+        tokens / dt, tfm.flops_per_token(cfg, seq_used), n_chips,
         device_peak_tflops(devices[0]),
     )
     print(json.dumps({
@@ -70,6 +90,8 @@ def main() -> None:
         "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
         "mfu": round(achieved_mfu, 4),
         "n_chips": n_chips,
+        "batch": batch_used,
+        "seq": seq_used,
         "final_loss": out["loss"],
     }))
 

@@ -1,0 +1,199 @@
+"""Autoregressive generation — static-shape KV-cache decode.
+
+The reference served request/reply actors (calculator.go); the model
+framework's equivalent of "serve a request" is generate-from-prompt.
+TPU-first decisions:
+
+- **Static shapes everywhere**: the KV cache is allocated at
+  ``max_seq`` up front; the decode loop is a ``lax.scan`` over step
+  index with ``dynamic_update_slice`` writes — one compiled program
+  regardless of prompt/output length, no retracing.
+- **Prefill + decode split**: prefill runs the full-sequence forward
+  (MXU-efficient batched matmuls) while collecting per-layer K/V;
+  decode steps attend against the cache with a position mask.
+- Sampling: greedy or temperature; RNG is explicit (fold_in per step).
+
+Works for any dense ``TransformerConfig`` (MoE decode falls back to the
+same path — experts run per token). GQA caches only ``kv_heads`` heads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ptype_tpu.models import transformer as tfm
+
+
+@dataclass(frozen=True)
+class KVCache:
+    """Stacked per-layer KV: (L, B, Smax, Kh, Dh)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    def tree_flatten(self):
+        return (self.k, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    KVCache, KVCache.tree_flatten, KVCache.tree_unflatten
+)
+
+
+def init_cache(cfg: tfm.TransformerConfig, batch: int,
+               max_seq: int | None = None) -> KVCache:
+    S = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, S, cfg.kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
+    """q: (B, 1, H, Dh); caches: (B, Smax, Kh, Dh); attend to
+    positions < pos_limit."""
+    B, _, H, Dh = q.shape
+    Kh = k_cache.shape[2]
+    if Kh != H:
+        k_cache = jnp.repeat(k_cache, H // Kh, axis=2)
+        v_cache = jnp.repeat(v_cache, H // Kh, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(Dh))
+    mask = jnp.arange(k_cache.shape[1]) < pos_limit  # (Smax,)
+    scores = jnp.where(mask[None, None, None, :], scores,
+                       jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def _rope_at(cfg, positions):
+    """(sin, cos) for explicit positions; positions: (S,) int."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def _head_logits(params, x_last, cfg):
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    return jnp.einsum("bd,dv->bv", x_last.astype(jnp.float32),
+                      head.astype(jnp.float32))
+
+
+def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
+            cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """Full-sequence forward, filling cache[:, :, :S]. Returns
+    (last-position logits (B, V), cache). Block math is the shared
+    transformer pieces (qkv_proj/attn_residual/mlp_residual), so
+    training and generation can never diverge."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    sin, cos = tfm.rope_tables(cfg, S)
+
+    def body(x, inputs):
+        layer, kc, vc = inputs
+        q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+        o = tfm._attention(q, k, v, cfg)
+        x = tfm.attn_residual(x, o, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg)
+        kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = lax.scan(body, x,
+                             (params["blocks"], cache.k, cache.v))
+    x = tfm.rms_norm(x, params["final_norm"])
+    return _head_logits(params, x[:, -1], cfg), KVCache(kcs, vcs)
+
+
+def decode_step(params: dict, token: jax.Array, pos: jax.Array,
+                cfg: tfm.TransformerConfig,
+                cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """One decode step. token: (B,) int32 at position ``pos`` (scalar).
+    Returns (logits (B, V), updated cache). MoE capacity is pinned to
+    the step's token count (B) so no routed token can drop at decode."""
+    B = token.shape[0]
+    x = params["embed"][token][:, None, :].astype(cfg.dtype)  # (B, 1, D)
+    sin, cos = _rope_at(cfg, pos[None])
+
+    def body(x, inputs):
+        layer, kc, vc = inputs  # kc/vc: (B, Smax, Kh, Dh)
+        q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        o = _cached_attention(q, kc, vc, pos + 1, cfg)
+        x = tfm.attn_residual(x, o, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = lax.scan(body, x,
+                             (params["blocks"], cache.k, cache.v))
+    x = tfm.rms_norm(x, params["final_norm"])
+    return _head_logits(params, x[:, 0], cfg), KVCache(kcs, vcs)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
+                       max_new_tokens: int, temperature: float):
+    """One jitted prefill+decode program per (cfg, shapes, temperature)
+    — repeated calls (the serving hot path) reuse the compilation."""
+
+    def run(params, prompt, rng):
+        cache = init_cache(cfg, B)
+        logits, cache = prefill(params, prompt, cfg, cache)
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits / jnp.float32(temperature), axis=-1
+            ).astype(jnp.int32)
+
+        first = sample(logits, jax.random.fold_in(rng, 0))
+
+        def step(carry, i):
+            token, cache = carry
+            logits, cache = decode_step(params, token, S + i, cfg, cache)
+            nxt = sample(logits, jax.random.fold_in(rng, i + 1))
+            return (nxt, cache), token
+
+        (_, _), toks = lax.scan(
+            step, (first, cache), jnp.arange(max_new_tokens))
+        return toks.T  # (B, max_new_tokens): ys are the emitted tokens
+
+    return jax.jit(run)
+
+
+def generate(params: dict, cfg: tfm.TransformerConfig,
+             prompt: jax.Array, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: jax.Array | None = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
+
+    One compiled program (cached per cfg/shape/temperature): prefill
+    then a ``lax.scan`` decode loop. ``temperature == 0`` → greedy;
+    else softmax sampling.
+    """
+    B, S = prompt.shape
+    total = S + max_new_tokens
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"generate: prompt {S} + new {max_new_tokens} exceeds "
+            f"max_seq {cfg.max_seq}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    run = _compiled_generate(cfg, B, S, int(max_new_tokens),
+                             float(temperature))
+    return run(params, prompt, rng)

@@ -57,6 +57,17 @@ class TransformerConfig:
     #: "xla" (fused by the compiler) or "ring" (shard_map ring attention
     #: over the "seq" mesh axis — see parallel/ring_attention.py).
     attn_impl: str = "xla"
+    #: Mixture-of-experts: number of experts per MLP (0 = dense). The
+    #: expert dim shards over the "expert" mesh axis (EP — the
+    #: all_to_all family, SURVEY.md §2 parallelism table).
+    n_experts: int = 0
+    #: Experts routed per token (top-k, GShard-style).
+    expert_top_k: int = 2
+    #: Expert capacity = ceil(top_k · tokens/expert · this factor);
+    #: overflow tokens fall back to the residual stream (dropped).
+    capacity_factor: float = 1.25
+    #: Coefficient of the router load-balancing aux loss.
+    moe_aux_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -90,6 +101,15 @@ PRESETS: dict[str, TransformerConfig] = {
         n_kv_heads=8, d_ff=14336, max_seq=8192, rope_theta=500000.0,
         tie_embeddings=False, remat=True,
     ),
+    # Mixture-of-experts variant of the optimus config — 8 experts,
+    # top-2 routing; the EP baseline (expert dim over the "expert" axis).
+    "optimus-moe": TransformerConfig(
+        d_ff=1024, n_experts=8, expert_top_k=2,
+    ),
+    "tiny-moe": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=64,
+        max_seq=128, n_experts=4, expert_top_k=2,
+    ),
 }
 
 
@@ -118,6 +138,22 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         return (jax.random.normal(key, shape, pd) * scale).astype(pd)
 
     resid_scale = 0.02 / jnp.sqrt(2.0 * L)
+    E = cfg.n_experts
+    if E:
+        mlp = {
+            "mlp_norm": jnp.ones((L, D), pd),
+            "router": norm(jax.random.split(keys[5])[0], (L, D, E), 0.02),
+            "w_gate": norm(keys[5], (L, E, D, F), 0.02),
+            "w_up": norm(keys[6], (L, E, D, F), 0.02),
+            "w_down": norm(keys[7], (L, E, F, D), resid_scale),
+        }
+    else:
+        mlp = {
+            "mlp_norm": jnp.ones((L, D), pd),
+            "w_gate": norm(keys[5], (L, D, F), 0.02),
+            "w_up": norm(keys[6], (L, D, F), 0.02),
+            "w_down": norm(keys[7], (L, F, D), resid_scale),
+        }
     params = {
         "embed": norm(keys[0], (V, D), 0.02),
         "blocks": {
@@ -126,10 +162,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             "wk": norm(keys[2], (L, D, K, Dh), 0.02),
             "wv": norm(keys[3], (L, D, K, Dh), 0.02),
             "wo": norm(keys[4], (L, H, Dh, D), resid_scale),
-            "mlp_norm": jnp.ones((L, D), pd),
-            "w_gate": norm(keys[5], (L, D, F), 0.02),
-            "w_up": norm(keys[6], (L, D, F), 0.02),
-            "w_down": norm(keys[7], (L, F, D), resid_scale),
+            **mlp,
         },
         "final_norm": jnp.ones((D,), pd),
     }
@@ -147,10 +180,15 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int,
     """Fwd+bwd training FLOPs per token (PaLM appendix B convention):
     ``6·N_matmul + 12·L·D·S`` — the MFU denominator."""
     if n_params is None:
-        # matmul params only (norms excluded — negligible anyway)
+        # ACTIVE matmul params only (norms excluded — negligible; for
+        # MoE, the top-k routed experts count, not the full bank).
         L, D = cfg.n_layers, cfg.d_model
         H, K, Dh, F = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_ff
-        per_layer = D * Dh * (H + 2 * K) + H * Dh * D + 3 * D * F
+        if cfg.n_experts:
+            mlp = cfg.expert_top_k * 3 * D * F + D * cfg.n_experts
+        else:
+            mlp = 3 * D * F
+        per_layer = D * Dh * (H + 2 * K) + H * Dh * D + mlp
         n_params = cfg.vocab_size * D + L * per_layer
         if not cfg.tie_embeddings:
             n_params += D * cfg.vocab_size
@@ -203,31 +241,113 @@ def _attention(q, k, v, cfg: TransformerConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer, sin, cos, cfg: TransformerConfig, attn_fn):
-    """One transformer block; x: (B, S, D) in compute dtype."""
+def _moe_mlp(h, layer, cfg: TransformerConfig, capacity: int | None = None):
+    """GShard-style top-k MoE MLP. h: (B, S, D) → (y, aux_loss).
+
+    Einsum dispatch with static expert capacity: tokens scatter into an
+    (E, C, D) buffer, the expert SwiGLUs run as one batched einsum over
+    the stacked expert weights (expert dim shardable over the "expert"
+    mesh axis — GSPMD lowers the dispatch to all_to_all), and outputs
+    gather back weighted by the router. Overflow past capacity falls
+    back to the residual stream. ``capacity`` overrides the
+    capacity_factor formula — decode passes the exact per-step token
+    count so single-token steps never drop (generate.py).
+    """
+    B, S, D = h.shape
+    E, topk = cfg.n_experts, cfg.expert_top_k
+    dt = cfg.dtype
+    T = B * S
+    x = h.reshape(T, D)
+
+    logits = jnp.einsum(
+        "td,de->te", x.astype(jnp.float32),
+        layer["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    gate_w, gate_e = jax.lax.top_k(probs, topk)  # (T, k)
+    gate_w = gate_w / jnp.maximum(
+        jnp.sum(gate_w, axis=-1, keepdims=True), 1e-9)
+
+    # Load-balancing aux (Switch eq. 4): E · Σ_e frac_tokens · frac_prob.
+    me = jnp.mean(probs, axis=0)
+    dispatched = jnp.sum(jax.nn.one_hot(gate_e, E, dtype=jnp.float32),
+                        axis=1)  # (T, E)
+    ce = jnp.mean(dispatched, axis=0) / topk
+    aux = E * jnp.sum(me * ce)
+
+    import math as _math
+
+    C = (capacity if capacity is not None
+         else max(_math.ceil(topk * T / E * cfg.capacity_factor), 1))
+    flat_e = gate_e.reshape(-1)  # (T·k,)
+    # Position within each expert, token-priority order.
+    counts = jnp.cumsum(jax.nn.one_hot(flat_e, E, dtype=jnp.int32), axis=0)
+    pos = counts[jnp.arange(T * topk), flat_e] - 1
+    keep = pos < C
+    slot = jnp.clip(pos, 0, C - 1)
+    tok = jnp.arange(T * topk) // topk
+
+    contrib = x[tok] * keep[:, None].astype(x.dtype)
+    X = jnp.zeros((E, C, D), dt).at[flat_e, slot].add(contrib)
+
+    g = jnp.einsum("ecd,edf->ecf", X, layer["w_gate"].astype(dt))
+    u = jnp.einsum("ecd,edf->ecf", X, layer["w_up"].astype(dt))
+    Y = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u,
+                   layer["w_down"].astype(dt))
+
+    y_tok = Y[flat_e, slot] * keep[:, None].astype(dt)
+    y_tok = y_tok * gate_w.reshape(-1)[:, None].astype(dt)
+    y = jnp.sum(y_tok.reshape(T, topk, D), axis=1)
+    return y.reshape(B, S, D), aux
+
+
+def qkv_proj(x, layer, cfg: TransformerConfig, sin, cos):
+    """Pre-norm + Q/K/V projections + RoPE. x: (B, S, D) → three
+    (B, S, H|K, Dh). Shared by training forward and the KV-cache
+    prefill/decode paths (models/generate.py) — the block math lives
+    here once."""
     dt = cfg.dtype
     h = rms_norm(x, layer["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
-    q = apply_rope(q, sin, cos)
-    k = apply_rope(k, sin, cos)
-    o = attn_fn(q, k, v, cfg)
-    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+    return apply_rope(q, sin, cos), apply_rope(k, sin, cos), v
 
+
+def attn_residual(x, o, layer, cfg: TransformerConfig):
+    """Output projection + residual add. o: (B, S, H, Dh)."""
+    return x + jnp.einsum("bshk,hkd->bsd", o,
+                          layer["wo"].astype(cfg.dtype))
+
+
+def mlp_residual(x, layer, cfg: TransformerConfig,
+                 moe_capacity: int | None = None):
+    """Pre-norm MLP (dense SwiGLU or MoE) + residual. → (x, aux)."""
+    dt = cfg.dtype
     h = rms_norm(x, layer["mlp_norm"])
+    if cfg.n_experts:
+        y, aux = _moe_mlp(h, layer, cfg, capacity=moe_capacity)
+        return x + y, aux
     gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
     up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
     x = x + jnp.einsum(
         "bsf,fd->bsd", jax.nn.silu(gate) * up, layer["w_down"].astype(dt)
     )
-    return x
+    return x, jnp.float32(0.0)
 
 
-def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            attn_fn=None) -> jax.Array:
-    """Logits (B, S, V) in f32. ``attn_fn`` overrides the attention
-    implementation (ring attention injects itself here)."""
+def _block(x, layer, sin, cos, cfg: TransformerConfig, attn_fn):
+    """One transformer block; x: (B, S, D) in compute dtype.
+    Returns (x, moe_aux) — aux is 0.0 for dense MLPs."""
+    q, k, v = qkv_proj(x, layer, cfg, sin, cos)
+    o = attn_fn(q, k, v, cfg)
+    x = attn_residual(x, o, layer, cfg)
+    return mlp_residual(x, layer, cfg)
+
+
+def forward_with_aux(params: dict, tokens: jax.Array,
+                     cfg: TransformerConfig, attn_fn=None):
+    """(logits (B,S,V) f32, aux) — aux is the summed MoE router
+    load-balancing loss (0.0 for dense configs)."""
     attn_fn = attn_fn or _attention
     B, S = tokens.shape
     dt = cfg.dtype
@@ -235,19 +355,28 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     sin, cos = rope_tables(cfg, S)
 
     def body(x, layer):
-        return _block(x, layer, sin, cos, cfg, attn_fn), None
+        x, aux = _block(x, layer, sin, cos, cfg, attn_fn)
+        return x, aux
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, _ = lax.scan(body, x, params["blocks"])
+    x, auxs = lax.scan(body, x, params["blocks"])
 
     x = rms_norm(x, params["final_norm"])
     if cfg.tie_embeddings:
         head = params["embed"].T
     else:
         head = params["lm_head"]
-    return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
-                      head.astype(jnp.float32))
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return logits, jnp.sum(auxs)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """Logits (B, S, V) in f32. ``attn_fn`` overrides the attention
+    implementation (ring attention injects itself here)."""
+    return forward_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
 def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
@@ -267,10 +396,14 @@ def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
 
 def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
             attn_fn=None) -> jax.Array:
-    """Mean next-token cross-entropy. ``batch``: tokens (B,S) int32,
-    targets (B,S) int32, optional loss_mask (B,S)."""
-    return nll_from_logits(forward(params, batch["tokens"], cfg, attn_fn),
-                           batch)
+    """Mean next-token cross-entropy (+ MoE router aux when configured).
+    ``batch``: tokens (B,S) int32, targets (B,S) int32, optional
+    loss_mask (B,S)."""
+    logits, aux = forward_with_aux(params, batch["tokens"], cfg, attn_fn)
+    loss = nll_from_logits(logits, batch)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_coef * aux
+    return loss
 
 
 # ---------------------------------------------------------------- sharding
@@ -296,9 +429,25 @@ def param_specs(cfg: TransformerConfig,
     Block specs carry a leading None for the scan/layer dim.
     """
     D, F, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
-    H, K = cfg.n_heads, cfg.kv_heads
+    H, K, E = cfg.n_heads, cfg.kv_heads, cfg.n_experts
     fsdp = partial(_maybe, "fsdp", axis_sizes=axis_sizes)
     tp = partial(_maybe, "model", axis_sizes=axis_sizes)
+    ep = partial(_maybe, "expert", axis_sizes=axis_sizes)
+    if E:
+        mlp_specs = {
+            "mlp_norm": P(None, None),
+            "router": P(None, fsdp(D), None),
+            "w_gate": P(None, ep(E), fsdp(D), tp(F)),
+            "w_up": P(None, ep(E), fsdp(D), tp(F)),
+            "w_down": P(None, ep(E), tp(F), fsdp(D)),
+        }
+    else:
+        mlp_specs = {
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fsdp(D), tp(F)),
+            "w_up": P(None, fsdp(D), tp(F)),
+            "w_down": P(None, tp(F), fsdp(D)),
+        }
     specs = {
         "embed": P(tp(V), fsdp(D)),
         "blocks": {
@@ -307,10 +456,7 @@ def param_specs(cfg: TransformerConfig,
             "wk": P(None, fsdp(D), tp(K), None),
             "wv": P(None, fsdp(D), tp(K), None),
             "wo": P(None, tp(H), None, fsdp(D)),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, fsdp(D), tp(F)),
-            "w_up": P(None, fsdp(D), tp(F)),
-            "w_down": P(None, tp(F), fsdp(D)),
+            **mlp_specs,
         },
         "final_norm": P(None),
     }

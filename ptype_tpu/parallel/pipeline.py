@@ -159,7 +159,8 @@ def transformer_pipeline_forward(params: dict, tokens: jax.Array, cfg,
 
     def stage_fn(blocks, x_mb):
         def body(x, layer):
-            return tfm._block(x, layer, sin, cos, cfg, tfm._attention), None
+            x, _aux = tfm._block(x, layer, sin, cos, cfg, tfm._attention)
+            return x, None
 
         if cfg.remat:
             body = jax.checkpoint(body)

@@ -1,0 +1,75 @@
+"""Model serving over the actor RPC plane.
+
+The reference's serving story was "register a handler object, join,
+serve" (example/calculator/server.go:15-41). This module packages the
+generation path the same way: a :class:`GeneratorActor` whose
+``Generate`` endpoint runs the compiled KV-cache decode loop, dropping
+into an ActorServer next to any other handler. Prompts/outputs ride the
+tensor codec as device buffers; callers use the balanced client
+(``cluster.new_client("llm").call("Generator.Generate", toks, 16)``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ptype_tpu import logs
+from ptype_tpu.models import generate as gen
+from ptype_tpu.models import transformer as tfm
+
+log = logs.get_logger("serve")
+
+
+class GeneratorActor:
+    """Generation endpoint over a params pytree.
+
+    Serializes requests (one decode loop at a time per actor — the
+    single-chip serving model; scale out by registering more actors
+    under the same service and letting the balancer spread callers).
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, params=None,
+                 rng: jax.Array | None = None):
+        self.cfg = cfg
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.params = (params if params is not None
+                       else jax.jit(lambda r: tfm.init_params(r, cfg))(rng))
+        self._lock = threading.Lock()
+        self._calls = 0
+        self._forward = jax.jit(
+            lambda p, t: tfm.forward(p, t, self.cfg))
+
+    def Generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, seed: int = 0):
+        """prompt: (B, S) int32 tokens → (B, max_new_tokens) int32."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        with self._lock:
+            self._calls += 1
+            out = gen.generate(
+                self.params, self.cfg, prompt, int(max_new_tokens),
+                float(temperature), jax.random.PRNGKey(int(seed)),
+            )
+        return out
+
+    def Logits(self, tokens):
+        """Full-sequence logits (B, S, V) — the eval endpoint."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        with self._lock:
+            return self._forward(self.params, tokens)
+
+    def Info(self) -> dict:
+        return {
+            "n_params": tfm.count_params(self.params),
+            "d_model": self.cfg.d_model,
+            "n_layers": self.cfg.n_layers,
+            "vocab_size": self.cfg.vocab_size,
+            "max_seq": self.cfg.max_seq,
+            "calls": self._calls,
+        }
