@@ -82,6 +82,18 @@ def main() -> None:
         tokens / dt, tfm.flops_per_token(cfg, seq_used), n_chips,
         device_peak_tflops(devices[0]),
     )
+
+    # Second BASELINE metric: Store push/pull == allreduce bandwidth.
+    store_gbps = None
+    if n_chips > 1:
+        from ptype_tpu.parallel.collectives import measure_allreduce_gbps
+
+        try:
+            store_gbps = round(measure_allreduce_gbps(
+                build_mesh({"data": n_chips}, devices=devices),
+                mbytes=64 if on_tpu else 4), 2)
+        except Exception:  # noqa: BLE001 — secondary metric, best-effort
+            pass
     print(json.dumps({
         "metric": "optimus-125M tokens/sec/chip"
         if on_tpu else "optimus-tiny tokens/sec/chip (cpu smoke)",
@@ -92,6 +104,7 @@ def main() -> None:
         "n_chips": n_chips,
         "batch": batch_used,
         "seq": seq_used,
+        "store_allreduce_gbps": store_gbps,
         "final_loss": out["loss"],
     }))
 

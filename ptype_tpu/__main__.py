@@ -1,0 +1,131 @@
+"""Operator CLI: ``python -m ptype_tpu <command>``.
+
+The reference shipped bare binaries selected by ``$CONFIG``
+(server.go:22); this adds the thin launcher the framework's own
+operations need. Commands:
+
+- ``info``   — devices, mesh axes from config (if any), native wire
+- ``join``   — join the cluster described by $CONFIG and idle (a seed
+               or bare member; ^C to leave)
+- ``serve``  — join + serve a GeneratorActor ($PRESET, default tiny)
+- ``train``  — join + train ($PRESET/$STEPS/$BATCH/$SEQ/$MODE as in
+               examples/optimus/trainer.py)
+- ``bench``  — the headline one-line JSON benchmark
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+
+def _info() -> None:
+    import jax
+
+    from ptype_tpu import native
+
+    devices = jax.devices()
+    out = {
+        "version": __import__("ptype_tpu").__version__,
+        "platform": devices[0].platform,
+        "devices": len(devices),
+        "device_kind": getattr(devices[0], "device_kind", ""),
+        "native_wire": native.available(),
+    }
+    import os
+
+    if os.environ.get("CONFIG"):
+        from ptype_tpu import config_from_env
+
+        cfg = config_from_env()
+        out["service"] = cfg.service_name
+        out["mesh_axes"] = cfg.platform.mesh_axes
+    print(json.dumps(out, indent=2))
+
+
+def _join() -> None:
+    from ptype_tpu import config_from_env, join
+
+    cluster = join(config_from_env())
+    print(f"joined as {cluster.cfg.node_name} "
+          f"(member {cluster.member.id}); ^C to leave", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.close()
+
+
+def _serve() -> None:
+    import os
+
+    from ptype_tpu import ActorServer, config_from_env, join
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.serve import GeneratorActor
+
+    cfg = config_from_env()
+    model_cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
+    server = ActorServer(port=cfg.port)
+    server.register(GeneratorActor(model_cfg), "Generator")
+    server.serve()
+    cfg.port = server.port
+    cluster = join(cfg)
+    print(f"serving Generator.{{Generate,Logits,Info}} on :{server.port}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.close()
+        server.close()
+
+
+def _train() -> None:
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "optimus_trainer",
+        os.path.join(os.path.dirname(__file__), "..", "examples",
+                     "optimus", "trainer.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+def _bench() -> None:
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+COMMANDS = {
+    "info": _info,
+    "join": _join,
+    "serve": _serve,
+    "train": _train,
+    "bench": _bench,
+}
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in COMMANDS:
+        print(f"usage: python -m ptype_tpu {{{'|'.join(COMMANDS)}}}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    COMMANDS[sys.argv[1]]()
+
+
+if __name__ == "__main__":
+    main()

@@ -72,16 +72,6 @@ def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
-def _rope_at(cfg, positions):
-    """(sin, cos) for explicit positions; positions: (S,) int."""
-    half = cfg.head_dim // 2
-    inv_freq = 1.0 / (
-        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
-    )
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
-    return jnp.sin(angles), jnp.cos(angles)
-
-
 def _head_logits(params, x_last, cfg):
     head = (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"])
@@ -123,7 +113,7 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
     the step's token count (B) so no routed token can drop at decode."""
     B = token.shape[0]
     x = params["embed"][token][:, None, :].astype(cfg.dtype)  # (B, 1, D)
-    sin, cos = _rope_at(cfg, pos[None])
+    sin, cos = tfm.rope_tables(cfg, positions=jnp.asarray(pos)[None])
 
     def body(x, inputs):
         layer, kc, vc = inputs  # kc/vc: (B, Smax, Kh, Dh)

@@ -132,7 +132,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     L, D, H, K = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.kv_heads
     Dh, F, V = cfg.head_dim, cfg.d_ff, cfg.vocab_size
     pd = cfg.param_dtype
-    keys = jax.random.split(rng, 8)
+    keys = jax.random.split(rng, 9)
 
     def norm(key, shape, scale):
         return (jax.random.normal(key, shape, pd) * scale).astype(pd)
@@ -142,7 +142,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     if E:
         mlp = {
             "mlp_norm": jnp.ones((L, D), pd),
-            "router": norm(jax.random.split(keys[5])[0], (L, D, E), 0.02),
+            "router": norm(keys[8], (L, D, E), 0.02),
             "w_gate": norm(keys[5], (L, E, D, F), 0.02),
             "w_up": norm(keys[6], (L, E, D, F), 0.02),
             "w_down": norm(keys[7], (L, E, F, D), resid_scale),
@@ -204,14 +204,19 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def rope_tables(cfg: TransformerConfig, seq_len: int):
-    """(sin, cos) tables, shape (S, head_dim/2), f32."""
+def rope_tables(cfg: TransformerConfig, seq_len: int | None = None,
+                positions: jax.Array | None = None):
+    """(sin, cos) tables, shape (S, head_dim/2), f32. Pass either a
+    ``seq_len`` (positions 0..S-1, the training path) or explicit
+    ``positions`` (the decode path, models/generate.py) — one formula
+    for both, so RoPE changes can never diverge between them."""
     half = cfg.head_dim // 2
     inv_freq = 1.0 / (
         cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
-    pos = jnp.arange(seq_len, dtype=jnp.float32)
-    angles = jnp.outer(pos, inv_freq)  # (S, half)
+    if positions is None:
+        positions = jnp.arange(seq_len)
+    angles = jnp.outer(positions.astype(jnp.float32), inv_freq)
     return jnp.sin(angles), jnp.cos(angles)
 
 

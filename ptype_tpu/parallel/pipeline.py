@@ -150,6 +150,14 @@ def transformer_pipeline_forward(params: dict, tokens: jax.Array, cfg,
     """
     from ptype_tpu.models import transformer as tfm
 
+    if cfg.n_experts:
+        # The stage ring carries activations only; threading the MoE
+        # router aux loss through it is not implemented — refusing beats
+        # silently optimizing a different objective than the dense path.
+        raise ClusterError(
+            "pipeline parallelism does not support MoE configs yet "
+            "(router aux loss would be dropped); use dp/fsdp/tp/ep"
+        )
     S = int(mesh.shape[axis])
     B, T = tokens.shape
     dt = cfg.dtype

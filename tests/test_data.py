@@ -1,0 +1,67 @@
+"""Data pipeline: synthetic streams and the memory-mapped corpus loader."""
+
+import numpy as np
+
+from ptype_tpu.train.data import (
+    TokenFileDataset,
+    synthetic_batches,
+    write_token_file,
+)
+
+
+def test_synthetic_reproducible():
+    a = next(synthetic_batches(100, 2, 8, seed=3))
+    b = next(synthetic_batches(100, 2, 8, seed=3))
+    np.testing.assert_array_equal(np.asarray(a["tokens"]),
+                                  np.asarray(b["tokens"]))
+    np.testing.assert_array_equal(np.asarray(a["tokens"][:, 1:]),
+                                  np.asarray(a["targets"][:, :-1]))
+
+
+def test_token_file_roundtrip(tmp_path):
+    corpus = np.arange(1000, dtype=np.uint16) % 500
+    path = str(tmp_path / "corpus.bin")
+    write_token_file(path, corpus)
+    ds = TokenFileDataset(path)
+    assert ds.n_tokens == 1000
+
+    it = ds.batches(batch=4, seq=16, seed=1)
+    b = next(it)
+    assert b["tokens"].shape == (4, 16)
+    assert b["tokens"].dtype == np.int32
+    # targets are the next-token shift of the same window.
+    np.testing.assert_array_equal(np.asarray(b["tokens"][:, 1:]),
+                                  np.asarray(b["targets"][:, :-1]))
+    # Window contents actually come from the corpus (consecutive runs).
+    row = np.asarray(b["tokens"][0])
+    diffs = np.diff(row) % 500
+    assert np.all((diffs == 1) | (row[1:] == 0))
+
+
+def test_token_file_trains(tmp_path):
+    """End to end: corpus file → prefetched batches → train step."""
+    import jax
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(0)
+    write_token_file(str(tmp_path / "c.bin"),
+                     rng.integers(0, 256, 5000).astype(np.uint16))
+    ds = TokenFileDataset(str(tmp_path / "c.bin"))
+    trainer = Trainer(tfm.preset("tiny"), build_mesh({"data": 2}))
+    it = ds.batches(batch=4, seq=32)
+    out = trainer.step(next(it))
+    assert np.isfinite(out["loss"])
+
+
+def test_token_file_too_small(tmp_path):
+    write_token_file(str(tmp_path / "c.bin"),
+                     np.zeros(10, dtype=np.uint16))
+    ds = TokenFileDataset(str(tmp_path / "c.bin"))
+    try:
+        next(ds.batches(batch=1, seq=64))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

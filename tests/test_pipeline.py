@@ -122,3 +122,14 @@ def test_pipeline_train_step():
     assert int(state.step) == 3
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]  # it learns the (repeated) batch
+
+
+def test_pipeline_refuses_moe():
+    from ptype_tpu.errors import ClusterError
+
+    mesh = build_mesh({"stage": 2})
+    cfg = tfm.preset("tiny-moe")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(ClusterError, match="MoE"):
+        transformer_pipeline_forward(params, toks, cfg, mesh, 2)
