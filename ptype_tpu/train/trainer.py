@@ -42,15 +42,37 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _decay_mask(params) -> Any:
+    """True for leaves that should receive weight decay: matmul weights
+    only — norm scales and biases (ndim ≤ 1) are exempt, the standard
+    AdamW recipe. Note block leaves carry a leading layer dim, so norm
+    scales there are ndim == 2; they are exempted by name."""
+
+    def mask_leaf(path, leaf):
+        name = ""
+        for p in path:
+            if hasattr(p, "key"):
+                name = str(p.key)
+        if "norm" in name:
+            return False
+        return jnp.ndim(leaf) > 1
+
+    return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
-                      warmup: int = 100, clip: float = 1.0):
-    """AdamW + cosine schedule + global-norm clip — the standard recipe."""
+                      warmup: int = 100, decay_steps: int = 100_000,
+                      clip: float = 1.0):
+    """AdamW + cosine schedule + global-norm clip — the standard recipe.
+    Weight decay applies to matmul weights only (mask exempts norm
+    scales), matching common practice."""
     sched = optax.warmup_cosine_decay_schedule(
-        0.0, lr, warmup, decay_steps=100_000, end_value=lr * 0.1
+        0.0, lr, warmup, decay_steps=decay_steps, end_value=lr * 0.1
     )
     return optax.chain(
         optax.clip_by_global_norm(clip),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mask=_decay_mask),
     )
 
 
@@ -119,13 +141,17 @@ def _init_impl(rng, cfg, optimizer):
 def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     optimizer=None, attn_fn: Callable | None = None,
                     seq_axis: bool = False,
-                    batch_keys: tuple[str, ...] = ("tokens", "targets")):
+                    batch_keys: tuple[str, ...] = ("tokens", "targets"),
+                    grad_accum: int = 1):
     """Compile the train step: (state, batch) → (state, metrics).
 
     State buffers are donated (in-place update, no HBM copy). Batch comes
     in sharded over the data-like axes; grads reduce over them via the
     sharding-implied allreduce. ``batch_keys`` fixes the batch signature
     (add "loss_mask" for masked training — every key shards the same way).
+    ``grad_accum > 1`` splits the batch into that many microbatches and
+    averages their grads in a ``lax.scan`` before one optimizer step —
+    big effective batches on bounded activation memory.
     """
     optimizer = optimizer or default_optimizer()
     axis_sizes = {n: int(mesh.shape[n]) for n in mesh.axis_names}
@@ -134,10 +160,32 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     batch_shardings = {k: batch_sh for k in batch_keys}
     repl = NamedSharding(mesh, P())
 
-    def step(state: TrainState, batch: dict):
-        loss, grads = jax.value_and_grad(tfm.loss_fn)(
-            state.params, batch, cfg, attn_fn
+    def grads_of(params, batch):
+        if grad_accum == 1:
+            return jax.value_and_grad(tfm.loss_fn)(
+                params, batch, cfg, attn_fn)
+        split = jax.tree.map(
+            lambda x: x.reshape(grad_accum, x.shape[0] // grad_accum,
+                                *x.shape[1:]),
+            batch,
         )
+
+        def micro(carry, mb):
+            loss_sum, grads_sum = carry
+            loss, grads = jax.value_and_grad(tfm.loss_fn)(
+                params, mb, cfg, attn_fn)
+            return (loss_sum + loss,
+                    jax.tree.map(jnp.add, grads_sum, grads)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            micro, (jnp.float32(0.0), zeros), split)
+        inv = 1.0 / grad_accum
+        return loss_sum * inv, jax.tree.map(
+            lambda g: g * inv, grads_sum)
+
+    def step(state: TrainState, batch: dict):
+        loss, grads = grads_of(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

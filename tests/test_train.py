@@ -111,3 +111,50 @@ def test_synthetic_batches_reproducible(tiny):
     np.testing.assert_array_equal(
         a["tokens"][:, 1:], a["targets"][:, :-1]
     )
+
+
+def test_grad_accum_matches_full_batch(tiny):
+    """grad_accum=2 over batch 8 == one step on batch 8 (mean loss &
+    identical update for linear-in-grads optimizers)."""
+    import optax
+
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train import trainer as tr
+
+    from ptype_tpu.models import transformer as tfm
+
+    cfg = tfm.preset("tiny", dtype=jnp.float32)  # f32: exact comparison
+    mesh = build_mesh({"data": 2})
+    opt = optax.sgd(0.1)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (8, 32), 0,
+                              cfg.vocab_size, jnp.int32)
+    batch = {"tokens": toks, "targets": toks}
+
+    s1, _ = tr.init_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+    s2, _ = tr.init_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+    step_full = tr.make_train_step(cfg, mesh, opt)
+    step_acc = tr.make_train_step(cfg, mesh, opt, grad_accum=2)
+    s1, o1 = step_full(s1, batch)
+    s2, o2 = step_acc(s2, batch)
+    np.testing.assert_allclose(float(o1["loss"]), float(o2["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_weight_decay_skips_norms(tiny):
+    """Norm scales don't decay: with zero grads, SGD+wd via the default
+    optimizer's mask leaves norm params untouched while weights shrink."""
+    import optax
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.train.trainer import _decay_mask
+
+    params = tfm.init_params(jax.random.PRNGKey(0), tiny)
+    mask = _decay_mask(params)
+    assert mask["blocks"]["attn_norm"] is False
+    assert mask["blocks"]["mlp_norm"] is False
+    assert mask["final_norm"] is False
+    assert mask["blocks"]["wq"] is True
+    assert mask["embed"] is True
