@@ -119,14 +119,17 @@ class Checkpointer:
             arr = jax.numpy.asarray(leaf) if np.isscalar(leaf) else leaf
             shards = []
             if isinstance(arr, jax.Array) and arr.addressable_shards:
-                # Dedup by start offset: replication (full or partial)
+                # Dedup by (start, extent): replication (full or partial)
                 # puts identical shards on several devices — write one.
+                # Start alone is not enough under uneven partial sharding
+                # (two shards can share a start with different extents).
                 seen: set[tuple] = set()
                 for s in arr.addressable_shards:
                     start = _index_start(s.index, arr.shape)
-                    if start in seen:
+                    box = (start, tuple(s.data.shape))
+                    if box in seen:
                         continue
-                    seen.add(start)
+                    seen.add(box)
                     shards.append((list(start), np.asarray(s.data)))
             else:
                 shards = [([0] * np.ndim(arr), np.asarray(arr))]
@@ -150,9 +153,19 @@ class Checkpointer:
             files = []
             for i, (start, data) in enumerate(shards):
                 fname = f"{key}.shard{i}.npy"
-                np.save(os.path.join(tmp, fname), data)
+                raw = data.dtype.kind == "V"
+                if raw:
+                    # Extension dtypes (bfloat16 & friends) have no npy
+                    # cast path: np.save writes them as opaque void and
+                    # restore cannot assign them back. Persist the raw
+                    # bytes; the manifest keeps the logical dtype and
+                    # restore views them back through it.
+                    np.save(os.path.join(tmp, fname),
+                            np.frombuffer(data.tobytes(), np.uint8))
+                else:
+                    np.save(os.path.join(tmp, fname), data)
                 files.append({"file": fname, "start": start,
-                              "shape": list(data.shape)})
+                              "shape": list(data.shape), "raw": raw})
             manifest["leaves"][key] = {**meta, "shards": files}
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
             json.dump(manifest, f)
@@ -223,29 +236,19 @@ class Checkpointer:
                 raise ClusterError(
                     f"restore: checkpoint {step} has no leaf {key!r}"
                 )
-            full = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
+            dtype = _resolve_dtype(entry["dtype"])
+            full = np.zeros(entry["shape"], dtype=dtype)
             if full.ndim == 0:
-                full = np.asarray(
-                    np.load(os.path.join(sdir, entry["shards"][0]["file"]))
-                )
+                full = _load_shard(sdir, entry["shards"][0], dtype)
             else:
-                covered = 0
+                _check_tiling(key, entry["shards"], entry["shape"])
                 for rec in entry["shards"]:
-                    data = np.load(os.path.join(sdir, rec["file"]))
+                    data = _load_shard(sdir, rec, dtype)
                     sl = tuple(
                         slice(st, st + sz)
                         for st, sz in zip(rec["start"], data.shape)
                     )
                     full[sl] = data
-                    covered += data.size
-                # Disjoint-by-construction shards must tile the array;
-                # fail loudly rather than hand back zero-filled params.
-                if covered < full.size:
-                    raise ClusterError(
-                        f"restore: leaf {key!r} shards cover {covered} of "
-                        f"{full.size} elements — partial checkpoint "
-                        "(saved from a different process set?)"
-                    )
             arr = jax.device_put(full, sh) if sh is not None else (
                 jax.numpy.asarray(full)
             )
@@ -269,6 +272,45 @@ def _index_start(index: tuple, shape: tuple) -> tuple[int, ...]:
     for sl, _ in zip(index, shape):
         out.append(0 if sl.start is None else int(sl.start))
     return tuple(out)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Manifest dtype string → dtype, including ml_dtypes extension
+    types (bfloat16 etc.) that plain numpy may not resolve by name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _load_shard(sdir: str, rec: dict, dtype: np.dtype) -> np.ndarray:
+    data = np.load(os.path.join(sdir, rec["file"]))
+    if rec.get("raw"):
+        data = data.view(dtype).reshape(rec["shape"])
+    return np.asarray(data)
+
+
+def _check_tiling(key: str, shards: list[dict], shape: list[int]) -> None:
+    """Shards must tile the array exactly: total element count matches
+    AND no two boxes overlap (a raw count can be satisfied by overlaps
+    masking gaps). O(n²) boxes, n = shard count — tiny."""
+    total = int(np.prod(shape)) if shape else 1
+    boxes = [(tuple(r["start"]), tuple(r["shape"])) for r in shards]
+    covered = sum(int(np.prod(s)) for _, s in boxes)
+    overlap = any(
+        all(a0 < b0 + bs and b0 < a0 + as_
+            for a0, as_, b0, bs in zip(sa, za, sb, zb))
+        for i, (sa, za) in enumerate(boxes)
+        for sb, zb in boxes[i + 1:]
+    )
+    if covered != total or overlap:
+        raise ClusterError(
+            f"restore: leaf {key!r} shards cover {covered} of {total} "
+            f"elements{' with overlaps' if overlap else ''} — corrupt "
+            "or partial checkpoint (saved from a different process set?)"
+        )
 
 
 class StoreCheckpoint:

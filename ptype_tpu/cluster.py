@@ -132,10 +132,43 @@ class Cluster:
         log.info("left cluster", kv={"node": self.cfg.node_name})
 
 
+def _init_jax_distributed(platform) -> None:
+    """Initialize the multi-controller JAX runtime as part of join —
+    Join does *everything* in the reference (cluster.go:28-84); the TPU
+    translation is "Join ≈ jax.distributed.initialize + mesh
+    construction" (SURVEY §3.1). No-op when already initialized (e.g.
+    the launcher did it) so join stays idempotent."""
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            log.debug("jax.distributed already initialized")
+            return
+    except Exception:  # noqa: BLE001 — internals moved; initialize anyway
+        pass
+    addr = platform.jax_coordinator_address
+    if not addr:
+        host, _, port = platform.coordinator_address.rpartition(":")
+        addr = f"{host}:{int(port) + 1}"
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=platform.num_processes,
+        process_id=platform.process_id,
+    )
+    log.info("jax distributed initialized",
+             kv={"addr": addr, "process": platform.process_id,
+                 "n": platform.num_processes})
+
+
 def join(cfg: Config) -> Cluster:
     """Join (or seed) the cluster described by ``cfg`` (ref: cluster.go:28-84)."""
     logs.set_debug(cfg.debug)
     platform = cfg.platform
+
+    if platform.num_processes > 1:
+        _init_jax_distributed(platform)
 
     owned_server: CoordServer | None = None
     coord_addr = platform.coordinator_address
@@ -146,7 +179,15 @@ def join(cfg: Config) -> Cluster:
         with _servers_lock:
             server = _servers.get(coord_addr)
             if server is None:
-                server = CoordServer(coord_addr)
+                import os as _os
+
+                # Durable control plane (ref: etcd data-dir): the seed
+                # WALs its CoordState so registry/store survive restart.
+                server = CoordServer(
+                    coord_addr,
+                    data_dir=(_os.path.join(platform.data_dir, "coord")
+                              if platform.data_dir else None),
+                )
                 _servers[server.address] = server
                 owned_server = server
         # The seed talks to its own state in-process — no self-dial.

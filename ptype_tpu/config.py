@@ -59,6 +59,12 @@ class PlatformConfig:
     lease_ttl: float = 2.0
     #: Dial timeout to the coordination service (ref: 5s, registry.go:37).
     dial_timeout: float = 5.0
+    #: host:port of the JAX distributed coordination service for
+    #: multi-controller runs (``num_processes > 1``). Empty = derive
+    #: from ``coordinator_address`` host with port+1. ``join`` calls
+    #: ``jax.distributed.initialize`` with this (SURVEY §3.1: "Join ≈
+    #: jax.distributed.initialize + mesh construction").
+    jax_coordinator_address: str = ""
 
     def validate(self) -> None:
         if not self.name:
@@ -127,6 +133,7 @@ _CONFIG_FIELDS = {
 _PLATFORM_FIELDS = {
     "name", "coordinator_address", "is_coordinator", "mesh_axes",
     "num_processes", "process_id", "data_dir", "lease_ttl", "dial_timeout",
+    "jax_coordinator_address",
 }
 
 

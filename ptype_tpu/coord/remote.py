@@ -39,20 +39,22 @@ class RemoteCoord(CoordBackend):
     """
 
     def __init__(self, address: str, dial_timeout: float = 5.0,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0,
+                 reconnect_timeout: float = 30.0):
         host, _, port = address.rpartition(":")
         self.address = address
+        self._host, self._port = host, int(port)
+        self._dial_timeout = dial_timeout
         self._request_timeout = request_timeout
+        #: How long to re-dial a lost coordinator before giving up
+        #: (covers a seed restart from its WAL data_dir); 0 disables.
+        self._reconnect_timeout = reconnect_timeout
         try:
-            self._sock = socket.create_connection(
-                (host, int(port)), timeout=dial_timeout
-            )
+            self._sock = self._dial()
         except OSError as e:
             raise CoordinationError(
                 f"failed to dial coordination service at {address}: {e}"
             ) from e
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._pending: dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
@@ -68,12 +70,33 @@ class RemoteCoord(CoordBackend):
 
     # ------------------------------------------------------------- plumbing
 
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._dial_timeout
+        )
+        if sock.getsockname() == sock.getpeername():
+            # TCP simultaneous-open self-connect: dialing a loopback
+            # ephemeral port with no listener can connect the socket to
+            # itself — not a coordinator.
+            sock.close()
+            raise OSError("self-connected (no listener)")
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
     def _read_loop(self) -> None:
         while not self._closed.is_set():
             try:
                 msg = wire.recv_msg(self._sock)
             except (wire.WireError, OSError):
-                break
+                # Connection lost: fail outstanding requests (their
+                # callers retry — registry keepalive, balancer) and try
+                # to reach the coordinator again (it may be restarting
+                # from its WAL). Deliberate close() skips the re-dial.
+                self._fail_pending()
+                if self._closed.is_set() or not self._try_reconnect():
+                    break
+                continue
             if "watch" in msg and "id" not in msg:
                 self._dispatch_watch(msg)
                 continue
@@ -82,16 +105,63 @@ class RemoteCoord(CoordBackend):
             if p is not None:
                 p.reply = msg
                 p.event.set()
-        # Connection is gone: fail everything outstanding.
+        # Giving up for good: fail everything outstanding.
         self._closed.set()
-        with self._pending_lock:
-            pending, self._pending = list(self._pending.values()), {}
-        for p in pending:
-            p.event.set()
+        self._fail_pending()
         with self._watches_lock:
             watches, self._watches = list(self._watches.values()), {}
         for w in watches:
             w.cancel()
+
+    def _fail_pending(self) -> None:
+        with self._pending_lock:
+            pending, self._pending = list(self._pending.values()), {}
+        for p in pending:
+            p.event.set()
+
+    def _try_reconnect(self) -> bool:
+        if not self._reconnect_timeout:
+            return False
+        deadline = time.monotonic() + self._reconnect_timeout
+        delay = 0.2
+        while not self._closed.is_set():
+            try:
+                self._sock = self._dial()
+            except OSError:
+                if time.monotonic() + delay > deadline:
+                    log.warning("coordination reconnect gave up",
+                                kv={"addr": self.address})
+                    return False
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            log.info("coordination connection re-established",
+                     kv={"addr": self.address})
+            # Re-arm watches on a fresh thread — _call needs this read
+            # loop back in recv. Events between loss and re-watch are
+            # missed; watch consumers re-list on the next event
+            # (registry.WatchService snapshot-then-delta contract).
+            threading.Thread(target=self._rewatch, daemon=True).start()
+            return True
+        return False
+
+    def _rewatch(self) -> None:
+        with self._watches_lock:
+            existing, self._watches = list(self._watches.values()), {}
+        for w in existing:
+            if w.closed:
+                continue
+            try:
+                new_id = self._call("watch", prefix=w.prefix)
+            except CoordinationError:
+                # Keep the watch registered under its old id: this
+                # connection is bad, the next reconnect cycle retries.
+                with self._watches_lock:
+                    self._watches[w.id] = w
+                continue
+            w.id = new_id
+            with self._watches_lock:
+                self._watches[new_id] = w
 
     def _dispatch_watch(self, msg: dict) -> None:
         with self._watches_lock:

@@ -44,8 +44,10 @@ def _member_wire(m) -> dict:
 class CoordServer:
     """Serves a CoordState over TCP. One instance per cluster seed."""
 
-    def __init__(self, address: str = "127.0.0.1:0", state: CoordState | None = None):
-        self.state = state or CoordState()
+    def __init__(self, address: str = "127.0.0.1:0",
+                 state: CoordState | None = None,
+                 data_dir: str | None = None):
+        self.state = state or CoordState(data_dir=data_dir)
         host, _, port = address.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

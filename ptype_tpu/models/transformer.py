@@ -54,9 +54,12 @@ class TransformerConfig:
     remat: bool = False
     #: Causal (decoder) vs. bidirectional (encoder/BERT) attention.
     causal: bool = True
-    #: "xla" (fused by the compiler) or "ring" (shard_map ring attention
-    #: over the "seq" mesh axis — see parallel/ring_attention.py).
-    attn_impl: str = "xla"
+    #: Attention lowering, resolved by :func:`resolve_attn_fn`:
+    #: "auto" (flash on TPU, xla elsewhere), "xla" (compiler-fused dense),
+    #: "flash" (Pallas kernel, ops/flash_attention.py), "ring" / "ulysses"
+    #: (sequence-parallel over the "seq" mesh axis — these need a mesh, so
+    #: the Trainer resolves them; see parallel/ring_attention.py).
+    attn_impl: str = "auto"
     #: Mixture-of-experts: number of experts per MLP (0 = dense). The
     #: expert dim shards over the "expert" mesh axis (EP — the
     #: all_to_all family, SURVEY.md §2 parallelism table).
@@ -246,6 +249,42 @@ def _attention(q, k, v, cfg: TransformerConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def resolve_attn_fn(cfg: TransformerConfig, mesh=None):
+    """Resolve ``cfg.attn_impl`` to a concrete ``attn_fn(q, k, v, cfg)``.
+
+    "auto" picks the Pallas flash kernel on TPU backends (the dense path
+    materializes B·H·S² f32 scores — the thing that kills the ≥30% MFU
+    target) and the XLA-fused dense path elsewhere. "ring"/"ulysses"
+    need a mesh with a "seq" axis; the Trainer passes its mesh, and a
+    bare ``forward`` call raises a clear error instead of silently
+    running dense.
+    """
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return _attention
+    if impl == "flash":
+        from ptype_tpu.ops.flash_attention import make_flash_attn_fn
+
+        return make_flash_attn_fn()
+    if impl in ("ring", "ulysses"):
+        if mesh is None:
+            raise ValueError(
+                f"attn_impl={impl!r} needs a mesh with a 'seq' axis — "
+                "use the Trainer (which passes its mesh) or pass attn_fn "
+                "explicitly (parallel/ring_attention.py)"
+            )
+        from ptype_tpu.parallel.ring_attention import (
+            make_ring_attention, make_ulysses_attention)
+
+        make = (make_ring_attention if impl == "ring"
+                else make_ulysses_attention)
+        return make(mesh)
+    raise ValueError(f"unknown attn_impl {impl!r}; "
+                     "want auto|xla|flash|ring|ulysses")
+
+
 def _moe_mlp(h, layer, cfg: TransformerConfig, capacity: int | None = None):
     """GShard-style top-k MoE MLP. h: (B, S, D) → (y, aux_loss).
 
@@ -353,7 +392,7 @@ def forward_with_aux(params: dict, tokens: jax.Array,
                      cfg: TransformerConfig, attn_fn=None):
     """(logits (B,S,V) f32, aux) — aux is the summed MoE router
     load-balancing loss (0.0 for dense configs)."""
-    attn_fn = attn_fn or _attention
+    attn_fn = attn_fn or resolve_attn_fn(cfg)
     B, S = tokens.shape
     dt = cfg.dtype
     x = params["embed"][tokens].astype(dt)
@@ -384,9 +423,12 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     return forward_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
-def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
-    """(Masked) mean cross-entropy from precomputed logits — shared by
-    the dense forward, the pipelined forward, and eval paths."""
+def nll_terms_from_logits(logits: jax.Array, batch: dict):
+    """(nll_sum, denom) — the unnormalized pieces of the (masked) mean
+    cross-entropy. Gradient accumulation sums these across microbatches
+    and divides ONCE, so the loss (and its grads) are invariant to the
+    accumulation factor even when valid-token counts differ per
+    microbatch."""
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, batch["targets"][..., None], axis=-1
@@ -394,9 +436,25 @@ def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
     nll = logz - gold
     mask = batch.get("loss_mask")
     if mask is None:
-        return jnp.mean(nll)
+        return jnp.sum(nll), jnp.float32(nll.size)
     mask = mask.astype(nll.dtype)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask), jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
+    """(Masked) mean cross-entropy from precomputed logits — shared by
+    the dense forward, the pipelined forward, and eval paths."""
+    nll_sum, denom = nll_terms_from_logits(logits, batch)
+    return nll_sum / denom
+
+
+def loss_terms(params: dict, batch: dict, cfg: TransformerConfig,
+               attn_fn=None):
+    """(nll_sum, denom, aux) — loss pieces for gradient accumulation
+    (train/trainer.py sums across microbatches, normalizes once)."""
+    logits, aux = forward_with_aux(params, batch["tokens"], cfg, attn_fn)
+    nll_sum, denom = nll_terms_from_logits(logits, batch)
+    return nll_sum, denom, aux
 
 
 def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,

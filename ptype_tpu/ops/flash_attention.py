@@ -7,18 +7,26 @@ block-by-block (online softmax forward; recomputed-block backward), so
 attention is HBM-linear in S — the standard flash decomposition, written
 for the MXU:
 
-- block_q × block_k = 128×128 score tiles (one MXU pass each),
-  bf16 matmuls with f32 accumulators (``preferred_element_type``);
-- causal masking at block granularity: K-blocks strictly above the
-  diagonal are skipped by loop bounds (not masked — never computed);
-- backward = two kernels (dq, and dk/dv) over recomputed score blocks
-  plus the delta = rowsum(dO∘O) trick, wired as a ``jax.custom_vjp``;
+- block_q × block_k score tiles (one MXU pass each), bf16 matmuls with
+  f32 accumulators (``preferred_element_type``);
+- **K/V streamed through the grid** — the kv-block index is the
+  innermost grid dim and online-softmax state lives in VMEM scratch
+  that persists across it, so VMEM use is O(block), independent of S
+  (the llama preset's S=8192 fits);
+- **native GQA**: K/V keep their ``n_kv_heads`` heads; the kernel index
+  maps route query head h to kv head h // group — no ``jnp.repeat``
+  materializing the H-head tensors GQA exists to avoid;
+- causal masking at block granularity; blocks strictly above the
+  diagonal are skipped (``pl.when`` — fetched but never computed);
+- forward emits the log-sum-exp rows as a residual; backward is two
+  kernels (dq; dk/dv accumulated over query heads of the group) using
+  the delta = rowsum(dO∘O) trick, wired as a ``jax.custom_vjp``;
 - ``interpret=True`` on CPU so the numerics tier of the test suite
   (SURVEY.md §4) validates the kernel without a TPU.
 
 Layout: public API takes (B, S, H, Dh) like models/transformer._attention
 and transposes to (B, H, S, Dh) internally (head-major keeps each
-(b, h) program's K/V contiguous in HBM).
+(b, h) program's K/V stream contiguous in HBM).
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -39,77 +48,99 @@ def _on_cpu() -> bool:
 # ------------------------------------------------------------------ forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                causal: bool):
-    """One (b·h, q_block) program: online softmax over K blocks."""
-    qi = pl.program_id(1)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool):
+    """Grid (B, H, num_q, num_k): one (q block, k block) tile per step.
+
+    Scratch (m, l, acc) carries the online softmax across the innermost
+    kv dim; m/l are lane-replicated (block_q, block_k) f32 so every op
+    stays 2-D and tile-aligned.
+    """
+    qi, kb = pl.program_id(2), pl.program_id(3)
+    num_k = pl.num_programs(3)
     block_q = q_ref.shape[0]
-    seq_k = k_ref.shape[0]
+    block_k = k_ref.shape[0]
 
-    q = q_ref[...]  # (block_q, Dh)
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_k = seq_k // block_k
-    if causal:
-        # K blocks past this Q block's diagonal are never computed.
-        hi = jnp.minimum((qi + 1) * block_q + block_k - 1, seq_k) // block_k
-    else:
-        hi = num_k
+    # Causal: K blocks strictly above this Q block's diagonal contribute
+    # nothing — skip the MXU work entirely.
+    live = (kb * block_k < (qi + 1) * block_q) if causal else True
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+                jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+                jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)  # lane-replicated
+        p = jnp.exp(s - m_next)
+        l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        l_scr[...] = jnp.broadcast_to(l_next[:, :1], l_scr.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m, l, acc))
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(kb == num_k - 1)
+    def _finalize():
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = m + jnp.log(l_safe)
 
 
 def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
          interpret: bool):
-    """q,k,v: (B, H, S, Dh) → o same shape."""
+    """q: (B, H, S, Dh); k, v: (B, K, S, Dh) → (o like q, lse (B, H, S))."""
     B, H, S, Dh = q.shape
+    K = k.shape[1]
+    group = H // K
     scale = 1.0 / (Dh ** 0.5)
-    grid = (B * H, S // block_q)
+    grid = (B, H, S // block_q, S // block_k)
 
-    def qmap(bh, qi):
-        return (bh // H, bh % H, qi, 0)
-
-    def kvmap(bh, qi):
-        return (bh // H, bh % H, 0, 0)
+    qmap = lambda b, h, qi, kb: (b, h, qi, 0)           # noqa: E731
+    kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
 
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                          causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, S, Dh), kvmap),
-            pl.BlockSpec((None, None, S, Dh), kvmap),
+            pl.BlockSpec((None, None, block_k, Dh), kvmap),
+            pl.BlockSpec((None, None, block_k, Dh), kvmap),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, Dh), qmap),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, Dh), qmap),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, qi, kb: (b, h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, block_k), jnp.float32),  # m
+            pltpu.VMEM((block_q, block_k), jnp.float32),  # l
+            pltpu.VMEM((block_q, Dh), jnp.float32),       # acc
+        ],
         interpret=interpret,
     )(q, k, v)
 
@@ -117,29 +148,27 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
 # ----------------------------------------------------------------- backward
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, dq_ref, *,
-                   block_k: int, scale: float, causal: bool):
-    """Recompute score blocks; dq for one (b·h, q_block)."""
-    qi = pl.program_id(1)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale: float, causal: bool):
+    """dq for one q block, streaming k/v blocks through the grid."""
+    qi, kb = pl.program_id(2), pl.program_id(3)
+    num_k = pl.num_programs(3)
     block_q = q_ref.shape[0]
-    seq_k = k_ref.shape[0]
+    block_k = k_ref.shape[0]
 
-    q = q_ref[...]
-    o = o_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
-    delta = jnp.sum(o * do, axis=1)  # (block_q,)
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    # Recover the softmax normalizer: flash stores only o, so we redo the
-    # m/l pass (cheap relative to the matmuls, keeps HBM linear).
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    num_k = seq_k // block_k
-    hi = (jnp.minimum((qi + 1) * block_q + block_k - 1, seq_k) // block_k
-          if causal else num_k)
+    live = (kb * block_k < (qi + 1) * block_q) if causal else True
 
-    def stats(kb, carry):
-        m, l = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        delta = delta_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -149,94 +178,47 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, dq_ref, *,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]),
-                                             axis=1)
-        return m_new, l
-
-    m, l = jax.lax.fori_loop(0, hi, stats, (m, l))
-
-    def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - m[:, None]) / l[:, None]
+        p = jnp.exp(s - lse[:, None])  # normalized probs via lse
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(
-        0, hi, body, jnp.zeros(q.shape, jnp.float32))
-    dq_ref[...] = dq.astype(dq_ref.dtype)
+    @pl.when(kb == num_k - 1)
+    def _finalize():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _fwd_stats_kernel(q_ref, k_ref, m_ref, l_ref, *, block_k: int,
-                      scale: float, causal: bool):
-    """Row max/normalizer per q block (forward replay, stats only)."""
-    qi = pl.program_id(1)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool):
+    """dk/dv for one kv block of one KV HEAD: grid (B, K, num_k, G,
+    num_q) streams every query block of every query head in the GQA
+    group through scratch accumulators — the group-sum GQA's backward
+    needs, without materializing repeated K/V."""
+    ki = pl.program_id(2)
+    g, qb = pl.program_id(3), pl.program_id(4)
+    num_g, num_q = pl.num_programs(3), pl.num_programs(4)
     block_q = q_ref.shape[0]
-    seq_k = k_ref.shape[0]
-    q = q_ref[...]
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    num_k = seq_k // block_k
-    hi = (jnp.minimum((qi + 1) * block_q + block_k - 1, seq_k) // block_k
-          if causal else num_k)
-
-    def body(kb, carry):
-        m, l = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]),
-                                             axis=1)
-        return m_new, l
-
-    m, l = jax.lax.fori_loop(0, hi, body, (m, l))
-    m_ref[...] = m[None, :]
-    l_ref[...] = l[None, :]
-
-
-def _bwd_dkv_kernel_v2(m_ref, l_ref, q_ref, k_ref, v_ref, do_ref, delta_ref,
-                       dk_ref, dv_ref, *, block_q: int, scale: float,
-                       causal: bool):
-    """dk/dv for one (b·h, k_block), given per-row m/l/delta."""
-    ki = pl.program_id(1)
     block_k = k_ref.shape[0]
-    seq_q = q_ref.shape[0]
-    k = k_ref[...]
-    v = v_ref[...]
-    num_q = seq_q // block_q
-    lo = (ki * block_k) // block_q if causal else 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :]
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        m = m_ref[0, pl.ds(qb * block_q, block_q)]
-        l = l_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+    @pl.when((g == 0) & (qb == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
+    live = ((qb + 1) * block_q > ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        delta = delta_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -246,25 +228,22 @@ def _bwd_dkv_kernel_v2(m_ref, l_ref, q_ref, k_ref, v_ref, do_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - m[:, None]) / l[:, None]  # (block_q, block_k)
-        dv = dv + jax.lax.dot_general(
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk, dv = jax.lax.fori_loop(
-        lo, num_q, body,
-        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
-    )
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+    @pl.when((g == num_g - 1) & (qb == num_q - 1))
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ------------------------------------------------------------- custom VJP
@@ -272,97 +251,78 @@ def _bwd_dkv_kernel_v2(m_ref, l_ref, q_ref, k_ref, v_ref, do_ref, delta_ref,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, block_q, block_k, causal, interpret):
-    return _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+    o, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
                 interpret=interpret)
+    return o
 
 
 def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
-    o = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-             interpret=interpret)
-    return o, (q, k, v, o)
+    o, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+                  interpret=interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(block_q, block_k, causal, interpret, res, do):
-    q, k, v, o = res
+    q, k, v, o, lse = res
     B, H, S, Dh = q.shape
+    K = k.shape[1]
+    group = H // K
     scale = 1.0 / (Dh ** 0.5)
-    grid = (B * H, S // block_q)
-
-    def qmap(bh, qi):
-        return (bh // H, bh % H, qi, 0)
-
-    def fullmap(bh, qi):
-        return (bh // H, bh % H, 0, 0)
-
-    # Row stats (m, l) via a stats-only forward replay.
-    m, l = pl.pallas_call(
-        functools.partial(_fwd_stats_kernel, block_k=block_k, scale=scale,
-                          causal=causal),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, S, Dh), fullmap),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, None, 1, block_q), lambda bh, qi: (bh // H, bh % H, 0, qi)),
-            pl.BlockSpec((None, None, 1, block_q), lambda bh, qi: (bh // H, bh % H, 0, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, 1, S), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, 1, S), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)[:, :, None, :]  # (B, H, 1, S)
+                    axis=-1)  # (B, H, S)
+
+    qmap = lambda b, h, qi, kb: (b, h, qi, 0)            # noqa: E731
+    kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
+    rowmap = lambda b, h, qi, kb: (b, h, qi)             # noqa: E731
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal),
-        grid=grid,
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(B, H, S // block_q, S // block_k),
         in_specs=[
             pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, S, Dh), fullmap),
-            pl.BlockSpec((None, None, S, Dh), fullmap),
+            pl.BlockSpec((None, None, block_k, Dh), kvmap),
+            pl.BlockSpec((None, None, block_k, Dh), kvmap),
             pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
+            pl.BlockSpec((None, None, block_q), rowmap),
+            pl.BlockSpec((None, None, block_q), rowmap),
         ],
         out_specs=pl.BlockSpec((None, None, block_q, Dh), qmap),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, Dh), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do)
+    )(q, k, v, do, lse, delta)
 
-    grid_k = (B * H, S // block_k)
-
-    def kmap(bh, ki):
-        return (bh // H, bh % H, ki, 0)
-
-    def full_rowmap(bh, ki):
-        return (bh // H, bh % H, 0, 0)
+    # dk/dv: grid walks (kv head, k block) then the group's query heads
+    # and q blocks innermost, accumulating the GQA group-sum in scratch.
+    bmap_q = lambda b, kk, ki, g, qb: (b, kk * group + g, qb, 0)  # noqa: E731,E501
+    bmap_kv = lambda b, kk, ki, g, qb: (b, kk, ki, 0)             # noqa: E731,E501
+    bmap_row = lambda b, kk, ki, g, qb: (b, kk * group + g, qb)   # noqa: E731,E501
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel_v2, block_q=block_q, scale=scale,
-                          causal=causal),
-        grid=grid_k,
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(B, K, S // block_k, group, S // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, 1, S), full_rowmap),  # m
-            pl.BlockSpec((None, None, 1, S), full_rowmap),  # l
-            pl.BlockSpec((None, None, S, Dh), full_rowmap),  # q (full)
-            pl.BlockSpec((None, None, block_k, Dh), kmap),
-            pl.BlockSpec((None, None, block_k, Dh), kmap),
-            pl.BlockSpec((None, None, S, Dh), full_rowmap),  # do (full)
-            pl.BlockSpec((None, None, 1, S), full_rowmap),  # delta
+            pl.BlockSpec((None, None, block_q, Dh), bmap_q),
+            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
+            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
+            pl.BlockSpec((None, None, block_q, Dh), bmap_q),
+            pl.BlockSpec((None, None, block_q), bmap_row),
+            pl.BlockSpec((None, None, block_q), bmap_row),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, Dh), kmap),
-            pl.BlockSpec((None, None, block_k, Dh), kmap),
+            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
+            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, Dh), jnp.float32),
+            pltpu.VMEM((block_k, Dh), jnp.float32),
+        ],
         interpret=interpret,
-    )(m, l, q, k, v, do, delta)
+    )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -377,18 +337,19 @@ def flash_attention(q, k, v, causal: bool = True,
                     interpret: bool | None = None) -> jax.Array:
     """Flash attention over (B, S, H, Dh) tensors (transformer layout).
 
-    GQA-aware: K/V may carry fewer heads (repeated up to H). Sequence
-    length must divide by the block sizes (pad upstream — presets use
-    power-of-two seq). ``interpret`` defaults to True on CPU backends so
-    tests validate the kernel without a TPU.
+    GQA-native: K/V may carry fewer heads (``H % K == 0``); query head h
+    reads kv head ``h // (H/K)`` inside the kernel — no repeat. Sequence
+    length must divide by the (clamped) block sizes; pad upstream —
+    presets use power-of-two seq. ``interpret`` defaults to True on CPU
+    backends so tests validate the kernel without a TPU.
     """
     if interpret is None:
         interpret = _on_cpu()
     B, S, H, Dh = q.shape
     K = k.shape[2]
-    if K != H:
-        k = jnp.repeat(k, H // K, axis=2)
-        v = jnp.repeat(v, H // K, axis=2)
+    if H % K:
+        raise ValueError(f"flash_attention: n_heads {H} must divide by "
+                         f"n_kv_heads {K}")
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
@@ -404,10 +365,19 @@ def flash_attention(q, k, v, causal: bool = True,
 
 def make_flash_attn_fn(block_q: int = 128, block_k: int = 128):
     """attn_fn(q, k, v, cfg) for models/transformer.forward — the
-    ``attn_impl="flash"`` lowering."""
+    ``attn_impl="flash"`` lowering. Shapes the kernel can't tile
+    (seq not divisible by the clamped block sizes — e.g. odd decode
+    lengths) fall back to the dense XLA path so "flash" is always safe
+    to set globally."""
 
     def attn_fn(q, k, v, cfg):
+        S = q.shape[1]
+        bq, bk = min(block_q, S), min(block_k, S)
+        if S % bq or S % bk:
+            from ptype_tpu.models.transformer import _attention
+
+            return _attention(q, k, v, cfg)
         return flash_attention(q, k, v, causal=cfg.causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=bq, block_k=bk)
 
     return attn_fn

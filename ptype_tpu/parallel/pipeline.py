@@ -203,15 +203,10 @@ def pipeline_state_shardings(params_like, mesh: Mesh, optimizer,
     p_sh = jax.tree_util.tree_map_with_path(param_sh, params_shape)
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
-    flat_p, _ = jax.tree_util.tree_flatten(params_shape)
-    flat_sh = jax.tree_util.tree_flatten(p_sh)[0]
-    by_shape = {}
-    for leaf, sh in zip(flat_p, flat_sh):
-        by_shape.setdefault(tuple(leaf.shape), sh)
+    from ptype_tpu.train.trainer import opt_state_shardings
+
     repl = NamedSharding(mesh, P())
-    o_sh = jax.tree.map(
-        lambda l: by_shape.get(tuple(l.shape), repl), opt_shape
-    )
+    o_sh = opt_state_shardings(opt_shape, params_shape, p_sh, repl)
     return TrainState(p_sh, o_sh, repl)
 
 

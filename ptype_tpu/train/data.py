@@ -107,7 +107,16 @@ class TokenFileDataset:
                         except queue.Full:
                             continue
             except Exception as e:  # noqa: BLE001 — surface to consumer
-                q.put((ERR, e))
+                # Same stop-aware bounded put as the happy path: if the
+                # consumer abandoned the iterator while the queue is
+                # full, the thread must still exit (not block forever
+                # with the error never read).
+                while not stop.is_set():
+                    try:
+                        q.put((ERR, e), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, name="token-prefetch",
                              daemon=True)

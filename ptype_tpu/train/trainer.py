@@ -88,6 +88,48 @@ def make_apply_fn(optimizer):
     return jax.jit(apply)
 
 
+def _path_key(path) -> tuple[str, ...]:
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(str(p.key))
+        elif hasattr(p, "idx"):
+            out.append(str(p.idx))
+        elif hasattr(p, "name"):
+            out.append(str(p.name))
+        else:
+            out.append(str(p))
+    return tuple(out)
+
+
+def opt_state_shardings(opt_shape, params_shape, param_sh_tree, repl):
+    """Sharding for every optimizer-state leaf.
+
+    Optax moment trees (adam mu/nu, …) mirror the params tree inside a
+    larger state structure, so each opt leaf is matched to a param by
+    PATH SUFFIX (('mu','blocks','wq') ends with ('blocks','wq')) with a
+    shape check — never by shape alone, where two unrelated leaves that
+    happen to share a shape would silently swap shardings. Unmatched
+    leaves (step counts, schedule scalars) replicate.
+    """
+    param_map: dict[tuple[str, ...], tuple[tuple, Any]] = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params_shape)[0]
+    flat_sh = jax.tree_util.tree_leaves(
+        param_sh_tree, is_leaf=lambda x: isinstance(x, NamedSharding))
+    for (path, leaf), sh in zip(flat_p, flat_sh):
+        param_map[_path_key(path)] = (tuple(leaf.shape), sh)
+
+    def match(path, leaf):
+        key = _path_key(path)
+        for i in range(len(key)):
+            hit = param_map.get(key[i:])
+            if hit is not None and hit[0] == tuple(leaf.shape):
+                return hit[1]
+        return repl
+
+    return jax.tree_util.tree_map_with_path(match, opt_shape)
+
+
 def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
                      optimizer) -> TrainState:
     """Sharding pytree for TrainState: optax mirrors param specs."""
@@ -97,24 +139,11 @@ def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
     param_sh = jax.tree.map(to_ns, pspecs,
                             is_leaf=lambda x: isinstance(x, P))
 
-    # Derive the opt-state sharding by eval_shape: any leaf whose shape
-    # matches a param leaf inherits that param's sharding (adam moments);
-    # everything else (counts, scalars) is replicated.
     params_shape = jax.eval_shape(lambda: tfm.init_params(
         jax.random.PRNGKey(0), cfg))
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
-
-    flat_params, ptree = jax.tree_util.tree_flatten(params_shape)
-    flat_specs = jax.tree_util.tree_flatten(
-        pspecs, is_leaf=lambda x: isinstance(x, P))[0]
-    by_shape: dict[tuple, P] = {}
-    for leaf, spec in zip(flat_params, flat_specs):
-        by_shape.setdefault(tuple(leaf.shape), spec)
-
-    def opt_leaf(leaf):
-        return to_ns(by_shape.get(tuple(leaf.shape), P()))
-
-    opt_sh = jax.tree.map(opt_leaf, opt_shape)
+    opt_sh = opt_state_shardings(opt_shape, params_shape, param_sh,
+                                 to_ns(P()))
     return TrainState(param_sh, opt_sh, to_ns(P()))
 
 
@@ -170,19 +199,32 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
             batch,
         )
 
+        # Global normalizer computed over the WHOLE batch up front (the
+        # mask is data, no model eval needed): each microbatch then
+        # contributes nll_sum/denom, so loss and grads match grad_accum=1
+        # exactly even when valid-token counts differ per microbatch.
+        mask = batch.get("loss_mask")
+        denom = (jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                 if mask is not None
+                 else jnp.float32(batch["targets"].size))
+
+        def micro_loss(params, mb):
+            nll_sum, _, aux = tfm.loss_terms(params, mb, cfg, attn_fn)
+            loss = nll_sum / denom
+            if cfg.n_experts:
+                loss = loss + cfg.moe_aux_coef * aux / grad_accum
+            return loss
+
         def micro(carry, mb):
             loss_sum, grads_sum = carry
-            loss, grads = jax.value_and_grad(tfm.loss_fn)(
-                params, mb, cfg, attn_fn)
+            loss, grads = jax.value_and_grad(micro_loss)(params, mb)
             return (loss_sum + loss,
                     jax.tree.map(jnp.add, grads_sum, grads)), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
-        (loss_sum, grads_sum), _ = jax.lax.scan(
+        (loss, grads), _ = jax.lax.scan(
             micro, (jnp.float32(0.0), zeros), split)
-        inv = 1.0 / grad_accum
-        return loss_sum * inv, jax.tree.map(
-            lambda g: g * inv, grads_sum)
+        return loss, grads
 
     def step(state: TrainState, batch: dict):
         loss, grads = grads_of(state.params, batch)
@@ -213,13 +255,18 @@ class Trainer:
 
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  optimizer=None, rng: jax.Array | None = None,
-                 attn_fn=None, seq_axis: bool = False):
+                 attn_fn=None, seq_axis: bool = False,
+                 sync_every: int = 16):
         from ptype_tpu.metrics import StepStats, device_peak_tflops
 
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
-        self._attn_fn = attn_fn
+        # Resolve attn_impl here (not in forward) so mesh-needing
+        # implementations (ring/ulysses) work and tests can introspect.
+        self._attn_fn = attn_fn or tfm.resolve_attn_fn(cfg, mesh)
+        if cfg.attn_impl in ("ring", "ulysses") and attn_fn is None:
+            seq_axis = True
         self._seq_axis = seq_axis
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.state, self.state_shardings = init_state(
@@ -231,6 +278,11 @@ class Trainer:
         self.n_params = tfm.count_params(self.state.params)
         self._stats: StepStats | None = None
         self._peak = device_peak_tflops(mesh.devices.flat[0])
+        #: Drain the device queue every N steps (0 = never): keeps the
+        #: throughput stats honest without paying a per-step sync —
+        #: host input prep overlaps device compute in between.
+        self.sync_every = sync_every
+        self._host_step = 0
 
     _BATCH_KEYS = ("tokens", "targets", "loss_mask")
 
@@ -261,6 +313,11 @@ class Trainer:
                 if k in self._BATCH_KEYS}
 
     def step(self, batch: dict) -> dict:
+        """Dispatch one step WITHOUT waiting for it: loss/grad_norm come
+        back as device scalars (reading them blocks; not reading is
+        free), so the next batch's host prep overlaps device compute.
+        The queue is drained every ``sync_every`` steps so throughput
+        stats measure compute rate, not dispatch rate."""
         from ptype_tpu.metrics import StepStats, step_annotation
 
         batch = self.shard_batch(batch)
@@ -272,16 +329,23 @@ class Trainer:
                 n_chips=self.mesh.devices.size,
                 peak_tflops=self._peak,
             )
+            self._host_step = int(self.state.step)
             self._stats.start()
-        with step_annotation(int(self.state.step)):
+        with step_annotation(self._host_step):
             self.state, out = train_step(self.state, batch)
-        jax.block_until_ready(out["loss"])
+        self._host_step += 1
+        if self.sync_every and self._host_step % self.sync_every == 0:
+            jax.block_until_ready(out["loss"])
         self._stats.step(batch["tokens"].size)
         return {
-            "loss": float(out["loss"]),
-            "grad_norm": float(out["grad_norm"]),
-            "step": int(out["step"]),
+            "loss": out["loss"],
+            "grad_norm": out["grad_norm"],
+            "step": self._host_step,
             "tokens_per_sec": self._stats.tokens_per_sec,
             "tokens_per_sec_per_chip": self._stats.tokens_per_sec_per_chip,
             "mfu": self._stats.mfu,
         }
+
+    def sync(self) -> None:
+        """Drain the device queue (call before reading final stats)."""
+        jax.block_until_ready(self.state.params)

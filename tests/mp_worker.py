@@ -1,0 +1,46 @@
+"""Subprocess worker for the multi-process integration test.
+
+Usage: python tests/mp_worker.py <role> <config.yaml>
+
+Joins the cluster described by the config (seed hosts the coordination
+service; joiner dials it), serves an Echo actor, prints one JSON ready
+line, then sleeps until killed — the process-boundary analog of the
+reference's in-process multi-member raft suite (cluster_test.go:47-167).
+"""
+
+import json
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ptype_tpu.actor import ActorServer  # noqa: E402
+from ptype_tpu.cluster import join  # noqa: E402
+from ptype_tpu.config import config_from_file  # noqa: E402
+
+
+class Echo:
+    def Ping(self, x):  # noqa: N802 — net/rpc Type.Method naming
+        return {"pid": os.getpid(), "x": x}
+
+
+def main() -> None:
+    role, cfg_path = sys.argv[1], sys.argv[2]
+    cfg = config_from_file(cfg_path)
+    server = ActorServer(host="127.0.0.1", port=0)
+    server.register(Echo())
+    server.serve()
+    cfg.port = server.port  # advertise the bound port
+    cluster = join(cfg)
+    if role == "seed":
+        cluster.store.put("boot", "from-seed")
+    print(json.dumps({"ready": True, "pid": os.getpid(),
+                      "port": server.port, "member": cluster.member.id}),
+          flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Control-plane durability: WAL replay, compaction, seed restart.
+
+The analog of the reference's etcd data-dir durability + dead-member
+rejoin (cluster/testdata/node1.yml ``data-dir``;
+cluster_test.go:133-165): the coordinator's state survives its own
+death, and clients re-establish their connection when it comes back.
+"""
+
+import socket
+import time
+
+import pytest
+
+from ptype_tpu.coord.core import CoordState, RangeOptions
+from ptype_tpu.coord.remote import RemoteCoord
+from ptype_tpu.coord.service import CoordServer
+from ptype_tpu.errors import CoordinationError
+
+
+def _mk(tmp_path, **kw):
+    return CoordState(sweep_interval=0.05, data_dir=str(tmp_path), **kw)
+
+
+def test_wal_replay_restores_kv_members_revs(tmp_path):
+    st = _mk(tmp_path)
+    st.put("store/a", "1")
+    st.put("store/a", "2")  # version 2
+    st.put("store/b", "x")
+    m = st.member_add("n1", "1.2.3.4:1", {"role": "seed"})
+    st.member_add("n2", "1.2.3.4:2")
+    st.member_remove(m.id)
+    st.delete("store/b")
+    rev = st.revision
+    st.close()
+
+    st2 = _mk(tmp_path)
+    try:
+        assert st2.revision == rev
+        res = st2.range("store/", RangeOptions(prefix=True))
+        assert [(i.key, i.value, i.version) for i in res.items] == [
+            ("store/a", "2", 2)]
+        members = st2.member_list()
+        assert [(m.name, m.metadata) for m in members] == [("n2", {})]
+        # ids keep advancing from where they left off
+        assert st2.member_add("n3", "x:1").id == 3
+    finally:
+        st2.close()
+
+
+def test_wal_replay_leases_rearm_then_expire(tmp_path):
+    st = _mk(tmp_path)
+    lease = st.grant(0.3)
+    st.put("services/svc/n1", "{}", lease=lease)
+    st.put("store/keep", "v")
+    st.close()
+
+    st2 = _mk(tmp_path)
+    try:
+        # Lease re-armed on restart: key survives the recovery instant...
+        assert st2.range("services/svc/n1").count == 1
+        # ...keepalives keep it alive...
+        st2.keepalive(lease)
+        # ...and without keepalives it expires one TTL later.
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if st2.range("services/svc/n1").count == 0:
+                break
+            time.sleep(0.05)
+        assert st2.range("services/svc/n1").count == 0
+        assert st2.range("store/keep").count == 1  # unleased key stays
+    finally:
+        st2.close()
+
+
+def test_wal_compaction_snapshot_roundtrip(tmp_path):
+    st = _mk(tmp_path, compact_every=10)
+    for i in range(37):
+        st.put(f"store/k{i % 5}", str(i))
+    rev = st.revision
+    st.close()
+    assert (tmp_path / "coord.snap").exists()
+    # Post-compaction WAL holds only the tail since the last snapshot.
+    assert len((tmp_path / "coord.wal").read_text().splitlines()) < 10
+
+    st2 = _mk(tmp_path)
+    try:
+        assert st2.revision == rev
+        res = st2.range("store/", RangeOptions(prefix=True))
+        got = {i.key: i.value for i in res.items}
+        # Last writer per slot of range(37): i % 5 == slot.
+        assert got == {"store/k0": "35", "store/k1": "36",
+                       "store/k2": "32", "store/k3": "33",
+                       "store/k4": "34"}
+    finally:
+        st2.close()
+
+
+def test_wal_torn_tail_ignored(tmp_path):
+    st = _mk(tmp_path)
+    st.put("store/a", "1")
+    st.close()
+    with open(tmp_path / "coord.wal", "a") as f:
+        f.write('{"o":"p","k":"store/b","v":')  # torn mid-record
+    st2 = _mk(tmp_path)
+    try:
+        assert st2.range("store/a").count == 1
+        assert st2.range("store/b").count == 0
+    finally:
+        st2.close()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_seed_restart_clients_recover(tmp_path):
+    """Kill the coordinator mid-run; restart it from its data_dir; a
+    connected client's registry/store view recovers (the dead-member
+    join analog, cluster_test.go:133-165)."""
+    addr = f"127.0.0.1:{_free_port()}"
+    server = CoordServer(addr, data_dir=str(tmp_path))
+    client = RemoteCoord(addr, reconnect_timeout=15.0)
+    try:
+        client.put("store/x", "42")
+        lease = client.grant(2.0)
+        client.put("services/svc/n1", "{}", lease=lease)
+        w = client.watch("store/")
+
+        server.close()  # coordinator dies
+
+        # Ops during the outage fail but do not poison the client.
+        with pytest.raises(CoordinationError):
+            client.put("store/y", "no-coordinator")
+
+        server2 = CoordServer(addr, data_dir=str(tmp_path))
+        try:
+            # Client reconnects and the state is intact.
+            deadline = time.monotonic() + 15.0
+            val = None
+            while time.monotonic() < deadline:
+                try:
+                    res = client.range("store/x")
+                    val = res.items[0].value if res.items else None
+                    break
+                except CoordinationError:
+                    time.sleep(0.2)
+            assert val == "42"
+            # Lease survived (re-armed): keepalive works on the new seed.
+            assert client.keepalive(lease) == 2.0
+            # Writes flow again, and the re-armed watch sees them.
+            client.put("store/x", "43")
+            events = w.get(timeout=10.0)
+            assert any(ev.key == "store/x" and ev.value == "43"
+                       for ev in events)
+        finally:
+            server2.close()
+    finally:
+        client.close()
+        server.close()
