@@ -2,13 +2,19 @@
 # wall-clock budget, Makefile:1-6) — Python's analog: the full suite on the
 # virtual 8-device CPU mesh with a hard timeout.
 
-.PHONY: test bench lint native
+.PHONY: test bench lint native tpu-smoke
 
 test:
 	python -m pytest tests/ -x -q
 
 bench:
 	python bench.py
+
+# Compile + run the Pallas flash kernel fwd/bwd on an attached TPU —
+# the only tier that sees Mosaic tiling checks (exit 42 = no TPU,
+# treated as skip, not failure).
+tpu-smoke:
+	python tests/tpu_smoke.py || test $$? -eq 42
 
 lint:
 	python -m compileall -q ptype_tpu

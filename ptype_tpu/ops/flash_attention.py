@@ -48,14 +48,21 @@ def _on_cpu() -> bool:
 # ------------------------------------------------------------------ forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                scale: float, causal: bool, want_lse: bool):
     """Grid (B, H, num_q, num_k): one (q block, k block) tile per step.
 
-    Scratch (m, l, acc) carries the online softmax across the innermost
-    kv dim; m/l are lane-replicated (block_q, block_k) f32 so every op
-    stays 2-D and tile-aligned.
+    ``rest`` is ``(lse_ref if want_lse, m_scr, l_scr, acc_scr)`` — the
+    LSE output exists only when the caller wants the residual (the
+    primal path declares just ``o``, skipping ~B·H·S·LANES f32 of
+    discarded HBM writes). Scratch (m, l, acc) carries the online
+    softmax across the innermost kv dim; m/l are lane-replicated
+    (block_q, block_k) f32 so every op stays 2-D and tile-aligned.
     """
+    if want_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
     qi, kb = pl.program_id(2), pl.program_id(3)
     num_k = pl.num_programs(3)
     block_q = q_ref.shape[0]
@@ -100,16 +107,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(kb == num_k - 1)
     def _finalize():
-        m = m_scr[...][:, 0]
-        l = l_scr[...][:, 0]
+        m = m_scr[...][:, :1]  # (block_q, 1) — stay 2-D for Mosaic
+        l = l_scr[...][:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[...] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[...] = m + jnp.log(l_safe)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # LSE rows are lane-replicated to the 128-lane tile (the row
+            # layout (B, H, S) puts a squeezed size-1 head dim second-to-
+            # last in the block, violating Mosaic's (8, 128) tiling rule
+            # — the round-2 TPU lowering failure).
+            lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                            lse_ref.shape)
+
+
+#: Lane width of the f32 Mosaic tile. Row residuals (LSE, delta) are
+#: stored lane-replicated at this width so their block's last two dims
+#: are (block_q, 128)-aligned.
+LANES = 128
 
 
 def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
-         interpret: bool):
-    """q: (B, H, S, Dh); k, v: (B, K, S, Dh) → (o like q, lse (B, H, S))."""
+         interpret: bool, want_lse: bool = True):
+    """q: (B, H, S, Dh); k, v: (B, K, S, Dh) → (o like q, lse
+    (B, H, S, LANES) lane-replicated | None when ``not want_lse``)."""
     B, H, S, Dh = q.shape
     K = k.shape[1]
     group = H // K
@@ -119,23 +139,24 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     qmap = lambda b, h, qi, kb: (b, h, qi, 0)           # noqa: E731
     kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
 
-    return pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+    out_specs = [pl.BlockSpec((None, None, block_q, Dh), qmap)]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if want_lse:
+        out_specs.append(pl.BlockSpec((None, None, block_q, LANES), qmap))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, S, LANES), jnp.float32))
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          want_lse=want_lse),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, Dh), qmap),
             pl.BlockSpec((None, None, block_k, Dh), kvmap),
             pl.BlockSpec((None, None, block_k, Dh), kvmap),
         ],
-        out_specs=[
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, qi, kb: (b, h, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, block_k), jnp.float32),  # m
             pltpu.VMEM((block_q, block_k), jnp.float32),  # l
@@ -143,6 +164,7 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v)
+    return (out[0], out[1]) if want_lse else (out[0], None)
 
 
 # ----------------------------------------------------------------- backward
@@ -167,8 +189,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         q = q_ref[...]
         k = k_ref[...]
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...]
-        delta = delta_ref[...]
+        lse = lse_ref[...][:, :1]      # lane-replicated → (block_q, 1)
+        delta = delta_ref[...][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -178,11 +200,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # normalized probs via lse
+        p = jnp.exp(s - lse)  # normalized probs via lse
         dp = jax.lax.dot_general(
             do, v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -217,8 +239,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[...]
         k = k_ref[...]
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...]
-        delta = delta_ref[...]
+        lse = lse_ref[...][:, :1]      # lane-replicated → (block_q, 1)
+        delta = delta_ref[...][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -228,14 +250,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -252,28 +274,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, block_q, block_k, causal, interpret):
     o, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-                interpret=interpret)
+                interpret=interpret, want_lse=False)
     return o
 
 
 def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
     o, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal,
                   interpret=interpret)
-    return o, (q, k, v, o, lse)
+    # Save one lane of the replicated LSE: the (B, H, S, LANES) layout is
+    # a kernel-I/O constraint, not information — holding all 128 lanes
+    # from forward to backward would inflate saved-activation HBM 128×.
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_bwd(block_q, block_k, causal, interpret, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse1 = res
     B, H, S, Dh = q.shape
     K = k.shape[1]
     group = H // K
     scale = 1.0 / (Dh ** 0.5)
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)  # (B, H, S)
+    # Row residuals ride the same lane-replicated (B, H, S, LANES)
+    # layout the forward emits for LSE (Mosaic (8, 128) tiling rule);
+    # both are broadcast transiently here, inside the backward.
+    lse = jnp.broadcast_to(lse1, (B, H, S, LANES))
+    delta = jnp.broadcast_to(
+        jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (B, H, S, LANES))
 
     qmap = lambda b, h, qi, kb: (b, h, qi, 0)            # noqa: E731
     kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
-    rowmap = lambda b, h, qi, kb: (b, h, qi)             # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
@@ -283,8 +313,8 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
             pl.BlockSpec((None, None, block_k, Dh), kvmap),
             pl.BlockSpec((None, None, block_k, Dh), kvmap),
             pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_q), rowmap),
-            pl.BlockSpec((None, None, block_q), rowmap),
+            pl.BlockSpec((None, None, block_q, LANES), qmap),
+            pl.BlockSpec((None, None, block_q, LANES), qmap),
         ],
         out_specs=pl.BlockSpec((None, None, block_q, Dh), qmap),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -296,7 +326,6 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
     # and q blocks innermost, accumulating the GQA group-sum in scratch.
     bmap_q = lambda b, kk, ki, g, qb: (b, kk * group + g, qb, 0)  # noqa: E731,E501
     bmap_kv = lambda b, kk, ki, g, qb: (b, kk, ki, 0)             # noqa: E731,E501
-    bmap_row = lambda b, kk, ki, g, qb: (b, kk * group + g, qb)   # noqa: E731,E501
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal),
@@ -306,8 +335,8 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
             pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
             pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
             pl.BlockSpec((None, None, block_q, Dh), bmap_q),
-            pl.BlockSpec((None, None, block_q), bmap_row),
-            pl.BlockSpec((None, None, block_q), bmap_row),
+            pl.BlockSpec((None, None, block_q, LANES), bmap_q),
+            pl.BlockSpec((None, None, block_q, LANES), bmap_q),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_k, Dh), bmap_kv),

