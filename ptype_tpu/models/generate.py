@@ -73,10 +73,10 @@ def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
 
 
 def _head_logits(params, x_last, cfg):
-    head = (params["embed"].T if cfg.tie_embeddings
-            else params["lm_head"])
-    return jnp.einsum("bd,dv->bv", x_last.astype(jnp.float32),
-                      head.astype(jnp.float32))
+    # One LM-head lowering for train and decode: bf16 operands with f32
+    # MXU accumulation (transformer.head_logits), so precision policy
+    # can never drift between the two paths.
+    return tfm.head_logits(x_last, tfm._head_weight(params, cfg), cfg)
 
 
 def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,

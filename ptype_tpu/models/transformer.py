@@ -88,9 +88,13 @@ PRESETS: dict[str, TransformerConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
         max_seq=128,
     ),
-    "optimus-125m": TransformerConfig(),  # defaults above ≈ 110M params
+    # ≈110M params. 6 heads × 128 head_dim (not GPT-2's 12 × 64): same
+    # d_model/params/FLOPs, but 128-wide heads fill the MXU contraction
+    # and the 128-lane tile — Dh=64 tensors pad 2× in HBM and ran the
+    # flash kernel 1.5× slower (measured on v5e).
+    "optimus-125m": TransformerConfig(n_heads=6),
     "optimus-350m": TransformerConfig(
-        d_model=1024, n_layers=24, n_heads=16, d_ff=2816,
+        d_model=1024, n_layers=24, n_heads=8, d_ff=2816,
     ),
     # Encoder config for the async param-server baseline ("BERT-base async
     # param-server mode", BASELINE.json configs) — bidirectional attention,
@@ -388,10 +392,12 @@ def _block(x, layer, sin, cos, cfg: TransformerConfig, attn_fn):
     return mlp_residual(x, layer, cfg)
 
 
-def forward_with_aux(params: dict, tokens: jax.Array,
-                     cfg: TransformerConfig, attn_fn=None):
-    """(logits (B,S,V) f32, aux) — aux is the summed MoE router
-    load-balancing loss (0.0 for dense configs)."""
+def hidden_with_aux(params: dict, tokens: jax.Array,
+                    cfg: TransformerConfig, attn_fn=None):
+    """Backbone up to (and including) the final norm: (x (B,S,D) in
+    compute dtype, aux). The LM head is applied by the caller — either
+    densely (:func:`forward_with_aux`) or fused with the loss
+    (:func:`loss_terms`) so the (B,S,V) f32 logits never materialize."""
     attn_fn = attn_fn or resolve_attn_fn(cfg)
     B, S = tokens.shape
     dt = cfg.dtype
@@ -405,15 +411,33 @@ def forward_with_aux(params: dict, tokens: jax.Array,
     if cfg.remat:
         body = jax.checkpoint(body)
     x, auxs = lax.scan(body, x, params["blocks"])
+    return rms_norm(x, params["final_norm"]), jnp.sum(auxs)
 
-    x = rms_norm(x, params["final_norm"])
-    if cfg.tie_embeddings:
-        head = params["embed"].T
-    else:
-        head = params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
-                        head.astype(jnp.float32))
-    return logits, jnp.sum(auxs)
+
+def _head_weight(params: dict, cfg: TransformerConfig) -> jax.Array:
+    return (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+
+
+def head_logits(x: jax.Array, head: jax.Array,
+                cfg: TransformerConfig) -> jax.Array:
+    """LM head matmul: bf16 operands, f32 MXU accumulation.
+
+    Casting both operands to f32 (the previous lowering) ran the
+    largest matmul in the model at half MXU rate (VERDICT r2 weak #7);
+    ``preferred_element_type`` keeps the f32 accumulator — and the f32
+    logits the softmax needs — with bf16 inputs."""
+    return jnp.einsum("...d,dv->...v", x.astype(cfg.dtype),
+                      head.astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def forward_with_aux(params: dict, tokens: jax.Array,
+                     cfg: TransformerConfig, attn_fn=None):
+    """(logits (B,S,V) f32, aux) — aux is the summed MoE router
+    load-balancing loss (0.0 for dense configs)."""
+    x, aux = hidden_with_aux(params, tokens, cfg, attn_fn)
+    return head_logits(x, _head_weight(params, cfg), cfg), aux
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
@@ -448,12 +472,68 @@ def nll_from_logits(logits: jax.Array, batch: dict) -> jax.Array:
     return nll_sum / denom
 
 
+#: Rows of (tokens × vocab) logits materialized at once by the fused
+#: loss head. 8192 × 32k vocab f32 ≈ 1 GB of transient per chunk — big
+#: enough to keep the MXU fed, small enough that the full (B·S, V)
+#: tensor (4.3 GB at batch 32 / seq 1024) never exists.
+LOSS_CHUNK_ROWS = 8192
+
+
+def _chunked_nll(x, head, targets, mask, cfg: TransformerConfig):
+    """(nll_sum, denom) with the head matmul fused into the loss.
+
+    The dense path materializes (B, S, V) f32 logits — at the bench's
+    32-per-chip batch that is 4.3 GB and was the HBM wall that forced
+    the ladder down to batch 16. Here rows stream through a
+    ``lax.scan`` in :data:`LOSS_CHUNK_ROWS` chunks; each chunk's body is
+    rematerialized (``jax.checkpoint``) so backward recomputes the
+    chunk logits instead of saving them — saved residuals shrink from
+    O(B·S·V) to O(B·S·D).
+    """
+    B, S, D = x.shape
+    n = B * S
+    x = x.reshape(n, D)
+    targets = targets.reshape(n)
+    mask = None if mask is None else mask.reshape(n).astype(jnp.float32)
+
+    # Largest divisor of n that fits the chunk budget — NOT just "n if
+    # it doesn't divide evenly": global batch 12 × seq 1024 (n=12288)
+    # must chunk at 6144, not fall back to one 1.6 GB dense chunk.
+    chunk = min(n, LOSS_CHUNK_ROWS)
+    while n % chunk:
+        chunk -= 1
+    if chunk < 512:  # pathological n (odd/prime): dense beats 1-row scan
+        chunk = n
+    xc = x.reshape(n // chunk, chunk, D)
+    tc = targets.reshape(n // chunk, chunk)
+    mc = (jnp.ones((n // chunk, chunk), jnp.float32) if mask is None
+          else mask.reshape(n // chunk, chunk))
+
+    @jax.checkpoint
+    def body(carry, xs):
+        nll_sum, denom = carry
+        xr, tr, mr = xs
+        logits = head_logits(xr, head, cfg)  # (chunk, V) f32
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tr[:, None], axis=-1)[:, 0]
+        nll = (logz - gold) * mr
+        return (nll_sum + jnp.sum(nll), denom + jnp.sum(mr)), None
+
+    (nll_sum, denom), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (xc, tc, mc))
+    return nll_sum, jnp.maximum(denom, 1.0)
+
+
 def loss_terms(params: dict, batch: dict, cfg: TransformerConfig,
                attn_fn=None):
     """(nll_sum, denom, aux) — loss pieces for gradient accumulation
-    (train/trainer.py sums across microbatches, normalizes once)."""
-    logits, aux = forward_with_aux(params, batch["tokens"], cfg, attn_fn)
-    nll_sum, denom = nll_terms_from_logits(logits, batch)
+    (train/trainer.py sums across microbatches, normalizes once). The
+    LM head runs fused with the cross-entropy (:func:`_chunked_nll`):
+    full logits are never materialized."""
+    x, aux = hidden_with_aux(params, batch["tokens"], cfg, attn_fn)
+    nll_sum, denom = _chunked_nll(
+        x, _head_weight(params, cfg), batch["targets"],
+        batch.get("loss_mask"), cfg)
     return nll_sum, denom, aux
 
 
@@ -462,8 +542,8 @@ def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
     """Mean next-token cross-entropy (+ MoE router aux when configured).
     ``batch``: tokens (B,S) int32, targets (B,S) int32, optional
     loss_mask (B,S)."""
-    logits, aux = forward_with_aux(params, batch["tokens"], cfg, attn_fn)
-    loss = nll_from_logits(logits, batch)
+    nll_sum, denom, aux = loss_terms(params, batch, cfg, attn_fn)
+    loss = nll_sum / denom
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_coef * aux
     return loss

@@ -28,25 +28,36 @@ def main() -> int:
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ptype_tpu.ops.flash_attention import flash_attention
 
-    B, S, H, K, Dh = 2, 512, 8, 2, 64  # GQA group of 4
-    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-    q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
-    k = jax.random.normal(kk, (B, S, K, Dh), jnp.bfloat16)
-    v = jax.random.normal(kv, (B, S, K, Dh), jnp.bfloat16)
+    # Two shape classes: the PRODUCTION config the bench actually runs
+    # (optimus-125m: MHA, Dh=128, S=1024, full 512×1024 default blocks)
+    # and a GQA/half-lane-head case (llama-style grouping, Dh=64, which
+    # clamps block_k) — Mosaic tiling legality and VMEM fit are
+    # shape-dependent, so smoking only one class misses the other.
+    shapes = [
+        ("optimus-125m-shaped", 2, 1024, 6, 6, 128),
+        ("gqa-Dh64", 2, 512, 8, 2, 64),
+    ]
+    for name, B, S, H, K, Dh in shapes:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, S, K, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, S, K, Dh), jnp.bfloat16)
 
-    def loss(q, k, v):
-        o = flash_attention(q, k, v, causal=True)
-        return jnp.sum(o.astype(jnp.float32) ** 2)
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
 
-    # .lower() alone catches trace-time shape bugs; compiling and running
-    # catches the Mosaic tiling rejections that only fire at compile time.
-    val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
-        q, k, v)
-    jax.block_until_ready((val, grads))
-    assert jnp.isfinite(val), f"non-finite loss {val}"
-    for g in grads:
-        assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))), \
-            "non-finite grads"
+        # Compiling AND running (not just .lower()) catches the Mosaic
+        # tiling rejections that only fire at compile time.
+        val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+            q, k, v)
+        # NB: float() forces the value through the device tunnel;
+        # block_until_ready alone has been observed not to.
+        assert jnp.isfinite(float(val)), f"{name}: non-finite loss {val}"
+        for g in grads:
+            assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))), \
+                f"{name}: non-finite grads"
+        print(f"tpu-smoke {name}: OK")
     print(f"tpu-smoke OK: flash fwd+bwd on {jax.devices()[0].device_kind}")
     return 0
 
