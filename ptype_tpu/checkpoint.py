@@ -11,14 +11,18 @@ pull". This module provides both tiers:
   written **per addressable shard** (device→host copy of exactly this
   process's shards), so an 8B FSDP state never materializes unsharded.
   Restore takes a sharding pytree and ``device_put``s each leaf back
-  into placement, and verifies the manifest covers every element (a
-  partial save fails loudly, never zero-fills). ``async_save``
+  into placement, and verifies the merged manifest covers every element
+  (a partial save fails loudly, never zero-fills). ``async_save``
   snapshots to host synchronously (cheap, device→host DMA) and writes
   files on a background thread — the train loop resumes while bytes hit
-  disk. Scope: one writer per directory — in multi-controller runs,
-  process 0 saves (addressable shards of a fully-sharded state are the
-  whole state only on a single host; cross-host manifest merge is a
-  later tier).
+  disk.
+
+  **Cross-host**: in multi-controller runs every process calls ``save``
+  — each writes only the shards whose ``replica_id`` is 0 (exactly one
+  owner per shard box globally) plus its own ``manifest.p<i>.json``
+  into the shared step dir; process 0 barriers on all N manifests, then
+  commits the marker. ``restore`` merges every per-process manifest and
+  can re-place into a different mesh/process set (reshard-on-restore).
 - :class:`StoreCheckpoint` — the Store tier: persists a TensorStore
   namespace (values + spec/epoch manifest) into the platform
   ``data_dir``; ``resume()`` re-puts every key with its binding, which
@@ -26,17 +30,20 @@ pull". This module provides both tiers:
 
 Layout (one directory per step, manifest-first like an orbax step dir):
 
-    <dir>/step_<N>/manifest.json
-    <dir>/step_<N>/<flat-key>.shard<i>.npy
+    <dir>/step_<N>/manifest.json                (single-process saves)
+    <dir>/step_<N>/manifest.p<i>.json           (one per process)
+    <dir>/step_<N>/<flat-key>[.p<i>].shard<j>.npy
     <dir>/step_<N>/.complete          (commit marker, written last)
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
 import shutil
 import threading
+import time
 from typing import Any
 
 import jax
@@ -67,12 +74,30 @@ def _flat_key(path) -> str:
     return ".".join(parts) or "_root"
 
 
-class Checkpointer:
-    """Sharded pytree checkpoints under ``directory``."""
+def _proc_info() -> tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when jax is absent or
+    single-controller."""
+    try:
+        import jax
 
-    def __init__(self, directory: str, keep: int = 3):
+        return jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 — control-plane-only processes
+        return 0, 1
+
+
+class Checkpointer:
+    """Sharded pytree checkpoints under ``directory``.
+
+    ``barrier_timeout`` bounds how long process 0 waits for the other
+    processes' manifests before declaring a multi-controller save
+    failed (no commit marker is written — the step stays invisible).
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 barrier_timeout: float = 120.0):
         self.directory = directory
         self.keep = keep
+        self.barrier_timeout = barrier_timeout
         os.makedirs(directory, exist_ok=True)
         self._pending: threading.Thread | None = None
 
@@ -91,12 +116,21 @@ class Checkpointer:
     def async_save(self, step: int, tree: Any) -> None:
         """Snapshot now (device→host), write in the background. At most
         one pending write: a second call waits for the first (backpressure
-        rather than unbounded host copies)."""
+        rather than unbounded host copies). A failed background write
+        (e.g. the multi-controller barrier timeout) re-raises from the
+        NEXT ``wait``/``save``/``async_save`` — it must not die silently
+        with the daemon thread while training continues uncheckpointed."""
         self.wait()
         host = self._snapshot(tree)
+
+        def run():
+            try:
+                self._write(step, host)
+            except Exception as e:  # noqa: BLE001 — re-raised on wait()
+                self._pending_error = e
+
         self._pending = threading.Thread(
-            target=self._write, args=(step, host),
-            name=f"ckpt-{step}", daemon=True,
+            target=run, name=f"ckpt-{step}", daemon=True,
         )
         self._pending.start()
 
@@ -104,14 +138,23 @@ class Checkpointer:
         if self._pending is not None:
             self._pending.join()
             self._pending = None
+        err = getattr(self, "_pending_error", None)
+        if err is not None:
+            self._pending_error = None
+            raise ClusterError(f"async checkpoint save failed: {err}") \
+                from err
 
     def _snapshot(self, tree: Any) -> list[tuple[str, list, dict]]:
-        """Pull this process's addressable shards to host memory.
+        """Pull this process's OWNED shards to host memory.
 
-        Returns [(key, [(shard_index, np_array), ...], meta)] where
-        shard_index identifies the shard's position so any process set
-        can reassemble.
+        Ownership = ``replica_id == 0``: replication (full or partial)
+        puts identical shards on several devices — possibly on several
+        hosts — and exactly one replica of each shard box has id 0, so
+        the union of every process's snapshot tiles each array exactly
+        once with no coordination. Returns
+        [(key, [(start, np_array), ...], meta)].
         """
+        pid, _ = _proc_info()
         out = []
         leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
         for path, leaf in leaves:
@@ -119,29 +162,39 @@ class Checkpointer:
             arr = jax.numpy.asarray(leaf) if np.isscalar(leaf) else leaf
             shards = []
             if isinstance(arr, jax.Array) and arr.addressable_shards:
-                # Dedup by (start, extent): replication (full or partial)
-                # puts identical shards on several devices — write one.
-                # Start alone is not enough under uneven partial sharding
-                # (two shards can share a start with different extents).
+                # Belt and braces: replica_id==0 already picks one owner
+                # per box; the box-dedup guards against exotic shardings
+                # that alias boxes within a replica.
                 seen: set[tuple] = set()
                 for s in arr.addressable_shards:
+                    if s.replica_id != 0:
+                        continue
                     start = _index_start(s.index, arr.shape)
                     box = (start, tuple(s.data.shape))
                     if box in seen:
                         continue
                     seen.add(box)
                     shards.append((list(start), np.asarray(s.data)))
+                dtype = str(arr.dtype)
             else:
-                shards = [([0] * np.ndim(arr), np.asarray(arr))]
-            meta = {
-                "shape": list(np.shape(arr)),
-                "dtype": str(np.asarray(shards[0][1]).dtype),
-            }
+                # Host-side leaves are identical everywhere: process 0
+                # owns them.
+                if pid == 0:
+                    shards = [([0] * np.ndim(arr), np.asarray(arr))]
+                dtype = str(np.asarray(arr).dtype)
+            meta = {"shape": list(np.shape(arr)), "dtype": dtype}
             out.append((key, shards, meta))
         return out
 
     def _write(self, step: int, host: list,
                extras: dict[str, str] | None = None) -> str:
+        pid, nproc = _proc_info()
+        if nproc == 1:
+            return self._write_single(step, host, extras)
+        return self._write_multi(step, host, extras, pid, nproc)
+
+    def _write_single(self, step: int, host: list,
+                      extras: dict[str, str] | None) -> str:
         final = self._step_dir(step)
         # Unique per process AND per write: a sync save racing a stale
         # async writer must never share (or rmtree) the other's tmp dir.
@@ -153,19 +206,7 @@ class Checkpointer:
             files = []
             for i, (start, data) in enumerate(shards):
                 fname = f"{key}.shard{i}.npy"
-                raw = data.dtype.kind == "V"
-                if raw:
-                    # Extension dtypes (bfloat16 & friends) have no npy
-                    # cast path: np.save writes them as opaque void and
-                    # restore cannot assign them back. Persist the raw
-                    # bytes; the manifest keeps the logical dtype and
-                    # restore views them back through it.
-                    np.save(os.path.join(tmp, fname),
-                            np.frombuffer(data.tobytes(), np.uint8))
-                else:
-                    np.save(os.path.join(tmp, fname), data)
-                files.append({"file": fname, "start": start,
-                              "shape": list(data.shape), "raw": raw})
+                files.append(_save_shard(tmp, fname, start, data))
             manifest["leaves"][key] = {**meta, "shards": files}
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
             json.dump(manifest, f)
@@ -179,6 +220,61 @@ class Checkpointer:
         os.replace(tmp, final)
         self._gc()
         log.info("checkpoint saved", kv={"step": step, "dir": final})
+        return final
+
+    def _write_multi(self, step: int, host: list,
+                     extras: dict[str, str] | None,
+                     pid: int, nproc: int) -> str:
+        """Cross-host save into a SHARED step dir: every process writes
+        its owned shards + ``manifest.p<pid>.json`` (each file committed
+        via tmp+rename); process 0 barriers on all N manifests and then
+        writes the completion marker. A crashed peer ⇒ barrier timeout ⇒
+        no marker ⇒ restore ignores the step (never a silent partial)."""
+        final = self._step_dir(step)
+        os.makedirs(final, exist_ok=True)
+        # Stale-attempt debris (a previous save of this step that timed
+        # out or crashed) must never satisfy the barrier: process 0
+        # clears EVERY old manifest + the marker before writing anything;
+        # peers clear their own. A peer's fresh manifest caught in
+        # process 0's sweep surfaces as a barrier timeout — loud failure,
+        # never a silent merge of two attempts' shards.
+        if pid == 0:
+            for p in _glob.glob(os.path.join(final, "manifest*.json")):
+                os.unlink(p)
+            _rm_f(os.path.join(final, _COMPLETE))
+        else:
+            _rm_f(os.path.join(final, f"manifest.p{pid}.json"))
+        manifest = {"step": step, "process": pid,
+                    "num_processes": nproc, "leaves": {}}
+        for key, shards, meta in host:
+            files = []
+            for i, (start, data) in enumerate(shards):
+                fname = f"{key}.p{pid}.shard{i}.npy"
+                files.append(_save_shard(final, fname, start, data))
+            manifest["leaves"][key] = {**meta, "shards": files}
+        _atomic_write(final, f"manifest.p{pid}.json",
+                      json.dumps(manifest))
+        if pid == 0:
+            deadline = time.monotonic() + self.barrier_timeout
+            pat = os.path.join(final, "manifest.p*.json")
+            while len(_glob.glob(pat)) < nproc:
+                if time.monotonic() > deadline:
+                    # Leave the dir clearly incomplete for the next
+                    # attempt: drop our own manifest too.
+                    _rm_f(os.path.join(final, "manifest.p0.json"))
+                    raise ClusterError(
+                        f"checkpoint step {step}: only "
+                        f"{len(_glob.glob(pat))}/{nproc} process "
+                        f"manifests arrived within {self.barrier_timeout}s"
+                        " — not committing"
+                    )
+                time.sleep(0.05)
+            for fname, text in (extras or {}).items():
+                _atomic_write(final, fname, text)
+            _atomic_write(final, _COMPLETE, "ok\n")
+            self._gc()
+        log.info("checkpoint shards saved",
+                 kv={"step": step, "dir": final, "process": pid})
         return final
 
     # ---------------------------------------------------------- restore
@@ -216,8 +312,7 @@ class Checkpointer:
                     f"no complete checkpoint under {self.directory}"
                 )
         sdir = self._step_dir(step)
-        with open(os.path.join(sdir, _MANIFEST)) as f:
-            manifest = json.load(f)
+        manifest = _merged_manifest(sdir, step)
 
         leaves, treedef = jax.tree_util.tree_flatten_with_path(treedef_like)
         shard_leaves = (
@@ -266,12 +361,99 @@ class Checkpointer:
             shutil.rmtree(self._step_dir(old), ignore_errors=True)
 
 
+def _save_shard(dirpath: str, fname: str, start: list,
+                data: np.ndarray) -> dict:
+    """Write one shard file (tmp+rename — shared multi-writer dirs must
+    never expose partial files) and return its manifest record."""
+    raw = data.dtype.kind == "V"
+    tmp = os.path.join(dirpath, f".tmp.{fname}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        if raw:
+            # Extension dtypes (bfloat16 & friends) have no npy cast
+            # path: np.save writes them as opaque void and restore
+            # cannot assign them back. Persist the raw bytes; the
+            # manifest keeps the logical dtype and restore views them
+            # back through it.
+            np.save(f, np.frombuffer(data.tobytes(), np.uint8))
+        else:
+            np.save(f, data)
+    os.replace(tmp, os.path.join(dirpath, fname))
+    return {"file": fname, "start": start,
+            "shape": list(data.shape), "raw": raw}
+
+
+def _atomic_write(dirpath: str, fname: str, text: str) -> None:
+    tmp = os.path.join(dirpath, f".tmp.{fname}.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, os.path.join(dirpath, fname))
+
+
+def _rm_f(path: str) -> None:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
 def _index_start(index: tuple, shape: tuple) -> tuple[int, ...]:
     """Shard slice → start offsets (None start = 0)."""
     out = []
     for sl, _ in zip(index, shape):
         out.append(0 if sl.start is None else int(sl.start))
     return tuple(out)
+
+
+def _merged_manifest(sdir: str, step: int) -> dict:
+    """Union of the step's manifests: the single-writer ``manifest.json``
+    and/or every per-process ``manifest.p<i>.json``. Leaf shard lists
+    concatenate (file names are process-unique); duplicate boxes (e.g. a
+    legacy save's replicated copies) keep the first occurrence so the
+    tiling check still holds."""
+    paths = sorted(
+        p for p in _glob.glob(os.path.join(sdir, "manifest*.json")))
+    if not paths:
+        raise ClusterError(f"restore: step {step} has no manifest")
+    per_proc = [p for p in paths
+                if os.path.basename(p) != "manifest.json"]
+    if per_proc and len(per_proc) != len(paths):
+        raise ClusterError(
+            f"restore: step {step} mixes a single-writer manifest.json "
+            f"with per-process manifests — two save modes' debris")
+    merged: dict[str, dict] = {}
+    expected_nproc: int | None = None
+    for path in paths:
+        with open(path) as f:
+            m = json.load(f)
+        nproc = m.get("num_processes")
+        if nproc is not None:
+            if expected_nproc is None:
+                expected_nproc = nproc
+            elif nproc != expected_nproc:
+                raise ClusterError(
+                    f"restore: step {step} manifests disagree on "
+                    f"num_processes ({expected_nproc} vs {nproc}) — "
+                    "mixed save attempts")
+        for key, entry in m["leaves"].items():
+            tgt = merged.setdefault(
+                key, {k: v for k, v in entry.items() if k != "shards"})
+            tgt.setdefault("shards", []).extend(entry["shards"])
+    if expected_nproc is not None and len(per_proc) != expected_nproc:
+        raise ClusterError(
+            f"restore: step {step} has {len(per_proc)} process manifests "
+            f"but the save ran with num_processes={expected_nproc} — "
+            "incomplete (uncommitted?) save")
+    for entry in merged.values():
+        seen: set[tuple] = set()
+        uniq = []
+        for rec in entry["shards"]:
+            box = (tuple(rec["start"]), tuple(rec["shape"]))
+            if box in seen:
+                continue
+            seen.add(box)
+            uniq.append(rec)
+        entry["shards"] = uniq
+    return {"step": step, "leaves": merged}
 
 
 def _resolve_dtype(name: str) -> np.dtype:

@@ -167,12 +167,15 @@ def join(cfg: Config) -> Cluster:
     logs.set_debug(cfg.debug)
     platform = cfg.platform
 
-    if platform.num_processes > 1:
-        _init_jax_distributed(platform)
-
     owned_server: CoordServer | None = None
     coord_addr = platform.coordinator_address
 
+    # Control plane FIRST, JAX runtime second: the seed must be
+    # dialable before it blocks in jax.distributed.initialize, and
+    # joiners must keep retrying within dial_timeout — simultaneous
+    # process launch otherwise races join into "connection refused"
+    # (observed: a joiner dialing in the ms between the seed's jax init
+    # and its server bind).
     if coord_addr.startswith("local:"):
         coord: CoordBackend = local_coord(coord_addr.split(":", 1)[1])
     elif platform.is_coordinator:
@@ -197,20 +200,33 @@ def join(cfg: Config) -> Cluster:
         log.debug("seeded coordination service", kv={"addr": server.address})
     else:
         # Join an existing cluster through any known client URL
-        # (ref: joinExistingCluster, cluster.go:105-118).
+        # (ref: joinExistingCluster, cluster.go:105-118), retrying the
+        # endpoint list until dial_timeout: cluster launchers start the
+        # seed and joiners at the same instant.
+        import time as _time
+
         endpoints = cfg.initial_cluster_client_urls or [coord_addr]
+        deadline = _time.monotonic() + platform.dial_timeout
         last: Exception | None = None
         coord = None  # type: ignore[assignment]
-        for ep in endpoints:
+        while coord is None:
+            per_dial = max(0.5, deadline - _time.monotonic())
             try:
-                coord = connect(ep, dial_timeout=platform.dial_timeout)
-                break
+                # The FULL endpoint list goes to the client: on a later
+                # connection loss it fails over to any standby
+                # (coord.standby) in the list, not just the seed.
+                coord = connect(endpoints, dial_timeout=per_dial)
             except CoordinationError as e:
                 last = e
-        if coord is None:
-            raise ClusterError(
-                f"failed to reach coordination service via {endpoints}: {last}"
-            )
+                if _time.monotonic() >= deadline:
+                    raise ClusterError(
+                        f"failed to reach coordination service via "
+                        f"{endpoints}: {last}"
+                    ) from e
+                _time.sleep(0.2)
+
+    if platform.num_processes > 1:
+        _init_jax_distributed(platform)
 
     device_ordinals = (
         _local_device_ordinals() if platform.mesh_axes else ()

@@ -59,7 +59,7 @@ class CoordBackend(abc.ABC):
 
 
 def connect(
-    address: str,
+    address: str | list[str],
     *,
     dial_timeout: float = 5.0,
     in_process: bool = False,
@@ -68,13 +68,16 @@ def connect(
 
     ``in_process=True`` (or an address of the form ``local:<name>``) returns
     the shared in-process backend — the embedded-etcd-style test tier.
-    Otherwise dials the TCP coordination service at ``host:port`` with the
-    reference's 5s default dial timeout (registry.go:37).
+    Otherwise dials the TCP coordination service with the reference's 5s
+    default dial timeout (registry.go:37). ``address`` may be a list of
+    endpoints (primary + standbys); the client fails over between them.
     """
     from ptype_tpu.coord.local import local_coord
     from ptype_tpu.coord.remote import RemoteCoord
 
-    if in_process or address.startswith("local:"):
-        name = address.split(":", 1)[1] if address.startswith("local:") else address
+    if isinstance(address, str) and (
+            in_process or address.startswith("local:")):
+        name = (address.split(":", 1)[1]
+                if address.startswith("local:") else address)
         return local_coord(name)
     return RemoteCoord(address, dial_timeout=dial_timeout)

@@ -161,6 +161,11 @@ class Watch:
     def __init__(self, watch_id: int, prefix: str, cancel_fn):
         self.id = watch_id
         self.prefix = prefix
+        #: Bumped by RemoteCoord every time the watch is re-armed after
+        #: a reconnect. Events between the loss and the re-arm are gone;
+        #: consumers that see the bump must re-list to resync (the
+        #: snapshot-then-delta contract's resync point).
+        self.epoch = 0
         self._cancel_fn = cancel_fn
         self._cond = threading.Condition()
         self._events: list[Event] = []
@@ -250,10 +255,28 @@ class CoordState:
         self._wal_count = 0
         self._compact_every = compact_every
         self._data_dir = data_dir
+        self._flock = None
         if data_dir:
+            import fcntl
             import os
 
             os.makedirs(data_dir, exist_ok=True)
+            # Single-writer fence on the WAL dir: a standby promoting
+            # against a wedged-but-alive primary (or an operator
+            # double-starting the seed) must fail here instead of
+            # interleaving two coordinators' appends into one WAL.
+            # The kernel releases the lock on crash/SIGKILL, so a truly
+            # dead primary never blocks takeover.
+            self._flock = open(os.path.join(data_dir, ".lock"), "w")
+            try:
+                fcntl.flock(self._flock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError as e:
+                self._flock.close()
+                self._flock = None
+                raise RuntimeError(
+                    f"coordination data_dir {data_dir!r} is locked by a "
+                    "live coordinator — refusing to double-write the WAL"
+                ) from e
             self._replay(data_dir)
             self._wal = open(self._wal_path(), "a", encoding="utf-8")
         self._sweeper = threading.Thread(
@@ -637,5 +660,11 @@ class CoordState:
                 except OSError:
                     pass
                 self._wal = None
+            if self._flock is not None:
+                try:
+                    self._flock.close()  # releases the WAL-dir fence
+                except OSError:
+                    pass
+                self._flock = None
         for w in watches:
             w.cancel()

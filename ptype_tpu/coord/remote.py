@@ -34,26 +34,34 @@ class _Pending:
 class RemoteCoord(CoordBackend):
     """Client over one persistent connection; safe for concurrent use.
 
+    ``address`` may be a list of endpoints: the client dials the first
+    reachable one and, on connection loss, cycles through ALL of them —
+    so a warm standby (coord.standby) that takes over on a different
+    address picks up the clientele without any client-side action.
+
     Dial timeout defaults to the reference's 5 s (registry.go:37,
     store.go:25, cluster.go:53).
     """
 
-    def __init__(self, address: str, dial_timeout: float = 5.0,
+    def __init__(self, address: str | list[str], dial_timeout: float = 5.0,
                  request_timeout: float = 30.0,
                  reconnect_timeout: float = 30.0):
-        host, _, port = address.rpartition(":")
-        self.address = address
-        self._host, self._port = host, int(port)
+        eps = [address] if isinstance(address, str) else list(address)
+        if not eps:
+            raise CoordinationError("RemoteCoord: no endpoints")
+        self.endpoints = eps
+        self.address = eps[0]
         self._dial_timeout = dial_timeout
         self._request_timeout = request_timeout
         #: How long to re-dial a lost coordinator before giving up
-        #: (covers a seed restart from its WAL data_dir); 0 disables.
+        #: (covers a seed restart from its WAL data_dir, or a standby
+        #: takeover on another endpoint); 0 disables.
         self._reconnect_timeout = reconnect_timeout
         try:
             self._sock = self._dial()
         except OSError as e:
             raise CoordinationError(
-                f"failed to dial coordination service at {address}: {e}"
+                f"failed to dial coordination service at {eps}: {e}"
             ) from e
         self._send_lock = threading.Lock()
         self._pending: dict[int, _Pending] = {}
@@ -63,26 +71,48 @@ class RemoteCoord(CoordBackend):
         self._next_id = 1
         self._id_lock = threading.Lock()
         self._closed = threading.Event()
+        #: Cleared while watches are being re-armed after a reconnect;
+        #: ordinary calls wait on it so a caller cannot slip a write in
+        #: before the re-watch and silently miss its own event.
+        self._rewatch_gate = threading.Event()
+        self._rewatch_gate.set()
+        self._rewatch_thread: threading.Thread | None = None
         self._reader = threading.Thread(
-            target=self._read_loop, name=f"coord-client-{address}", daemon=True
+            target=self._read_loop, name=f"coord-client-{self.address}",
+            daemon=True
         )
         self._reader.start()
 
     # ------------------------------------------------------------- plumbing
 
     def _dial(self) -> socket.socket:
-        sock = socket.create_connection(
-            (self._host, self._port), timeout=self._dial_timeout
-        )
-        if sock.getsockname() == sock.getpeername():
-            # TCP simultaneous-open self-connect: dialing a loopback
-            # ephemeral port with no listener can connect the socket to
-            # itself — not a coordinator.
-            sock.close()
-            raise OSError("self-connected (no listener)")
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return sock
+        """Dial the endpoint list in order, starting at the currently
+        active one; first success wins and becomes ``self.address``."""
+        start = (self.endpoints.index(self.address)
+                 if self.address in self.endpoints else 0)
+        last: OSError | None = None
+        for i in range(len(self.endpoints)):
+            ep = self.endpoints[(start + i) % len(self.endpoints)]
+            host, _, port = ep.rpartition(":")
+            try:
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=self._dial_timeout
+                )
+            except OSError as e:
+                last = e
+                continue
+            if sock.getsockname() == sock.getpeername():
+                # TCP simultaneous-open self-connect: dialing a loopback
+                # ephemeral port with no listener can connect the socket
+                # to itself — not a coordinator.
+                sock.close()
+                last = OSError("self-connected (no listener)")
+                continue
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.address = ep
+            return sock
+        raise last or OSError("no endpoints")
 
     def _read_loop(self) -> None:
         while not self._closed.is_set():
@@ -90,10 +120,14 @@ class RemoteCoord(CoordBackend):
                 msg = wire.recv_msg(self._sock)
             except (wire.WireError, OSError):
                 # Connection lost: fail outstanding requests (their
-                # callers retry — registry keepalive, balancer) and try
-                # to reach the coordinator again (it may be restarting
-                # from its WAL). Deliberate close() skips the re-dial.
+                # callers retry — registry keepalive, balancer), mark
+                # every watch dis-armed, and try to reach a coordinator
+                # again (seed restarting from its WAL, or a standby
+                # taking over). Deliberate close() skips the re-dial.
                 self._fail_pending()
+                with self._watches_lock:
+                    for w in self._watches.values():
+                        w._armed = False
                 if self._closed.is_set() or not self._try_reconnect():
                     break
                 continue
@@ -138,30 +172,74 @@ class RemoteCoord(CoordBackend):
             log.info("coordination connection re-established",
                      kv={"addr": self.address})
             # Re-arm watches on a fresh thread — _call needs this read
-            # loop back in recv. Events between loss and re-watch are
-            # missed; watch consumers re-list on the next event
-            # (registry.WatchService snapshot-then-delta contract).
-            threading.Thread(target=self._rewatch, daemon=True).start()
+            # loop back in recv. The rewatch gate holds OTHER callers'
+            # requests until re-arm completes, so a client's own
+            # post-reconnect write can't race ahead of its watches;
+            # events produced by third parties during the outage are
+            # still missed (watch consumers re-list — the
+            # registry.WatchService snapshot-then-delta contract).
+            # Gen bump + gate clear are atomic (watches lock): a
+            # superseded rewatch thread checking its generation must
+            # never interleave with this clear and re-open the gate.
+            with self._watches_lock:
+                self._rewatch_gen = getattr(self, "_rewatch_gen", 0) + 1
+                gen = self._rewatch_gen
+                self._rewatch_gate.clear()
+            t = threading.Thread(target=self._rewatch,
+                                 args=(gen,), daemon=True)
+            self._rewatch_thread = t
+            t.start()
             return True
         return False
 
-    def _rewatch(self) -> None:
-        with self._watches_lock:
-            existing, self._watches = list(self._watches.values()), {}
-        for w in existing:
-            if w.closed:
-                continue
-            try:
-                new_id = self._call("watch", prefix=w.prefix)
-            except CoordinationError:
-                # Keep the watch registered under its old id: this
-                # connection is bad, the next reconnect cycle retries.
+    def _rewatch(self, gen: int) -> None:
+        """Re-arm every dis-armed watch, RETRYING until all are live (a
+        one-shot attempt whose failure waits for the *next* disconnect
+        leaves watches dead forever on a healthy connection). A newer
+        reconnect's rewatch (gen bump) supersedes this one — watches it
+        didn't finish stay dis-armed and the successor picks them up."""
+        def current() -> bool:
+            return gen == getattr(self, "_rewatch_gen", gen)
+
+        first = True
+        try:
+            while not self._closed.is_set() and current():
                 with self._watches_lock:
-                    self._watches[w.id] = w
-                continue
-            w.id = new_id
+                    todo = [w for w in self._watches.values()
+                            if not w.closed
+                            and not getattr(w, "_armed", True)]
+                for w in todo:
+                    try:
+                        new_id = self._call("watch", prefix=w.prefix)
+                    except CoordinationError:
+                        continue  # retried next round
+                    with self._watches_lock:
+                        if self._watches.pop(w.id, None) is not None:
+                            w.id = new_id
+                            w._armed = True
+                            # Signal consumers to re-list: events between
+                            # the loss and this re-arm were missed.
+                            w.epoch += 1
+                            self._watches[new_id] = w
+                if first:
+                    with self._watches_lock:
+                        if current():
+                            self._rewatch_gate.set()
+                    first = False
+                with self._watches_lock:
+                    if not any(not w.closed
+                               and not getattr(w, "_armed", True)
+                               for w in self._watches.values()):
+                        return
+                time.sleep(0.5)
+        finally:
+            # A superseded generation must NOT open the gate — its
+            # successor cleared it and is still re-arming; opening it
+            # here would let a caller's write race ahead of its watches.
+            # (Atomic with the successor's bump+clear via the lock.)
             with self._watches_lock:
-                self._watches[new_id] = w
+                if self._closed.is_set() or current():
+                    self._rewatch_gate.set()
 
     def _dispatch_watch(self, msg: dict) -> None:
         with self._watches_lock:
@@ -182,6 +260,11 @@ class RemoteCoord(CoordBackend):
     def _call(self, op: str, reply_timeout: float | None = None, **kwargs):
         if self._closed.is_set():
             raise CoordinationError(f"coordination connection to {self.address} closed")
+        if (not self._rewatch_gate.is_set()
+                and threading.current_thread() is not self._rewatch_thread):
+            # A reconnect is re-arming watches; hold ordinary traffic so
+            # callers observe their own effects through their watches.
+            self._rewatch_gate.wait(timeout=5.0)
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1

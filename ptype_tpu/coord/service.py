@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 
 from ptype_tpu import logs
 from ptype_tpu.coord import wire
@@ -51,7 +52,19 @@ class CoordServer:
         host, _, port = address.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host or "127.0.0.1", int(port)))
+        # Bind retries: a restarting seed can race its own clients'
+        # reconnect loops — a loopback dial to the (momentarily free)
+        # port can TCP-self-connect and squat it as the dialer's
+        # ephemeral port for an instant. SO_REUSEADDR doesn't cover an
+        # ACTIVE squatter; a short retry does.
+        for attempt in range(50):
+            try:
+                self._sock.bind((host or "127.0.0.1", int(port)))
+                break
+            except OSError:
+                if attempt == 49:
+                    raise
+                time.sleep(0.1)
         self._sock.listen(128)
         self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
         self._closed = threading.Event()

@@ -43,13 +43,19 @@ def recv_msg(sock: socket.socket) -> dict:
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     """Read exactly n bytes into one preallocated buffer (no chunk list
     + join). Uses the native GIL-free reader when built (ptype_tpu.native,
-    the compiled-runtime tier); recv_into otherwise."""
+    the compiled-runtime tier); recv_into otherwise.
+
+    The native path requires a BLOCKING socket: ``settimeout()`` flips
+    the fd to non-blocking and raw ``recv(2)`` then returns EAGAIN
+    immediately (observed as spurious probe failures in the standby) —
+    Python's own recv hides this behind a selector wait, so timed
+    sockets take the Python path."""
     buf = bytearray(n)
     view = memoryview(buf)
     try:
         from ptype_tpu import native
 
-        if native.available():
+        if native.available() and sock.gettimeout() is None:
             got = native.recv_exact_into(sock, view)
             if got < n:
                 raise WireError("connection closed")

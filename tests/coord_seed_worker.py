@@ -1,0 +1,24 @@
+"""Subprocess hosting a coordination seed — the kill -9 target of the
+failover test. Usage: python tests/coord_seed_worker.py <addr> <data_dir>
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ptype_tpu.coord.service import CoordServer  # noqa: E402
+
+
+def main() -> None:
+    addr, data_dir = sys.argv[1], sys.argv[2]
+    server = CoordServer(addr, data_dir=data_dir)
+    print(json.dumps({"ready": True, "addr": server.address,
+                      "pid": os.getpid()}), flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,25 @@ def test_async_save_and_gc(tmp_path):
     assert ckpt.latest_step() == 4
 
 
+def test_async_save_failure_surfaces_on_wait(tmp_path, monkeypatch):
+    """A background write that fails (e.g. the multi-controller barrier
+    timeout) must re-raise from wait()/the next save — not die silently
+    with its daemon thread while training continues uncheckpointed."""
+    ckpt = Checkpointer(str(tmp_path))
+
+    def boom(*a, **k):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(ckpt, "_write", boom)
+    ckpt.async_save(1, _tree())
+    with pytest.raises(ClusterError, match="async checkpoint save"):
+        ckpt.wait()
+    # The error is consumed: the checkpointer is usable again.
+    monkeypatch.undo()
+    ckpt.save(2, _tree())
+    assert ckpt.latest_step() == 2
+
+
 def test_incomplete_checkpoint_ignored(tmp_path):
     ckpt = Checkpointer(str(tmp_path))
     ckpt.save(1, _tree())

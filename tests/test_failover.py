@@ -1,0 +1,193 @@
+"""Coordinator failover: kill -9 the seed, the warm standby takes over.
+
+The availability story the reference got from raft quorum
+(cluster.go:120-147), rebuilt as primary + WAL-sharing standby
+(coord/standby.py). The seed runs in a SUBPROCESS and dies by SIGKILL
+mid-churn — no graceful close; the standby detects the death by probe,
+replays the shared WAL, and the SAME client objects (endpoint-list
+RemoteCoord) ride their reconnect loop onto it. Asserts: zero lost
+registrations after one TTL, watches still deliver, KV intact.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ptype_tpu.coord.remote import RemoteCoord
+from ptype_tpu.coord.standby import Standby
+from ptype_tpu.errors import CoordinationError
+from ptype_tpu.registry import CoordRegistry
+
+SEED = os.path.join(os.path.dirname(__file__), "coord_seed_worker.py")
+TTL = 1.0
+
+
+def _start_seed(addr: str, data_dir: str) -> subprocess.Popen:
+    p = subprocess.Popen(
+        [sys.executable, SEED, addr, data_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = p.stdout.readline()
+    assert line.startswith("{"), f"seed died: {p.stderr.read()[-2000:]}"
+    assert json.loads(line)["ready"]
+    return p
+
+
+def test_standby_takes_over_after_seed_sigkill(tmp_path, free_port_pair):
+    primary_addr, standby_addr = free_port_pair
+    data_dir = str(tmp_path / "coord")
+    seed = _start_seed(primary_addr, data_dir)
+    standby = Standby(primary_addr, standby_addr, data_dir,
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0)
+    registry = CoordRegistry(coord, lease_ttl=TTL)
+    try:
+        # Live registrations with keepalive + a watch + KV state.
+        regs = [registry.register("svc", f"node{i}", "127.0.0.1",
+                                  7000 + i) for i in range(3)]
+        watch = registry.watch_service("svc")
+        assert len(watch.get(timeout=5)) == 3  # snapshot
+        coord.put("store/answer", "42")
+
+        # Churn right up to (and across) the kill.
+        churn = registry.register("svc", "churner", "127.0.0.1", 7999)
+
+        assert not standby.promoted.is_set()
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+
+        assert standby.promoted.wait(timeout=10), (
+            "standby never promoted after seed SIGKILL")
+
+        # Within ~one TTL the clients must be whole again: keepalives
+        # reclaim replayed leases (or re-register on lease loss), so
+        # ZERO registrations are lost.
+        deadline = time.monotonic() + 10 * TTL
+        want = {7000, 7001, 7002, 7999}
+        ports: set = set()
+        while time.monotonic() < deadline:
+            try:
+                # In-flight calls can race the client's reconnect and
+                # surface CoordinationError — callers retry, exactly
+                # like the registry keepalive does.
+                ports = {n.port for n in
+                         registry.services().get("svc", [])}
+            except CoordinationError:
+                ports = set()
+            if ports == want:
+                break
+            time.sleep(0.1)
+        assert ports == want, f"lost registrations after failover: " \
+                              f"{want - ports}"
+
+        # KV survived via the WAL replay.
+        got = coord.range("store/answer")
+        assert [it.value for it in got.items] == ["42"]
+
+        # Watches re-armed: a post-failover registration is delivered
+        # as a fresh node-set snapshot containing the new endpoint.
+        registry.register("svc", "late", "127.0.0.1", 7100)
+        deadline = time.monotonic() + 5
+        seen_late = False
+        while time.monotonic() < deadline and not seen_late:
+            snap = watch.get(timeout=1)
+            if snap and 7100 in {n.port for n in snap}:
+                seen_late = True
+        assert seen_late, "watch stream dead after failover"
+
+        # And churn keeps working: deregistration propagates.
+        churn.close(revoke=True)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if 7999 not in {n.port for n in
+                            registry.services().get("svc", [])}:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("deregistration lost after failover")
+        for r in regs:
+            r.close()
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
+def test_standby_does_not_promote_while_primary_lives(tmp_path,
+                                                      free_port_pair):
+    primary_addr, standby_addr = free_port_pair
+    data_dir = str(tmp_path / "coord")
+    seed = _start_seed(primary_addr, data_dir)
+    standby = Standby(primary_addr, standby_addr, data_dir,
+                      check_interval=0.1, failure_threshold=3,
+                      probe_timeout=0.5)
+    try:
+        time.sleep(1.5)  # many probe rounds
+        assert not standby.promoted.is_set()
+        assert standby.server is None
+    finally:
+        standby.close()
+        seed.kill()
+        seed.wait(timeout=10)
+
+
+def test_wal_fence_refuses_second_coordinator(tmp_path):
+    """Split-brain fence: while a coordinator holds the WAL-dir flock,
+    a second CoordState on the same data_dir must refuse to start —
+    promotion against a wedged-but-alive primary fails loudly instead
+    of interleaving two writers into one WAL."""
+    from ptype_tpu.coord.core import CoordState
+
+    first = CoordState(data_dir=str(tmp_path))
+    try:
+        with pytest.raises(RuntimeError, match="locked by a live"):
+            CoordState(data_dir=str(tmp_path))
+    finally:
+        first.close()
+    # Fence released on close: a successor starts cleanly.
+    second = CoordState(data_dir=str(tmp_path))
+    second.close()
+
+
+def test_standby_retries_promotion_while_fence_held(tmp_path,
+                                                    free_port_pair):
+    """A wedged-but-alive primary: probes fail (no server on the
+    address) but the WAL fence is still held — the standby must keep
+    retrying, then promote once the fence drops."""
+    from ptype_tpu.coord.core import CoordState
+
+    primary_addr, standby_addr = free_port_pair
+    data_dir = str(tmp_path / "coord")
+    wedged = CoordState(data_dir=data_dir)  # holds the fence, serves nothing
+    standby = Standby(primary_addr, standby_addr, data_dir,
+                      check_interval=0.1, failure_threshold=2,
+                      probe_timeout=0.3)
+    try:
+        assert not standby.promoted.wait(timeout=1.5), (
+            "standby promoted through a held WAL fence")
+        wedged.close()  # primary truly dies; fence drops
+        assert standby.promoted.wait(timeout=5), (
+            "standby did not promote after the fence dropped")
+    finally:
+        standby.close()
+
+
+@pytest.fixture
+def free_port_pair():
+    import socket
+
+    socks = [socket.socket(), socket.socket()]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    addrs = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    return addrs
