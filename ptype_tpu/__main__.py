@@ -11,6 +11,10 @@ operations need. Commands:
 - ``train``  — join + train ($PRESET/$STEPS/$BATCH/$SEQ/$MODE as in
                examples/optimus/trainer.py)
 - ``bench``  — the headline one-line JSON benchmark
+- ``standby`` — warm-standby coordinator: probe the seed, take over on
+               failure ($STANDBY_ADDR to listen on; the platform
+               config supplies coordinator_address + data_dir).
+               ``kill -USR1`` (or ^C twice) for operator switchover.
 """
 
 from __future__ import annotations
@@ -110,12 +114,43 @@ def _bench() -> None:
     mod.main()
 
 
+def _standby() -> None:
+    import os
+    import signal
+
+    from ptype_tpu import config_from_env
+    from ptype_tpu.coord.standby import Standby
+
+    cfg = config_from_env()
+    listen = os.environ.get("STANDBY_ADDR")
+    if not listen:
+        print("standby: set STANDBY_ADDR=host:port (the address this "
+              "standby serves on after takeover)", file=sys.stderr)
+        raise SystemExit(2)
+    data_dir = os.path.join(cfg.platform.data_dir, "coord")
+    if not cfg.platform.data_dir:
+        print("standby: platform config needs data_dir (the seed's WAL "
+              "directory, shared)", file=sys.stderr)
+        raise SystemExit(2)
+    sb = Standby(cfg.platform.coordinator_address, listen, data_dir)
+    signal.signal(signal.SIGUSR1, lambda *_: sb.promote())
+    print(f"standby for {cfg.platform.coordinator_address}; will serve "
+          f"on {listen} (SIGUSR1 = switchover)", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sb.close()
+
+
 COMMANDS = {
     "info": _info,
     "join": _join,
     "serve": _serve,
     "train": _train,
     "bench": _bench,
+    "standby": _standby,
 }
 
 

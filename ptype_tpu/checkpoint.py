@@ -252,10 +252,11 @@ class Checkpointer:
                 fname = f"{key}.p{pid}.shard{i}.npy"
                 files.append(_save_shard(final, fname, start, data))
             manifest["leaves"][key] = {**meta, "shards": files}
-        _atomic_write(final, f"manifest.p{pid}.json",
-                      json.dumps(manifest))
+        mf_name = f"manifest.p{pid}.json"
+        mf_json = json.dumps(manifest)
+        _atomic_write(final, mf_name, mf_json)
+        deadline = time.monotonic() + self.barrier_timeout
         if pid == 0:
-            deadline = time.monotonic() + self.barrier_timeout
             pat = os.path.join(final, "manifest.p*.json")
             while len(_glob.glob(pat)) < nproc:
                 if time.monotonic() > deadline:
@@ -273,6 +274,22 @@ class Checkpointer:
                 _atomic_write(final, fname, text)
             _atomic_write(final, _COMPLETE, "ok\n")
             self._gc()
+        else:
+            # Hold until process 0 commits, RE-ASSERTING our manifest:
+            # a peer that outran process 0 has its manifest swept by
+            # p0's stale-debris cleanup — rewriting it (idempotent,
+            # shards unchanged) turns that race into at most a ~1 s
+            # delay instead of a spurious barrier timeout.
+            marker = os.path.join(final, _COMPLETE)
+            mf_path = os.path.join(final, mf_name)
+            while not os.path.exists(marker):
+                if time.monotonic() > deadline:
+                    raise ClusterError(
+                        f"checkpoint step {step}: process 0 did not "
+                        f"commit within {self.barrier_timeout}s")
+                if not os.path.exists(mf_path):
+                    _atomic_write(final, mf_name, mf_json)
+                time.sleep(0.2)
         log.info("checkpoint shards saved",
                  kv={"step": step, "dir": final, "process": pid})
         return final

@@ -49,23 +49,33 @@ class CoordServer:
                  state: CoordState | None = None,
                  data_dir: str | None = None):
         self.state = state or CoordState(data_dir=data_dir)
+        self._owns_state = state is None
         host, _, port = address.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # Bind retries: a restarting seed can race its own clients'
-        # reconnect loops — a loopback dial to the (momentarily free)
-        # port can TCP-self-connect and squat it as the dialer's
-        # ephemeral port for an instant. SO_REUSEADDR doesn't cover an
-        # ACTIVE squatter; a short retry does.
-        for attempt in range(50):
-            try:
-                self._sock.bind((host or "127.0.0.1", int(port)))
-                break
-            except OSError:
-                if attempt == 49:
-                    raise
-                time.sleep(0.1)
-        self._sock.listen(128)
+        try:
+            # Bind retries: a restarting seed can race its own clients'
+            # reconnect loops — a loopback dial to the (momentarily
+            # free) port can TCP-self-connect and squat it as the
+            # dialer's ephemeral port for an instant. SO_REUSEADDR
+            # doesn't cover an ACTIVE squatter; a short retry does.
+            for attempt in range(50):
+                try:
+                    self._sock.bind((host or "127.0.0.1", int(port)))
+                    break
+                except OSError:
+                    if attempt == 49:
+                        raise
+                    time.sleep(0.1)
+            self._sock.listen(128)
+        except OSError:
+            # A leaked CoordState would hold the WAL-dir flock forever
+            # (its sweeper thread pins it against GC), wedging every
+            # future promotion in this process — release it.
+            self._sock.close()
+            if self._owns_state:
+                self.state.close()
+            raise
         self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
         self._closed = threading.Event()
         self._conns: set[socket.socket] = set()

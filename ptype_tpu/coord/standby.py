@@ -137,6 +137,40 @@ class Standby:
 
     # ------------------------------------------------------------- admin
 
+    def promote(self, timeout: float = 30.0) -> "CoordServer":
+        """Operator-triggered switchover — the analog of the reference's
+        learner PROMOTE (cluster.go:183-195): stop monitoring, wait for
+        the primary to release the WAL fence (shut it down first), and
+        serve. Returns the live server; raises on fence timeout."""
+        import time as _time
+
+        if self.promoted.is_set() and self.server is not None:
+            return self.server  # idempotent: already serving
+        self._closed.set()  # stop the monitor; we promote deliberately
+        self._thread.join(timeout=5)
+        # The monitor may have completed an AUTOMATIC promotion while we
+        # were joining it — spinning against our own server's WAL fence
+        # would misdiagnose as "primary still alive".
+        if self.promoted.is_set() and self.server is not None:
+            return self.server
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                self.server = CoordServer(self.listen_address,
+                                          data_dir=self.data_dir)
+                break
+            except Exception as e:  # noqa: BLE001 — fence still held
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"promote: primary still holds the WAL fence "
+                        f"after {timeout}s — shut it down first"
+                    ) from e
+                _time.sleep(0.2)
+        self.promoted.set()
+        log.info("standby promoted by operator",
+                 kv={"standby": self.listen_address})
+        return self.server
+
     def close(self) -> None:
         """Stop monitoring; shut the promoted server down if any."""
         self._closed.set()

@@ -117,13 +117,18 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq"):
     def attn_fn(q, k, v, cfg):
         # K/V enter at kv_heads (GQA-native — no repeat): the ring
         # rotates blocks G× smaller than the round-2 repeat-first
-        # lowering. Only when a "model" axis shards heads and the kv
-        # head count doesn't divide it (so per-device q/kv group
-        # alignment would break) do we fall back to repeating.
+        # lowering. When a "model" axis shards heads and the kv head
+        # count doesn't divide it, pad MINIMALLY (rep = m/gcd(K, m),
+        # like Ulysses) so per-device q/kv groups stay aligned — full
+        # repeat to H only as the last resort when even the padded
+        # count can't group-align with H.
         H, K = q.shape[2], k.shape[2]
         if head_axis and K % int(mesh.shape[head_axis]):
-            k = jnp.repeat(k, H // K, axis=2)
-            v = jnp.repeat(v, H // K, axis=2)
+            m = int(mesh.shape[head_axis])
+            rep = m // math.gcd(K, m)
+            rep = rep if H % (K * rep) == 0 else H // K
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         body = shard_map(
             partial(_ring_body, axis=axis, n_blocks=n, causal=cfg.causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

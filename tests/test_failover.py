@@ -139,6 +139,41 @@ def test_standby_does_not_promote_while_primary_lives(tmp_path,
         seed.wait(timeout=10)
 
 
+def test_operator_switchover(tmp_path, free_port_pair):
+    """Graceful promote (the learner-PROMOTE analog): operator shuts
+    the primary down, promotes the standby, clients fail over and the
+    state is intact."""
+    primary_addr, standby_addr = free_port_pair
+    data_dir = str(tmp_path / "coord")
+    seed = _start_seed(primary_addr, data_dir)
+    standby = Standby(primary_addr, standby_addr, data_dir,
+                      check_interval=5.0, failure_threshold=1000)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0)
+    try:
+        coord.put("store/k", "v1")
+        seed.terminate()  # graceful shutdown releases the WAL fence
+        seed.wait(timeout=10)
+        server = standby.promote(timeout=10)
+        assert server is standby.server and standby.promoted.is_set()
+        deadline = time.monotonic() + 10
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                res = coord.range("store/k")
+                val = res.items[0].value if res.items else None
+                break
+            except CoordinationError:
+                time.sleep(0.1)
+        assert val == "v1"
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 def test_wal_fence_refuses_second_coordinator(tmp_path):
     """Split-brain fence: while a coordinator holds the WAL-dir flock,
     a second CoordState on the same data_dir must refuse to start —

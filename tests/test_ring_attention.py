@@ -151,6 +151,24 @@ def test_ulysses_gqa_indivisible_heads_pads_minimally():
         f"got {sizes}")
 
 
+def test_ring_gqa_with_model_axis_pads_minimally():
+    """seq×model mesh where kv_heads doesn't divide the model axis:
+    the fallback pads K/V minimally (to lcm alignment), not to H, and
+    the numerics still match dense."""
+    mesh = build_mesh({"seq": 2, "model": 2})
+    q, k, v = _qkv(jax.random.PRNGKey(10), H=8, K=1)
+    attn = make_ring_attention(mesh)
+    got = jax.jit(lambda q, k, v: attn(q, k, v, CFG))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_dense(q, k, v)), rtol=2e-4, atol=2e-4
+    )
+    sizes = _collective_kv_heads(
+        lambda q, k, v: attn(q, k, v, CFG), q, k, v, ("ppermute",))
+    assert sizes and all(s <= 2 for s in sizes), (
+        f"fallback should pad K=1 to 2 heads (lcm with model=2), "
+        f"not H=8; ppermute head sizes: {sizes}")
+
+
 def test_ulysses_matches_dense():
     mesh = build_mesh({"seq": 4})
     q, k, v = _qkv(jax.random.PRNGKey(3))

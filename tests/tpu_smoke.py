@@ -36,6 +36,10 @@ def main() -> int:
     shapes = [
         ("optimus-125m-shaped", 2, 1024, 6, 6, 128),
         ("gqa-Dh64", 2, 512, 8, 2, 64),
+        # Long-context: S=8192 streams K/V through the grid (VMEM is
+        # O(block), not O(S)) at llama-like GQA grouping — the shape
+        # class the long-context story depends on.
+        ("long-context-8k", 1, 8192, 8, 2, 128),
     ]
     for name, B, S, H, K, Dh in shapes:
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
