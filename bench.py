@@ -86,9 +86,14 @@ def worker_main() -> None:
     # regression must degrade to a dense-attention baseline number, never
     # zero the round (VERDICT r2 weak #2 — round 2 emitted nothing
     # because every rung shared the one broken kernel).
+    # remat is "dots" | True | False: "dots" = jax.checkpoint with the
+    # dots-saveable policy — the round-3 sweep's best plan (0.448 MFU
+    # vs 0.445 no-remat, 0.434 b=24, 0.328 scan_unroll=2; b=32 no-remat
+    # crashes the v5e remote-compile helper, which is why the b=16
+    # rung leads).
     if on_tpu:
         preset_name = "optimus-125m"
-        plans = [(32, 1024, 30, 3, False, "flash"),
+        plans = [(16, 1024, 30, 3, "dots", "flash"),
                  (16, 1024, 30, 3, False, "flash"),
                  (8, 1024, 20, 3, True, "flash"),
                  (16, 1024, 30, 3, False, "xla"),
@@ -107,9 +112,12 @@ def worker_main() -> None:
     last_err = None
     for pcb, seq, steps, warmup, remat, attn in plans:
         try:
-            cfg = tfm.preset(preset_name, remat=remat, attn_impl=attn)
+            cfg = tfm.preset(
+                preset_name, remat=bool(remat), attn_impl=attn,
+                remat_policy="dots" if remat == "dots" else "none")
             out, tokens, dt = _run(cfg, devices, pcb, seq, steps, warmup)
             batch_used, seq_used, attn_used = pcb * n_chips, seq, attn
+            remat_used = remat
             break
         except Exception as e:  # noqa: BLE001 — report, try next plan
             last_err = e
@@ -154,6 +162,7 @@ def worker_main() -> None:
         "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
         "mfu": round(achieved_mfu, 4),
         "attn": attn_used,
+        "remat": str(remat_used),
         "n_chips": n_chips,
         "batch": batch_used,
         "seq": seq_used,

@@ -14,7 +14,7 @@ operations need. Commands:
 - ``standby`` — warm-standby coordinator: probe the seed, take over on
                failure ($STANDBY_ADDR to listen on; the platform
                config supplies coordinator_address + data_dir).
-               ``kill -USR1`` (or ^C twice) for operator switchover.
+               ``kill -USR1`` for operator switchover; ^C exits.
 """
 
 from __future__ import annotations
@@ -133,7 +133,18 @@ def _standby() -> None:
               "directory, shared)", file=sys.stderr)
         raise SystemExit(2)
     sb = Standby(cfg.platform.coordinator_address, listen, data_dir)
-    signal.signal(signal.SIGUSR1, lambda *_: sb.promote())
+
+    def _switchover(*_):
+        # promote() raises if the primary still holds the WAL fence
+        # (and re-arms monitoring); a raise out of a signal handler
+        # would tear down the whole standby process.
+        try:
+            sb.promote()
+        except RuntimeError as e:
+            print(f"standby: switchover refused: {e}", file=sys.stderr,
+                  flush=True)
+
+    signal.signal(signal.SIGUSR1, _switchover)
     print(f"standby for {cfg.platform.coordinator_address}; will serve "
           f"on {listen} (SIGUSR1 = switchover)", flush=True)
     try:

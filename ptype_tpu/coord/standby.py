@@ -161,6 +161,16 @@ class Standby:
                 break
             except Exception as e:  # noqa: BLE001 — fence still held
                 if _time.monotonic() > deadline:
+                    # Re-arm automatic failover before surfacing the
+                    # error: a caller that catches it expects the
+                    # standby to keep guarding the (still-live)
+                    # primary, and the monitor thread was stopped
+                    # above.
+                    self._closed.clear()
+                    self._thread = threading.Thread(
+                        target=self._monitor, name="coord-standby",
+                        daemon=True)
+                    self._thread.start()
                     raise RuntimeError(
                         f"promote: primary still holds the WAL fence "
                         f"after {timeout}s — shut it down first"

@@ -52,6 +52,17 @@ class TransformerConfig:
     tie_embeddings: bool = True
     #: Rematerialize each block in backward (jax.checkpoint).
     remat: bool = False
+    #: jax.checkpoint policy when ``remat``: "none" saves nothing
+    #: (recompute everything), "dots" saves matmul outputs but
+    #: recomputes the cheap elementwise chains (norms, RoPE, SwiGLU
+    #: products) — the usual HBM-vs-FLOPs middle ground.
+    remat_policy: str = "none"
+    #: ``lax.scan`` unroll factor for the layer stack. Measured on v5e
+    #: at 125M: unroll>1 is ~25% SLOWER (0.33 vs 0.45 MFU — the
+    #: unrolled body loses the loop-level overlap scheduling), so the
+    #: default stays 1; the knob exists because the tradeoff flips with
+    #: model size and backend generation.
+    scan_unroll: int = 1
     #: Causal (decoder) vs. bidirectional (encoder/BERT) attention.
     causal: bool = True
     #: Attention lowering, resolved by :func:`resolve_attn_fn`:
@@ -414,8 +425,10 @@ def hidden_with_aux(params: dict, tokens: jax.Array,
         return x, aux
 
     if cfg.remat:
-        body = jax.checkpoint(body)
-    x, auxs = lax.scan(body, x, params["blocks"])
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        body = jax.checkpoint(body, policy=policy)
+    x, auxs = lax.scan(body, x, params["blocks"], unroll=cfg.scan_unroll)
     return rms_norm(x, params["final_norm"]), jnp.sum(auxs)
 
 

@@ -215,6 +215,32 @@ def test_standby_retries_promotion_while_fence_held(tmp_path,
         standby.close()
 
 
+def test_failed_operator_promote_rearms_monitor(tmp_path, free_port_pair):
+    """promote() against a live primary raises — but the standby must
+    KEEP guarding afterwards: a caller that catches the error expects
+    automatic failover to still be armed (the monitor was stopped
+    during the deliberate-promotion attempt)."""
+    primary_addr, standby_addr = free_port_pair
+    data_dir = str(tmp_path / "coord")
+    seed = _start_seed(primary_addr, data_dir)
+    standby = Standby(primary_addr, standby_addr, data_dir,
+                      check_interval=0.1, failure_threshold=2,
+                      probe_timeout=0.3)
+    try:
+        with pytest.raises(RuntimeError, match="WAL fence"):
+            standby.promote(timeout=1.0)  # primary alive: fence held
+        # The failed attempt must have re-armed automatic failover.
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10), (
+            "monitor not re-armed after failed operator promote")
+    finally:
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket

