@@ -13,7 +13,9 @@ operations need. Commands:
 - ``bench``  — the headline one-line JSON benchmark
 - ``standby`` — warm-standby coordinator: probe the seed, take over on
                failure ($STANDBY_ADDR to listen on; the platform
-               config supplies coordinator_address + data_dir).
+               config supplies coordinator_address + data_dir;
+               $STANDBY_REPLICATE=1 streams the WAL cross-host
+               instead of assuming a shared data_dir).
                ``kill -USR1`` for operator switchover; ^C exits.
 """
 
@@ -132,7 +134,10 @@ def _standby() -> None:
         print("standby: platform config needs data_dir (the seed's WAL "
               "directory, shared)", file=sys.stderr)
         raise SystemExit(2)
-    sb = Standby(cfg.platform.coordinator_address, listen, data_dir)
+    # STANDBY_REPLICATE=1: cross-host mode — data_dir is local and a
+    # WalFollower streams the primary's WAL into it (no shared fs).
+    sb = Standby(cfg.platform.coordinator_address, listen, data_dir,
+                 replicate=os.environ.get("STANDBY_REPLICATE") == "1")
 
     def _switchover(*_):
         # promote() raises if the primary still holds the WAL fence

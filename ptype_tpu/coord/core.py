@@ -217,6 +217,60 @@ class Watch:
                 yield ev
 
 
+class ReplFeed:
+    """A follower's view of the primary's WAL: one ``("snap", dict)``
+    item with the full state at subscribe time (and after each
+    compaction), then a ``("rec", dict)`` item per mutation, in commit
+    order. Consumed by the WAL-shipping standby
+    (:class:`ptype_tpu.coord.standby.WalFollower`); the queue is
+    unbounded — control-plane mutation volume is leases + registry
+    churn, and a follower that stops draining loses its connection
+    (service.py pump) which cancels the feed.
+    """
+
+    def __init__(self, feed_id: int, cancel_fn):
+        self.id = feed_id
+        self._cancel_fn = cancel_fn
+        self._cond = threading.Condition()
+        self._items: list[tuple[str, dict]] = []
+        self._closed = False
+
+    def _push(self, kind: str, data: dict) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._items.append((kind, data))
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> list[tuple[str, dict]]:
+        """Block for the next batch; [] on timeout or close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._items and not self._closed:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                self._cond.wait(remaining)
+            batch, self._items = self._items, []
+            return batch
+
+    def cancel(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._cancel_fn(self)
+
+    close = cancel
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
 class CoordState:
     """Single-lock linearizable KV + leases + watches + members + barriers.
 
@@ -256,6 +310,8 @@ class CoordState:
         self._compact_every = compact_every
         self._data_dir = data_dir
         self._flock = None
+        self._repl_feeds: list[ReplFeed] = []
+        self._next_repl = 1
         if data_dir:
             import fcntl
             import os
@@ -297,6 +353,8 @@ class CoordState:
 
     def _append(self, rec: dict) -> None:
         """Log one mutation (called under the lock, before ack)."""
+        for feed in self._repl_feeds:
+            feed._push("rec", rec)
         if self._wal is None:
             return
         import json
@@ -307,12 +365,9 @@ class CoordState:
         if self._wal_count >= self._compact_every:
             self._compact()
 
-    def _compact(self) -> None:
-        """Snapshot full state, truncate the WAL (under the lock)."""
-        import json
-        import os
-
-        snap = {
+    def _snapshot_dict(self) -> dict:
+        """Full state in ``coord.snap`` format (called under the lock)."""
+        return {
             "rev": self._rev,
             "next_lease": self._next_lease,
             "next_member": self._next_member,
@@ -331,6 +386,15 @@ class CoordState:
                 for m in self._members.values()
             ],
         }
+
+    def _compact(self) -> None:
+        """Snapshot full state, truncate the WAL (under the lock)."""
+        import json
+        import os
+
+        snap = self._snapshot_dict()
+        for feed in self._repl_feeds:
+            feed._push("snap", snap)
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
@@ -580,6 +644,29 @@ class CoordState:
             if w in self._watches:
                 self._watches.remove(w)
 
+    # ---------------------------------------------------------- replication
+
+    def repl_subscribe(self) -> ReplFeed:
+        """Subscribe a WAL follower: the feed's first item is a full
+        state snapshot taken atomically with the subscription (no
+        mutation can fall between the snapshot and the record stream),
+        then every subsequent mutation's WAL record in commit order.
+        The standby's :class:`~ptype_tpu.coord.standby.WalFollower`
+        mirrors these into its own data_dir so promotion replays
+        locally — control-plane failover without a shared filesystem.
+        """
+        with self._lock:
+            feed = ReplFeed(self._next_repl, self._remove_repl)
+            self._next_repl += 1
+            feed._push("snap", self._snapshot_dict())
+            self._repl_feeds.append(feed)
+            return feed
+
+    def _remove_repl(self, feed: ReplFeed) -> None:
+        with self._lock:
+            if feed in self._repl_feeds:
+                self._repl_feeds.remove(feed)
+
     def _notify(self, events: list[Event]) -> None:
         # called under self._lock
         for w in self._watches:
@@ -654,6 +741,7 @@ class CoordState:
         self._closed.set()
         with self._lock:
             watches = list(self._watches)
+            feeds = list(self._repl_feeds)
             if self._wal is not None:
                 try:
                     self._wal.close()
@@ -668,3 +756,5 @@ class CoordState:
                 self._flock = None
         for w in watches:
             w.cancel()
+        for feed in feeds:
+            feed.cancel()

@@ -105,6 +105,11 @@ class CoordServer:
     def _serve_conn(self, conn: socket.socket, peer) -> None:
         send_lock = threading.Lock()
         watches: dict[int, Watch] = {}
+        # Repl feeds ride the same per-connection registry so a dropped
+        # follower connection cancels its subscription — otherwise the
+        # primary would append every future mutation to an orphaned
+        # in-memory feed forever.
+        feeds: dict[int, object] = {}
         watches_lock = threading.Lock()
         try:
             while not self._closed.is_set():
@@ -118,13 +123,16 @@ class CoordServer:
                 # than a pool.
                 threading.Thread(
                     target=self._handle,
-                    args=(conn, send_lock, watches, watches_lock, msg),
+                    args=(conn, send_lock, watches, feeds, watches_lock,
+                          msg),
                     daemon=True,
                 ).start()
         finally:
             with watches_lock:
                 for w in watches.values():
                     w.cancel()
+                for feed in feeds.values():
+                    feed.cancel()
             with self._conns_lock:
                 self._conns.discard(conn)
             try:
@@ -132,10 +140,12 @@ class CoordServer:
             except OSError:
                 pass
 
-    def _handle(self, conn, send_lock, watches, watches_lock, msg: dict) -> None:
+    def _handle(self, conn, send_lock, watches, feeds, watches_lock,
+                msg: dict) -> None:
         req_id = msg.get("id")
         op = msg.get("op", "")
         pump_watch: Watch | None = None
+        pump_feed = None
         try:
             if op == "watch":
                 # The pump must not start until the create-reply is on the
@@ -145,6 +155,14 @@ class CoordServer:
                 with watches_lock:
                     watches[pump_watch.id] = pump_watch
                 result = pump_watch.id
+            elif op == "repl_subscribe":
+                # Same ordering contract as watch: the snapshot that
+                # heads the feed must not hit the wire before the
+                # create-reply the follower is blocking on.
+                pump_feed = self.state.repl_subscribe()
+                with watches_lock:
+                    feeds[pump_feed.id] = pump_feed
+                result = pump_feed.id
             else:
                 result = self._dispatch(conn, send_lock, watches,
                                         watches_lock, op, msg)
@@ -154,12 +172,26 @@ class CoordServer:
         try:
             wire.send_msg(conn, send_lock, reply)
         except (wire.WireError, OSError):
+            # The connection died under the reply: nothing will pump
+            # these — cancel now rather than waiting for the reader
+            # thread's cleanup to notice.
+            if pump_watch is not None:
+                pump_watch.cancel()
+            if pump_feed is not None:
+                pump_feed.cancel()
             return
         if pump_watch is not None:
             threading.Thread(
                 target=self._pump_watch,
                 args=(conn, send_lock, watches, watches_lock, pump_watch),
                 name=f"coordd-watch-{pump_watch.id}",
+                daemon=True,
+            ).start()
+        if pump_feed is not None:
+            threading.Thread(
+                target=self._pump_repl,
+                args=(conn, send_lock, feeds, watches_lock, pump_feed),
+                name=f"coordd-repl-{pump_feed.id}",
                 daemon=True,
             ).start()
 
@@ -223,6 +255,27 @@ class CoordServer:
                 w.cancel()
                 with watches_lock:
                     watches.pop(w.id, None)
+                return
+
+    def _pump_repl(self, conn, send_lock, feeds, watches_lock,
+                   feed) -> None:
+        """Stream a ReplFeed to a WAL follower. A follower that stops
+        draining eventually backs TCP up; a send failure cancels the
+        feed (it re-syncs from a fresh snapshot on reconnect)."""
+        while True:
+            batch = feed.get(timeout=1.0)
+            if feed.closed and not batch:
+                return
+            if not batch:
+                continue
+            push = {"repl": feed.id,
+                    "items": [{"kind": k, "data": d} for k, d in batch]}
+            try:
+                wire.send_msg(conn, send_lock, push)
+            except (wire.WireError, OSError):
+                feed.cancel()
+                with watches_lock:
+                    feeds.pop(feed.id, None)
                 return
 
     def close(self) -> None:

@@ -362,7 +362,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: bool | None = None) -> jax.Array:
     """Flash attention over (B, S, H, Dh) tensors (transformer layout).
 
@@ -372,10 +372,13 @@ def flash_attention(q, k, v, causal: bool = True,
     presets use power-of-two seq. ``interpret`` defaults to True on CPU
     backends so tests validate the kernel without a TPU.
 
-    Default blocks are large (512×1024): the grid-step count, not
-    VMEM, bounds throughput at these shapes — a measured sweep on v5e
-    at B=16/S=1024 runs 128×128 blocks 3.3× slower than 512+ blocks
-    (per-step overhead dominates the tiny (128, Dh) MXU tiles).
+    Default blocks are large (1024×1024): the grid-step count, not
+    VMEM, bounds throughput at these shapes — measured on v5e at the
+    125M train config (B=16/S=1024, dots-remat): 1024×1024 0.457 MFU,
+    512×1024 0.442, 512×512 0.422, 256×512 0.402, and 128×128 blocks
+    3.3× slower than 512+ (per-step overhead dominates the tiny
+    (128, Dh) MXU tiles). VMEM stays O(block): ~2.5 MB/program at
+    Dh=128 even at S=8192.
     """
     if interpret is None:
         interpret = _on_cpu()
@@ -397,7 +400,7 @@ def flash_attention(q, k, v, causal: bool = True,
     return jnp.swapaxes(o, 1, 2)
 
 
-def make_flash_attn_fn(block_q: int = 512, block_k: int = 1024):
+def make_flash_attn_fn(block_q: int = 1024, block_k: int = 1024):
     """attn_fn(q, k, v, cfg) for models/transformer.forward — the
     ``attn_impl="flash"`` lowering. Shapes the kernel can't tile
     (seq not divisible by the clamped block sizes — e.g. odd decode

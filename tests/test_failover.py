@@ -241,6 +241,118 @@ def test_failed_operator_promote_rearms_monitor(tmp_path, free_port_pair):
             seed.wait(timeout=10)
 
 
+def test_wal_stream_standby_cross_host(tmp_path, free_port_pair):
+    """Cross-host failover: the standby's data_dir is its OWN (no
+    shared filesystem); a WalFollower mirrors the primary's WAL over
+    TCP. SIGKILL the primary → the standby promotes over the mirror
+    with registrations, KV and lease state intact."""
+    from ptype_tpu.coord.standby import WalFollower  # noqa: F401
+
+    primary_addr, standby_addr = free_port_pair
+    primary_dir = str(tmp_path / "primary")   # "host A"
+    standby_dir = str(tmp_path / "standby")   # "host B" — disjoint
+    seed = _start_seed(primary_addr, primary_dir)
+    standby = Standby(primary_addr, standby_addr, standby_dir,
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0)
+    registry = CoordRegistry(coord, lease_ttl=TTL)
+    try:
+        assert standby.follower.synced.wait(timeout=10), (
+            "follower never mirrored the initial snapshot")
+        regs = [registry.register("svc", f"node{i}", "127.0.0.1",
+                                  7000 + i) for i in range(3)]
+        coord.put("store/answer", "42")
+        # Let the mirror catch up (stream is ordered; the last put
+        # landing implies everything before it landed).
+        deadline = time.monotonic() + 10
+        wal = os.path.join(standby_dir, "coord.wal")
+        while time.monotonic() < deadline:
+            if os.path.exists(wal) and "store/answer" in open(wal).read():
+                break
+            time.sleep(0.05)
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10), (
+            "standby never promoted after seed SIGKILL (wal-stream)")
+
+        # Clients ride the endpoint list onto the standby; within ~one
+        # TTL keepalives reclaim the replayed leases: zero lost
+        # registrations, KV intact.
+        deadline = time.monotonic() + TTL * 8
+        nodes, val = [], None
+        while time.monotonic() < deadline:
+            try:
+                nodes = registry.nodes("svc")
+                res = coord.range("store/answer")
+                val = res.items[0].value if res.items else None
+                if len(nodes) == 3 and val == "42":
+                    break
+            except CoordinationError:
+                pass
+            time.sleep(0.1)
+        assert len(nodes) == 3, f"lost registrations: {nodes}"
+        assert val == "42", f"lost KV state: {val!r}"
+        del regs
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
+def test_wal_stream_refuses_promotion_over_unsynced_mirror(
+        tmp_path, free_port_pair):
+    """A replicate-mode standby whose follower NEVER mirrored a
+    snapshot (primary unreachable from the start) must refuse
+    auto-promotion — serving an empty data_dir would silently wipe the
+    control plane."""
+    primary_addr, standby_addr = free_port_pair
+    # No seed: the primary address never answers.
+    standby = Standby(primary_addr, standby_addr,
+                      str(tmp_path / "standby"),
+                      check_interval=0.1, failure_threshold=2,
+                      probe_timeout=0.2, replicate=True)
+    try:
+        assert not standby.promoted.wait(timeout=2.0), (
+            "standby promoted over a never-synced (empty) mirror")
+        assert standby.server is None
+    finally:
+        standby.close()
+
+
+def test_wal_stream_operator_promote_refused_while_primary_lives(
+        tmp_path, free_port_pair):
+    """wal-stream mode has no flock fence: operator promote() while
+    the primary still answers must refuse (split-brain guard) and
+    leave automatic failover armed."""
+    primary_addr, standby_addr = free_port_pair
+    seed = _start_seed(primary_addr, str(tmp_path / "primary"))
+    standby = Standby(primary_addr, standby_addr,
+                      str(tmp_path / "standby"),
+                      check_interval=0.1, failure_threshold=2,
+                      probe_timeout=0.3, replicate=True)
+    try:
+        # Wait for the initial mirror: killing the seed before the
+        # follower's first snapshot would (correctly) trip the
+        # unsynced-mirror refusal instead of exercising the re-arm.
+        assert standby.follower.synced.wait(timeout=10)
+        with pytest.raises(RuntimeError, match="still alive"):
+            standby.promote(timeout=1.0)
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10), (
+            "monitor not re-armed after refused wal-stream promote")
+    finally:
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
