@@ -307,6 +307,7 @@ class CoordState:
         self._sweep_interval = sweep_interval
         self._wal = None
         self._wal_count = 0
+        self._wal_gen = 0
         self._compact_every = compact_every
         self._data_dir = data_dir
         self._flock = None
@@ -365,9 +366,18 @@ class CoordState:
         if self._wal_count >= self._compact_every:
             self._compact()
 
-    def _snapshot_dict(self) -> dict:
-        """Full state in ``coord.snap`` format (called under the lock)."""
+    def _snapshot_dict(self, wal_gen: int | None = None) -> dict:
+        """Full state in ``coord.snap`` format (called under the lock).
+
+        ``wal_gen`` is the generation of WAL records that FOLLOW this
+        snapshot: replay accepts a WAL only when its header generation
+        matches the snapshot's. This closes the crash window between
+        "snapshot replaced" and "WAL truncated" — a stale WAL paired
+        with a fresh snapshot would re-apply already-folded records
+        and diverge (grant ids, revisions).
+        """
         return {
+            "wal_gen": self._wal_gen if wal_gen is None else wal_gen,
             "rev": self._rev,
             "next_lease": self._next_lease,
             "next_member": self._next_member,
@@ -392,15 +402,23 @@ class CoordState:
         import json
         import os
 
-        snap = self._snapshot_dict()
+        new_gen = self._wal_gen + 1
+        snap = self._snapshot_dict(wal_gen=new_gen)
         for feed in self._repl_feeds:
             feed._push("snap", snap)
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
         os.replace(tmp, self._snap_path())
+        # Crash here leaves the new snapshot with the OLD-generation
+        # WAL — replay sees the header mismatch and skips it (those
+        # records are already folded into the snapshot).
         self._wal.close()
         self._wal = open(self._wal_path(), "w", encoding="utf-8")
+        self._wal_gen = new_gen
+        self._wal.write(json.dumps({"o": "hdr", "gen": new_gen},
+                                   separators=(",", ":")) + "\n")
+        self._wal.flush()
         self._wal_count = 0
 
     def _replay(self, data_dir: str) -> None:
@@ -409,9 +427,11 @@ class CoordState:
         import os
 
         snap_path = os.path.join(data_dir, "coord.snap")
+        snap_gen = 0
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
                 snap = json.load(f)
+            snap_gen = snap.get("wal_gen", 0)
             self._rev = snap["rev"]
             self._next_lease = snap["next_lease"]
             self._next_member = snap["next_member"]
@@ -427,9 +447,12 @@ class CoordState:
                 self._members[r["id"]] = Member(
                     id=r["id"], name=r["n"], peer_addr=r["a"],
                     metadata=r["md"])
+        self._wal_gen = snap_gen
         wal_path = os.path.join(data_dir, "coord.wal")
         if os.path.exists(wal_path):
             with open(wal_path, encoding="utf-8") as f:
+                first = True
+                skip = False
                 for line in f:
                     line = line.strip()
                     if not line:
@@ -438,7 +461,22 @@ class CoordState:
                         rec = json.loads(line)
                     except ValueError:
                         break  # torn tail write from a crash — stop here
-                    self._apply(rec)
+                    if first:
+                        first = False
+                        if rec.get("o") == "hdr":
+                            if rec["gen"] != snap_gen:
+                                # Stale WAL beside a newer snapshot (a
+                                # crash between snapshot-replace and
+                                # WAL-truncate): every record here is
+                                # already folded into the snapshot.
+                                skip = True
+                            continue
+                        # Headerless WAL (pre-compaction, or legacy):
+                        # belongs to generation 0 — apply only if the
+                        # snapshot agrees.
+                        skip = snap_gen != 0
+                    if not skip:
+                        self._apply(rec)
         now = time.monotonic()
         for lease in self._leases.values():
             lease.expires_at = now + lease.ttl

@@ -108,6 +108,12 @@ class WalFollower:
             sock.settimeout(None)
             while not self._closed.is_set():
                 msg = wire.recv_msg(sock)
+                # Re-check AFTER the blocking recv: close() may have
+                # promoted this data_dir to a live CoordState while we
+                # were parked — one more mirror write would truncate
+                # the WAL underneath the new primary.
+                if self._closed.is_set():
+                    return
                 for item in msg.get("items", ()):
                     if item["kind"] == "snap":
                         wal = self._mirror_snapshot(item["data"], wal)
@@ -133,26 +139,50 @@ class WalFollower:
         return os.path.join(self.data_dir, "coord.wal")
 
     def _mirror_snapshot(self, snap: dict, wal):
-        """Atomically replace the mirror: snap file first, then an
-        empty WAL — the same commit order _compact uses, so a crash
-        between the two replays at worst a stale-but-consistent pair."""
+        """Replace the mirror: truncate the WAL (stamping the
+        snapshot's generation header) BEFORE replacing the snapshot. A
+        crash between the two leaves the OLD snapshot with a
+        new-generation empty WAL — replay skips the mismatched WAL and
+        recovers the stale-but-consistent old snapshot; the follower
+        re-syncs from a fresh snapshot on its next connect anyway. The
+        reverse order (new snap + old records) would re-apply folded
+        records and diverge on replay."""
         if wal is not None:
             wal.close()
+        gen = snap.get("wal_gen", 0)
+        wal = open(self._wal_path, "w", encoding="utf-8")
+        wal.write(json.dumps({"o": "hdr", "gen": gen},
+                             separators=(",", ":")) + "\n")
+        wal.flush()
         tmp = os.path.join(self.data_dir, "coord.snap.tmp")
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
         os.replace(tmp, os.path.join(self.data_dir, "coord.snap"))
-        return open(self._wal_path, "w", encoding="utf-8")
+        return wal
 
-    def close(self) -> None:
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self) -> bool:
+        """Stop mirroring. Returns True when the follower thread has
+        actually exited — promotion must not serve over this data_dir
+        while a parked reader could still wake up and truncate it."""
         self._closed.set()
         sock = self._sock
         if sock is not None:
             try:
-                sock.close()  # unblock the reader
+                # shutdown() interrupts a thread parked in recv(2);
+                # close() alone does not.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
             except OSError:
                 pass
         self._thread.join(timeout=5)
+        return not self._thread.is_alive()
 
 
 class Standby:
@@ -184,14 +214,26 @@ class Standby:
         # filesystem), and the WAL-dir flock doubles as the
         # split-brain fence.
         self._replicate = replicate
-        self.follower = (WalFollower(primary_address, data_dir)
-                         if replicate else None)
+        self.follower: WalFollower | None = None
         self._thread: threading.Thread | None = None
-        self._start_guarding()
+        self._start_guarding()  # creates the follower in wal-stream mode
         log.info("standby watching primary",
                  kv={"primary": primary_address,
                      "standby": listen_address,
                      "mode": "wal-stream" if replicate else "shared-dir"})
+
+    def _ensure_follower(self) -> None:
+        """wal-stream mode: make sure a LIVE follower is mirroring —
+        replaces one closed by a failed/deferred promotion attempt
+        (guarding with a frozen mirror would promote stale state on
+        the next primary death)."""
+        if not self._replicate:
+            return
+        if self.follower is not None and not self.follower.closed:
+            return
+        if self.follower is not None:
+            self.follower.close()
+        self.follower = WalFollower(self.primary_address, self.data_dir)
 
     def _start_guarding(self) -> None:
         """(Re)arm everything a guarding standby needs: the probe
@@ -199,9 +241,7 @@ class Standby:
         construction and after every failed promotion path — partial
         re-arms (monitor without follower) would leave the standby
         silently guarding with a frozen mirror."""
-        if self._replicate and self.follower is None:
-            self.follower = WalFollower(self.primary_address,
-                                        self.data_dir)
+        self._ensure_follower()
         self._closed.clear()
         self._thread = threading.Thread(
             target=self._monitor, name="coord-standby", daemon=True)
@@ -238,6 +278,9 @@ class Standby:
         while not self._closed.is_set():
             if self._probe():
                 failures = 0
+                # The primary is back after a failed/deferred promotion
+                # attempt that closed the follower: resume mirroring.
+                self._ensure_follower()
             else:
                 failures += 1
                 log.debug("primary probe failed",
@@ -272,7 +315,14 @@ class Standby:
             # Stop mirroring before serving over the mirror: the
             # follower's reconnect loop re-truncating coord.wal under
             # a live CoordState would corrupt the new primary.
-            self.follower.close()
+            if not self.follower.close():
+                # A reader refusing to die (wedged primary holding the
+                # TCP stream mid-push) could wake and truncate the
+                # mirror under the promoted server — retry next probe
+                # round instead of serving over contested files.
+                log.warning("standby promotion deferred: follower "
+                            "thread still live")
+                return False
             self.follower = None
         try:
             # The WAL-dir flock (coord/core.py) is the fence: if the
@@ -284,12 +334,10 @@ class Standby:
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
-            if self._replicate:
-                # Resume mirroring: the primary may come back (no
-                # takeover happened) and a monitor guarding a frozen
-                # mirror would promote stale state on the NEXT death.
-                self.follower = WalFollower(self.primary_address,
-                                            self.data_dir)
+            # Resume mirroring (wal-stream): the primary may come back
+            # (no takeover happened) and a monitor guarding a frozen
+            # mirror would promote stale state on the NEXT death.
+            self._ensure_follower()
             return False
         self.promoted.set()
         return True
@@ -321,7 +369,12 @@ class Standby:
                 raise RuntimeError(
                     "promote: primary is still alive — shut it down "
                     "first (wal-stream mode has no fence)")
-            self.follower.close()
+            if not self.follower.close():
+                self._start_guarding()
+                raise RuntimeError(
+                    "promote: follower reader thread still live — a "
+                    "late wake-up would truncate the mirror under the "
+                    "promoted server; retry once it exits")
             self.follower = None
         deadline = _time.monotonic() + timeout
         while True:

@@ -347,11 +347,22 @@ def _moe_mlp(h, layer, cfg: TransformerConfig, capacity: int | None = None):
     counts = jnp.cumsum(jax.nn.one_hot(flat_e, E, dtype=jnp.int32), axis=0)
     pos = counts[jnp.arange(T * topk), flat_e] - 1
     keep = pos < C
-    slot = jnp.clip(pos, 0, C - 1)
     tok = jnp.arange(T * topk) // topk
 
-    contrib = x[tok] * keep[:, None].astype(x.dtype)
-    X = jnp.zeros((E, C, D), dt).at[flat_e, slot].add(contrib)
+    # Dispatch via the INVERSE index map: scatter each kept assignment's
+    # token id (a single i32) into its (expert, slot) cell — (e, slot)
+    # pairs are unique for kept entries and overflow rides slot=C,
+    # dropped by mode="drop" — then GATHER token rows into the (E, C, D)
+    # buffer. Scattering the D-wide activation rows instead
+    # (``.at[e, slot].add(x[tok])``, the previous lowering) ran 22×
+    # slower on v5e (102 ms vs 4.7 ms fwd+bwd at T=16k, D=768: TPU
+    # scatter serializes; gather vectorizes).
+    slot_oob = jnp.where(keep, pos, C)
+    inv = jnp.zeros((E, C), jnp.int32).at[flat_e, slot_oob].set(
+        tok + 1, mode="drop", unique_indices=True)  # 0 = empty slot
+    X = jnp.where((inv > 0)[..., None],
+                  x[jnp.maximum(inv - 1, 0)].astype(dt), 0)
+    slot = jnp.clip(pos, 0, C - 1)
 
     g = jnp.einsum("ecd,edf->ecf", X, layer["w_gate"].astype(dt))
     u = jnp.einsum("ecd,edf->ecf", X, layer["w_up"].astype(dt))

@@ -95,6 +95,66 @@ def test_wal_compaction_snapshot_roundtrip(tmp_path):
         st2.close()
 
 
+def test_stale_wal_beside_newer_snapshot_skipped(tmp_path):
+    """Crash window between snapshot-replace and WAL-truncate in
+    _compact: the fresh snapshot sits beside the OLD generation's WAL.
+    Replaying those already-folded records would diverge (grant ids,
+    revisions) — the generation header must make replay skip them."""
+    import json
+    import shutil
+
+    st = _mk(tmp_path, compact_every=10)
+    lease = st.grant(30.0)  # a 'g' record: replaying it twice diverges
+    st.put("services/x", "{}", lease=lease)
+    for i in range(15):  # crosses compact_every: one compaction happens
+        st.put(f"store/k{i}", str(i))
+    # Simulate the crash: resurrect the PRE-compaction WAL next to the
+    # post-compaction snapshot (old generation: header gen differs).
+    pre_wal = [json.dumps({"o": "g", "id": lease, "ttl": 30.0}),
+               json.dumps({"o": "p", "k": "services/x", "v": "{}",
+                           "l": lease})]
+    st.close()
+    (tmp_path / "coord.wal").write_text("\n".join(pre_wal) + "\n")
+    snap_rev = json.loads((tmp_path / "coord.snap").read_text())["rev"]
+
+    st2 = _mk(tmp_path)
+    try:
+        # The stale (headerless = generation-0) records beside the
+        # generation-1 snapshot are skipped: recovery lands exactly on
+        # the snapshot — and does NOT raise "WAL replay diverged",
+        # which re-applying the 'g' grant would.
+        assert st2.revision == snap_rev
+        assert st2.range("services/x").count == 1
+    finally:
+        st2.close()
+    shutil.rmtree(tmp_path)
+
+
+def test_follower_mirror_crash_window_recovers(tmp_path):
+    """The follower's truncate-then-snapshot order: a crash between
+    them leaves the old snapshot + a new-generation empty WAL, which
+    must replay to the old (stale-but-consistent) snapshot instead of
+    failing."""
+    import json
+
+    st = _mk(tmp_path / "a", compact_every=10_000)
+    st.put("store/a", "1")
+    st.close()
+    # Old snapshot from a closed state's files: build one by compacting.
+    st = _mk(tmp_path / "a", compact_every=10_000)
+    st._compact()
+    st.close()
+    # Simulate: follower truncated the WAL with a NEWER generation
+    # header, then crashed before writing the newer snapshot.
+    (tmp_path / "a" / "coord.wal").write_text(
+        json.dumps({"o": "hdr", "gen": 99}) + "\n")
+    st2 = _mk(tmp_path / "a")
+    try:
+        assert st2.range("store/a").count == 1  # old snapshot state
+    finally:
+        st2.close()
+
+
 def test_wal_torn_tail_ignored(tmp_path):
     st = _mk(tmp_path)
     st.put("store/a", "1")
