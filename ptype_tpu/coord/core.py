@@ -336,6 +336,15 @@ class CoordState:
                 ) from e
             self._replay(data_dir)
             self._wal = open(self._wal_path(), "a", encoding="utf-8")
+            # Compact-on-start: fold the recovered state into a fresh
+            # snapshot + truncated WAL. Appending to the replayed file
+            # would be wrong in the stale-generation case (a crash
+            # between _compact's snapshot-replace and WAL-truncate):
+            # new records after a mismatched header would be skipped
+            # wholesale by the NEXT replay. Rewriting both files makes
+            # every start leave a consistent (snap, WAL-gen) pair —
+            # and bounds future replay work as a side effect.
+            self._compact()
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="coord-lease-sweeper", daemon=True
         )

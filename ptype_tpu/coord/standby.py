@@ -231,8 +231,14 @@ class Standby:
             return
         if self.follower is not None and not self.follower.closed:
             return
-        if self.follower is not None:
-            self.follower.close()
+        if self.follower is not None and not self.follower.close():
+            # The old reader thread hasn't exited: a replacement would
+            # make TWO writers on one mirror (the zombie can wake and
+            # truncate coord.wal mid-write). Retry on the next probe
+            # round instead.
+            log.warning("follower re-arm deferred: old reader thread "
+                        "still live")
+            return
         self.follower = WalFollower(self.primary_address, self.data_dir)
 
     def _start_guarding(self) -> None:

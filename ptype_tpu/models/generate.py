@@ -57,19 +57,23 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int,
 
 def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
     """q: (B, 1, H, Dh); caches: (B, Smax, Kh, Dh); attend to
-    positions < pos_limit."""
+    positions < pos_limit. GQA-native: query heads are grouped onto
+    their kv head inside the einsum — no ``jnp.repeat``
+    materializing H-head caches every decode step (the G=1 MHA case
+    is the same einsum)."""
     B, _, H, Dh = q.shape
     Kh = k_cache.shape[2]
-    if Kh != H:
-        k_cache = jnp.repeat(k_cache, H // Kh, axis=2)
-        v_cache = jnp.repeat(v_cache, H // Kh, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
+    G = H // Kh
+    qg = q.reshape(B, 1, Kh, G, Dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                        k_cache).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(Dh))
     mask = jnp.arange(k_cache.shape[1]) < pos_limit  # (Smax,)
-    scores = jnp.where(mask[None, None, None, :], scores,
+    scores = jnp.where(mask[None, None, None, None, :], scores,
                        jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
+    return o.reshape(B, 1, H, Dh)
 
 
 def _head_logits(params, x_last, cfg):
@@ -141,7 +145,12 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
     — repeated calls (the serving hot path) reuse the compilation."""
 
     def run(params, prompt, rng):
-        cache = init_cache(cfg, B)
+        # Size the cache to THIS request's reach (128-lane aligned),
+        # not cfg.max_seq: decode reads the whole static cache every
+        # step, so a 128+128-token call against a 1024-slot cache was
+        # paying 4× the attention HBM traffic for masked-out zeros.
+        reach = min(cfg.max_seq, -(-(S + max_new_tokens) // 128) * 128)
+        cache = init_cache(cfg, B, max_seq=reach)
         logits, cache = prefill(params, prompt, cfg, cache)
 
         def sample(logits, key):

@@ -130,6 +130,37 @@ def test_stale_wal_beside_newer_snapshot_skipped(tmp_path):
     shutil.rmtree(tmp_path)
 
 
+def test_writes_after_stale_wal_recovery_survive(tmp_path):
+    """After recovering past a stale-generation WAL, NEW acknowledged
+    writes must survive the next restart. (Compact-on-start regresses
+    this if the recovered files were left as snapshot-gen-N+1 beside a
+    gen-N WAL that new records were appended to — the next replay
+    would skip them wholesale.)"""
+    import json
+
+    st = _mk(tmp_path, compact_every=10)
+    for i in range(12):  # crosses compact_every once
+        st.put(f"store/k{i}", str(i))
+    st.close()
+    # Resurrect a stale WAL beside the newer snapshot (the _compact
+    # crash window).
+    (tmp_path / "coord.wal").write_text(
+        json.dumps({"o": "p", "k": "store/stale", "v": "old"}) + "\n")
+
+    st2 = _mk(tmp_path, compact_every=10)
+    st2.put("store/after", "survives")  # acknowledged post-recovery
+    st2.close()
+
+    st3 = _mk(tmp_path)
+    try:
+        assert st3.range("store/stale").count == 0  # stale skipped
+        res = st3.range("store/after")
+        assert [i.value for i in res.items] == ["survives"]
+        assert st3.range("store/k5").count == 1  # snapshot state intact
+    finally:
+        st3.close()
+
+
 def test_follower_mirror_crash_window_recovers(tmp_path):
     """The follower's truncate-then-snapshot order: a crash between
     them leaves the old snapshot + a new-generation empty WAL, which
