@@ -36,6 +36,7 @@ from ptype_tpu.coord.local import LocalCoord, local_coord, reset_local_coords
 from ptype_tpu.coord.service import CoordServer
 from ptype_tpu.coord.remote import RemoteCoord
 from ptype_tpu.coord.api import CoordBackend, connect
+from ptype_tpu.coord.standby import Standby, WalFollower
 
 __all__ = [
     "CoordBackend",
@@ -51,6 +52,8 @@ __all__ = [
     "RemoteCoord",
     "SortOrder",
     "SortTarget",
+    "Standby",
+    "WalFollower",
     "Watch",
     "connect",
     "local_coord",

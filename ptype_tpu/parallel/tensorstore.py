@@ -19,9 +19,16 @@ KVStore carries ``{shape, dtype, spec, epoch}`` manifests so any member
 (or a checkpointer) can discover the parameter space — the control-plane/
 data-plane split mandated by SURVEY.md §7 stage 6.
 
-Compression hook: ``compress="bf16"`` casts contributions to bfloat16 for
-the wire and restores dtype after the reduce (EQuARX pattern, PAPERS.md) —
-halves ICI bytes at <1 ulp-bf16 cost.
+Compression hooks (EQuARX pattern, PAPERS.md):
+
+- ``compress="bf16"`` casts contributions to bfloat16 for the wire and
+  restores dtype after the reduce — halves ICI bytes at <1 ulp-bf16 cost.
+- ``compress="int8"`` runs push through the two-phase int8-quantized
+  allreduce (``collectives.quantized_all_reduce``: all_to_all
+  reduce-scatter leg + all_gather leg, both carrying int8 payloads with
+  f32 absmax scales) — ≈4× fewer ICI bytes; lossy, meant for gradients.
+  Leaves too small to chunk over the axis (scalars, short vectors) ride
+  the exact allreduce instead.
 """
 
 from __future__ import annotations
@@ -70,7 +77,7 @@ class TensorStore:
     def __init__(self, mesh: Mesh, axis: str = "data",
                  kv: KVStore | None = None, namespace: str = "params",
                  compress: str | None = None):
-        if compress not in (None, "bf16"):
+        if compress not in (None, "bf16", "int8"):
             raise ValueError(f"TensorStore: unknown compression {compress!r}")
         self.mesh = mesh
         self.axis = axis
@@ -169,9 +176,21 @@ class TensorStore:
         b = self.binding(key)
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
-        wire = stacked.astype(jnp.bfloat16) if self.compress else stacked
+        n = int(self.mesh.shape[self.axis])
+        use_int8 = (self.compress == "int8" and op in ("sum", "mean")
+                    and stacked.ndim >= 2 and stacked.shape[1] % n == 0)
         with annotate(f"store.push/{key}"):
-            reduced = collectives.all_reduce(wire, self.mesh, self.axis, op)
+            if use_int8:
+                reduced = collectives.quantized_all_reduce(
+                    stacked, self.mesh, self.axis, op)
+            else:
+                # int8-ineligible leaves (scalars, short vectors,
+                # max/min ops) ride the EXACT allreduce — the caller
+                # opted into int8 loss, not into bf16 loss.
+                wire = (stacked.astype(jnp.bfloat16)
+                        if self.compress == "bf16" else stacked)
+                reduced = collectives.all_reduce(
+                    wire, self.mesh, self.axis, op)
         if self.compress:
             reduced = reduced.astype(stacked.dtype)
         if b.spec != P():
@@ -185,7 +204,9 @@ class TensorStore:
         bandwidth-optimal allreduce decomposition."""
         b = Binding(P(self.axis), op or self.binding(key).reduce_op)
         stacked = jnp.asarray(stacked)
-        wire = stacked.astype(jnp.bfloat16) if self.compress else stacked
+        # int8 applies to push() only; scatter under int8 stays exact.
+        wire = (stacked.astype(jnp.bfloat16) if self.compress == "bf16"
+                else stacked)
         reduced = collectives.reduce_scatter(
             wire, self.mesh, self.axis, b.reduce_op
         )

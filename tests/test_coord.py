@@ -255,3 +255,35 @@ def test_remote_error_propagates(coord_server):
 def test_remote_dial_failure():
     with pytest.raises(CoordinationError, match="failed to dial"):
         RemoteCoord("127.0.0.1:1", dial_timeout=0.3)
+
+
+def test_repl_feed_cancelled_when_follower_disconnects(coord_server):
+    """A dropped replication connection must cancel its feed on the
+    primary — otherwise every future mutation is appended to an
+    orphaned in-memory feed forever (a flapping follower would leak
+    one per reconnect)."""
+    import socket as _socket
+    import time as _time
+
+    from ptype_tpu.coord import wire
+
+    host, _, port = coord_server.address.rpartition(":")
+    sock = _socket.create_connection((host, int(port)), timeout=2.0)
+    lock = threading.Lock()
+    wire.send_msg(sock, lock, {"op": "repl_subscribe", "id": 1})
+    reply = wire.recv_msg(sock)
+    assert reply["ok"]
+    state = coord_server.state
+    assert len(state._repl_feeds) == 1
+    # First push carries the subscribe-time snapshot.
+    push = wire.recv_msg(sock)
+    assert push["items"][0]["kind"] == "snap"
+
+    sock.close()  # follower drops
+    # The reader or pump notices within its 1 s poll; a mutation makes
+    # the pump's send fail immediately.
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and state._repl_feeds:
+        state.put("store/poke", "x")
+        _time.sleep(0.1)
+    assert not state._repl_feeds, "orphaned repl feed leaked"

@@ -130,6 +130,27 @@ class TestCollectives:
         rs = C.reduce_scatter(jnp.asarray(x), mesh8, op="mean")
         np.testing.assert_allclose(np.asarray(rs), np.ones(16), rtol=1e-6)
 
+    def test_quantized_all_reduce_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(8, 64, 16)).astype(np.float32)
+        out = C.quantized_all_reduce(jnp.asarray(x), mesh8, op="mean")
+        exact = x.mean(0)
+        # Two absmax-scaled round-to-nearest quantizations: error per
+        # element bounded by ~2 quant steps of the chunk absmax.
+        tol = 2.5 * np.abs(x).max() / 127.0
+        np.testing.assert_allclose(np.asarray(out), exact, atol=tol)
+        assert out.sharding.is_fully_replicated
+
+    def test_quantized_all_reduce_sum_and_validation(self, mesh8):
+        x = np.ones((8, 16), np.float32)
+        out = C.quantized_all_reduce(jnp.asarray(x), mesh8, op="sum")
+        np.testing.assert_allclose(np.asarray(out), np.full(16, 8.0),
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match="divide"):
+            C.quantized_all_reduce(jnp.ones((8, 17)), mesh8)
+        with pytest.raises(ValueError, match="op"):
+            C.quantized_all_reduce(jnp.ones((8, 16)), mesh8, op="max")
+
     def test_ring_shift(self, mesh8):
         x = jnp.arange(8, dtype=jnp.float32)[:, None]
         out = np.asarray(C.ring_shift(x, mesh8, shift=1))
@@ -197,6 +218,21 @@ class TestTensorStore:
         out = ts.push("g", jnp.asarray(x), op="sum")
         assert out.dtype == jnp.float32
         np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-2)
+
+    def test_int8_compression_push(self, mesh8):
+        ts = TensorStore(mesh8, compress="int8")
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(8, 64)).astype(np.float32)
+        out = ts.push("g", jnp.asarray(x), op="mean")
+        assert out.dtype == jnp.float32
+        tol = 2.5 * np.abs(x).max() / 127.0
+        np.testing.assert_allclose(np.asarray(out), x.mean(0), atol=tol)
+        # Leaves too small to chunk over the axis ride the EXACT
+        # allreduce (not bf16): the caller opted into int8 loss only.
+        small = ts.push("b", jnp.full((8, 4), 1.001, jnp.float32),
+                        op="sum")
+        np.testing.assert_allclose(np.asarray(small),
+                                   np.full(4, 8.008), rtol=1e-6)
 
     def test_tree_push_and_get(self, mesh8):
         ts = TensorStore(mesh8)
