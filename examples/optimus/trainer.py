@@ -13,7 +13,8 @@ and train. Three modes:
 - ``async``: param-server mode (train/param_server.py) — un-barriered
   push/pull.
 
-Env knobs: PRESET (optimus-125m), STEPS, BATCH, SEQ, MODE.
+Env knobs: PRESET (optimus-125m), STEPS, BATCH, SEQ, MODE,
+COMPRESS (store mode: bf16|int8 gradient-push wire compression).
 """
 
 from __future__ import annotations
@@ -50,17 +51,52 @@ def main() -> None:
 
             trainer = Trainer(model_cfg, mesh)
             print(f"params: {trainer.n_params/1e6:.1f}M", flush=True)
+            # CKPT_DIR enables save/resume: restart the process with the
+            # same dir and training continues from the latest complete
+            # step (reshard-on-restore: the mesh may have changed).
+            ckpt_dir = os.environ.get("CKPT_DIR")
+            ckpt_every = int(os.environ.get("CKPT_EVERY", "50"))
+            ck = None
+            if ckpt_dir:
+                from ptype_tpu.checkpoint import Checkpointer
+
+                ck = Checkpointer(ckpt_dir)
+                latest = ck.latest_step()
+                if latest is not None:
+                    trainer.state = ck.restore(
+                        trainer.state, step=latest,
+                        shardings=trainer.state_shardings)
+                    print(f"resumed from step {latest}", flush=True)
             for i in range(steps):
                 out = trainer.step(next(stream))
                 if i % 10 == 0 or i == steps - 1:
                     print(f"step {out['step']:5d} loss {out['loss']:.4f} "
                           f"tok/s/chip {out['tokens_per_sec_per_chip']:.0f} "
                           f"mfu {out['mfu']:.3f}", flush=True)
+                if (ck is not None and ckpt_every
+                        and (i + 1) % ckpt_every == 0):
+                    trainer.sync()
+                    # async: the snapshot is copied out with
+                    # backpressure and written off-thread; training
+                    # continues while the bytes land.
+                    ck.async_save(int(out["step"]), trainer.state)
+            if ck is not None:
+                trainer.sync()
+                final = int(trainer.state.step)
+                if ck.latest_step() != final:
+                    ck.save(final, trainer.state)
+                ck.wait()
+                print(f"checkpointed step {final}", flush=True)
         elif mode == "store":
             from ptype_tpu.parallel.tensorstore import TensorStore
             from ptype_tpu.train.store_dp import StoreDPTrainer
 
-            store = TensorStore(mesh, kv=cluster.store)
+            # COMPRESS=bf16|int8 compresses the gradient push wire
+            # (tensorstore.py compression hooks; int8 = the EQuARX
+            # two-phase quantized allreduce).
+            store = TensorStore(mesh, kv=cluster.store,
+                                compress=os.environ.get("COMPRESS")
+                                or None)
             trainer = StoreDPTrainer(model_cfg, store)
             for i in range(steps):
                 out = trainer.step(next(stream))

@@ -9,7 +9,8 @@ operations need. Commands:
                or bare member; ^C to leave)
 - ``serve``  — join + serve a GeneratorActor ($PRESET, default tiny)
 - ``train``  — join + train ($PRESET/$STEPS/$BATCH/$SEQ/$MODE as in
-               examples/optimus/trainer.py)
+               examples/optimus/trainer.py; $CKPT_DIR/$CKPT_EVERY for
+               save/resume, $COMPRESS for store-mode grad wire)
 - ``bench``  — the headline one-line JSON benchmark
 - ``standby`` — warm-standby coordinator: probe the seed, take over on
                failure ($STANDBY_ADDR to listen on; the platform
