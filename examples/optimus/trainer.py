@@ -82,10 +82,15 @@ def main() -> None:
                     ck.async_save(int(out["step"]), trainer.state)
             if ck is not None:
                 trainer.sync()
+                # Drain any in-flight async save BEFORE consulting
+                # latest_step(): an uncommitted final-step save would
+                # otherwise be re-serialized (and in multi-controller
+                # runs, processes would disagree and strand the
+                # manifest barrier).
+                ck.wait()
                 final = int(trainer.state.step)
                 if ck.latest_step() != final:
                     ck.save(final, trainer.state)
-                ck.wait()
                 print(f"checkpointed step {final}", flush=True)
         elif mode == "store":
             from ptype_tpu.parallel.tensorstore import TensorStore
