@@ -70,12 +70,18 @@ def _serve() -> None:
 
     from ptype_tpu import ActorServer, config_from_env, join
     from ptype_tpu.models import transformer as tfm
-    from ptype_tpu.serve import GeneratorActor
+    from ptype_tpu.serve import BatchingGeneratorActor
 
     cfg = config_from_env()
     model_cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
     server = ActorServer(port=cfg.port)
-    server.register(GeneratorActor(model_cfg), "Generator")
+    # Dynamic batching: concurrent greedy requests coalesce into one
+    # decode round ($SERVE_WINDOW_MS to tune; sampled requests run solo).
+    server.register(
+        BatchingGeneratorActor(
+            model_cfg,
+            window_ms=float(os.environ.get("SERVE_WINDOW_MS", "5"))),
+        "Generator")
     server.serve()
     cfg.port = server.port
     cluster = join(cfg)

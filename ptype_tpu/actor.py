@@ -60,12 +60,17 @@ class ActorServer:
     # ------------------------------------------------------------ handlers
 
     def register(self, obj: object, name: str = "") -> None:
-        """Expose ``obj``'s public methods as ``Name.Method`` endpoints
-        (net/rpc naming: ref example/calculator/calculator.go:9-12 exposes
-        ``Calculator.Multiply``)."""
+        """Expose ``obj``'s EXPORTED methods — leading-uppercase names,
+        Go's net/rpc rule (ref example/calculator/calculator.go:9-12
+        exposes ``Calculator.Multiply``) — as ``Name.Method`` endpoints.
+        Lowercase methods (``close``, ``params``…) are the actor's
+        local/lifecycle surface and must not be remotely callable: a
+        reflected ``Generator.close`` would let any client shut the
+        server's generation down. ``register_function`` remains the
+        explicit escape hatch for any name."""
         name = name or type(obj).__name__
         for attr in dir(obj):
-            if attr.startswith("_"):
+            if not attr[:1].isupper():
                 continue
             fn = getattr(obj, attr)
             if callable(fn):

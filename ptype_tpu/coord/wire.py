@@ -37,7 +37,19 @@ def recv_msg(sock: socket.socket) -> dict:
     if length > MAX_FRAME:
         raise WireError(f"frame too large: {length} bytes")
     payload = _recv_exact(sock, length)
-    return json.loads(bytes(payload).decode("utf-8"))
+    try:
+        msg = json.loads(bytes(payload).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError, RecursionError) as e:
+        # RecursionError: ~2000 nested brackets blows json's recursive
+        # parser well under MAX_FRAME — same peer-garbage class.
+        # Garbage from a confused/malicious peer must surface as the
+        # connection-level error every reader already handles — a raw
+        # JSONDecodeError would escape the (WireError, OSError) nets.
+        raise WireError(f"malformed frame: {e}") from e
+    if not isinstance(msg, dict):
+        raise WireError(f"malformed frame: expected object, "
+                        f"got {type(msg).__name__}")
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:

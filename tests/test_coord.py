@@ -287,3 +287,49 @@ def test_repl_feed_cancelled_when_follower_disconnects(coord_server):
         state.put("store/poke", "x")
         _time.sleep(0.1)
     assert not state._repl_feeds, "orphaned repl feed leaked"
+
+
+def test_server_survives_garbage_frames(coord_server):
+    """Fuzz the wire: random garbage, truncated frames, non-object JSON
+    and oversize headers from one client must not take the server (or
+    other clients) down — malformed input is a connection-level error,
+    never an unhandled exception in the reader."""
+    import os as _os
+    import random
+    import socket as _socket
+    import struct as _struct
+
+    from ptype_tpu.coord import wire
+
+    host, _, port = coord_server.address.rpartition(":")
+    rng = random.Random(0)
+    payloads = [
+        b"\x00\x00\x00\x04junk",                     # not JSON
+        b"\x00\x00\x00\x02[]",                        # JSON, not object
+        b"\xff\xff\xff\xff",                          # oversize length
+        _struct.pack(">I", 10) + b"short",            # truncated frame
+    ] + [_os.urandom(rng.randint(1, 64)) for _ in range(20)]
+    for p in payloads:
+        s = _socket.create_connection((host, int(port)), timeout=2.0)
+        try:
+            s.sendall(p)
+        finally:
+            s.close()
+
+    # A well-behaved client still gets service.
+    good = RemoteCoord(coord_server.address)
+    try:
+        good.put("store/alive", "yes")
+        assert good.range("store/alive").items[0].value == "yes"
+    finally:
+        good.close()
+
+    # And recv_msg itself reports garbage as WireError, not ValueError.
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x03{{{")
+        with pytest.raises(wire.WireError, match="malformed"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()

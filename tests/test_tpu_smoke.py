@@ -27,8 +27,10 @@ def test_flash_lowers_and_runs_on_tpu():
             capture_output=True, timeout=90, env=env)
     except subprocess.TimeoutExpired:
         pytest.skip("TPU backend unreachable (device probe hung)")
-    if probe.returncode != 0:
-        pytest.skip("no usable accelerator backend")
+    # A probe that FAILS (vs hangs) is ambiguous — broken import, or
+    # backend init raising. Fall through and run the smoke: it exits 42
+    # for no-TPU (skip below) and nonzero-loudly for real regressions.
+    del probe
     try:
         p = subprocess.run([sys.executable, SMOKE], capture_output=True,
                            text=True, timeout=580, env=env,
