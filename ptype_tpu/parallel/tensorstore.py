@@ -41,9 +41,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ptype_tpu.errors import NoKeyError
+from ptype_tpu import logs
+from ptype_tpu.errors import CoordinationError, NoKeyError
 from ptype_tpu.parallel import collectives
 from ptype_tpu.store import KVStore
+
+log = logs.get_logger("tensorstore")
 
 TENSOR_PREFIX = "tensors"
 
@@ -87,6 +90,7 @@ class TensorStore:
         self._entries: dict[str, _Entry] = {}
         self._bindings: dict[str, Binding] = {}
         self._lock = threading.RLock()
+        self._manifest_failed: set[str] = set()
 
     # ---------------------------------------------------------- bindings
 
@@ -251,19 +255,52 @@ class TensorStore:
         return f"{TENSOR_PREFIX}/{self.namespace}/{key}"
 
     def _publish(self, key: str) -> None:
+        """Best-effort manifest publish + catch-up of earlier misses.
+
+        Manifests are DISCOVERY metadata; the tensors themselves are
+        device-resident and the collectives never touch the
+        coordinator. A control-plane outage (e.g. the seed dying
+        before its standby promotes) must lag the manifest, not kill
+        the training step. Keys whose publish failed are remembered
+        and republished on the next successful KV contact — a key
+        put exactly once (params) self-heals too, not just re-pushed
+        gradient keys.
+        """
         if self._kv is None:
             return
+        if not self._try_publish(key):
+            return
+        with self._lock:
+            missed = [k for k in self._manifest_failed
+                      if k != key and k in self._entries]
+        recovered = [k for k in missed if self._try_publish(k)]
+        if recovered:
+            log.info("manifest publishing recovered",
+                     kv={"republished": len(recovered)})
+
+    def _try_publish(self, key: str) -> bool:
         with self._lock:
             entry = self._entries[key]
-        self._kv.put(
-            self._manifest_key(key),
-            json.dumps({
-                "shape": list(entry.value.shape),
-                "dtype": str(entry.value.dtype),
-                "spec": spec_to_json(entry.binding.spec),
-                "epoch": entry.epoch,
-            }, separators=(",", ":")),
-        )
+        try:
+            self._kv.put(
+                self._manifest_key(key),
+                json.dumps({
+                    "shape": list(entry.value.shape),
+                    "dtype": str(entry.value.dtype),
+                    "spec": spec_to_json(entry.binding.spec),
+                    "epoch": entry.epoch,
+                }, separators=(",", ":")),
+            )
+        except CoordinationError as e:
+            with self._lock:
+                self._manifest_failed.add(key)
+            log.warning("manifest publish failed; will retry on next "
+                        "successful publish",
+                        kv={"key": key, "err": str(e)})
+            return False
+        with self._lock:
+            self._manifest_failed.discard(key)
+        return True
 
     def manifest(self) -> dict[str, dict]:
         """Key → {shape, dtype, spec, epoch} for the whole namespace —

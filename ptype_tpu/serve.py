@@ -165,7 +165,20 @@ class BatchingGeneratorActor(GeneratorActor):
                     rows = sum(p.prompt.shape[0] for p in self._queue)
                     if not got:
                         break
-                batch, self._queue = self._queue, []
+                # Take only up to max_batch rows — the window loop
+                # stops WAITING at the cap, but a burst (or a fat
+                # request queued behind others) could have overshot it;
+                # decoding past the cap would pad to a bigger bucket
+                # and blow the configured device footprint. A single
+                # request larger than max_batch runs alone, uncapped —
+                # it can't be split without changing its result shape.
+                batch, rows = [], 0
+                while self._queue:
+                    nxt_rows = self._queue[0].prompt.shape[0]
+                    if batch and rows + nxt_rows > self.max_batch:
+                        break
+                    batch.append(self._queue.pop(0))
+                    rows += nxt_rows
             self._run_round(batch)
 
     def _run_round(self, batch: list[_Pending]) -> None:

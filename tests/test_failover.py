@@ -353,6 +353,66 @@ def test_wal_stream_operator_promote_refused_while_primary_lives(
             seed.wait(timeout=10)
 
 
+def test_training_rides_through_coordinator_failover(tmp_path,
+                                                     free_port_pair):
+    """The integration drill: Store-DP training publishes its tensor
+    manifests through the coordination KV while the seed is SIGKILLed
+    mid-run and a wal-stream standby takes over. The data plane (XLA
+    collectives) never depended on the coordinator; the control-plane
+    writes must ride the reconnect onto the standby — training
+    continues, manifests keep publishing, nothing deadlocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import TensorStore
+    from ptype_tpu.store import KVStore
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer
+
+    primary_addr, standby_addr = free_port_pair
+    seed = _start_seed(primary_addr, str(tmp_path / "primary"))
+    standby = Standby(primary_addr, standby_addr,
+                      str(tmp_path / "standby"),
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0)
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        mesh = build_mesh({"data": jax.device_count()})
+        cfg = tfm.preset("tiny", dtype=jnp.float32)
+        store = TensorStore(mesh, kv=KVStore(coord))
+        trainer = StoreDPTrainer(cfg, store)
+        stream = synthetic_batches(cfg.vocab_size, 8, 32)
+
+        out = trainer.step(next(stream))
+        assert jnp.isfinite(out["loss"])
+        pre_epoch = out["grad_epoch"]
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10)
+
+        # Training continues across the outage: steps complete, the
+        # grad epoch advances, and manifests land on the NEW primary.
+        for _ in range(3):
+            out = trainer.step(next(stream))
+        assert jnp.isfinite(out["loss"])
+        assert out["grad_epoch"] > pre_epoch
+        from ptype_tpu.store import with_prefix
+
+        manifests = KVStore(coord).get("tensors/", with_prefix())
+        assert manifests, "no tensor manifests on the promoted standby"
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket

@@ -234,6 +234,39 @@ class TestTensorStore:
         np.testing.assert_allclose(np.asarray(small),
                                    np.full(4, 8.008), rtol=1e-6)
 
+    def test_manifest_outage_lags_then_self_heals(self, mesh8):
+        """A coordination outage must not kill the push (tensors are
+        device-resident; manifests are discovery metadata) — and a key
+        published exactly once during the outage must be republished
+        on the next successful KV contact, not lost forever."""
+        from ptype_tpu.coord.local import LocalCoord
+        from ptype_tpu.errors import CoordinationError
+        from ptype_tpu.store import KVStore, with_prefix
+
+        real = KVStore(LocalCoord())
+
+        class FlakyKV:
+            fail = False
+
+            def put(self, k, v):
+                if self.fail:
+                    raise CoordinationError("coordinator down")
+                return real.put(k, v)
+
+            def __getattr__(self, a):
+                return getattr(real, a)
+
+        kv = FlakyKV()
+        ts = TensorStore(mesh8, kv=kv)
+        kv.fail = True
+        ts.put("weights", jnp.ones((4,)))  # one-time put, outage window
+        with pytest.raises(Exception):
+            real.get("tensors/params/weights")
+        kv.fail = False
+        ts.push("grads", jnp.ones((8, 4)), op="sum")  # healthy contact
+        keys = real.get("tensors/", with_prefix())
+        assert len(keys) == 2, "weights manifest not republished"
+
     def test_tree_push_and_get(self, mesh8):
         ts = TensorStore(mesh8)
         grads = {"layer0": {"w": jnp.ones((8, 2)), "b": jnp.ones((8,))},
