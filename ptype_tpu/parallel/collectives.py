@@ -229,14 +229,22 @@ def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
         q2, s2 = _q_int8_chunks(red[None])  # one chunk → one scale
         qg = lax.all_gather(jnp.squeeze(q2, 0), axis)   # (n, c, *tail)
         sg = lax.all_gather(s2[0], axis)                # (n,)
-        out = qg.astype(jnp.float32) * sg.reshape(
-            (n,) + (1,) * (qg.ndim - 1))
+        out = qg.astype(jnp.float32) * sg.reshape(bcast)
         return out.reshape(x.shape)
 
     return jax.jit(
         shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
                   check_vma=False)
     )
+
+
+def quantized_all_reduce_eligible(shape: tuple, n: int,
+                                  op: str) -> bool:
+    """Whether a stacked ``(n, *rest)`` payload can take the int8 path
+    — the single source of its constraints (callers like TensorStore
+    route ineligible leaves to the exact allreduce)."""
+    return (op in ("sum", "mean") and len(shape) >= 2
+            and shape[0] == n and shape[1] % n == 0)
 
 
 def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,

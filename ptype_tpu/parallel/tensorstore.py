@@ -181,8 +181,9 @@ class TensorStore:
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
         n = int(self.mesh.shape[self.axis])
-        use_int8 = (self.compress == "int8" and op in ("sum", "mean")
-                    and stacked.ndim >= 2 and stacked.shape[1] % n == 0)
+        use_int8 = (self.compress == "int8"
+                    and collectives.quantized_all_reduce_eligible(
+                        stacked.shape, n, op))
         with annotate(f"store.push/{key}"):
             if use_int8:
                 reduced = collectives.quantized_all_reduce(

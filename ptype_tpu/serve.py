@@ -23,6 +23,13 @@ from ptype_tpu.models import transformer as tfm
 log = logs.get_logger("serve")
 
 
+def _norm_prompt(prompt) -> jnp.ndarray:
+    """Tokens from the wire → (B, S) int32 (a bare (S,) gets a batch
+    dim) — one normalization for every endpoint."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    return prompt[None] if prompt.ndim == 1 else prompt
+
+
 class GeneratorActor:
     """Generation endpoint over a params pytree.
 
@@ -45,9 +52,7 @@ class GeneratorActor:
     def Generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0):
         """prompt: (B, S) int32 tokens → (B, max_new_tokens) int32."""
-        prompt = jnp.asarray(prompt, jnp.int32)
-        if prompt.ndim == 1:
-            prompt = prompt[None]
+        prompt = _norm_prompt(prompt)
         with self._lock:
             self._calls += 1
             out = gen.generate(
@@ -58,9 +63,7 @@ class GeneratorActor:
 
     def Logits(self, tokens):
         """Full-sequence logits (B, S, V) — the eval endpoint."""
-        tokens = jnp.asarray(tokens, jnp.int32)
-        if tokens.ndim == 1:
-            tokens = tokens[None]
+        tokens = _norm_prompt(tokens)
         with self._lock:
             return self._forward(self.params, tokens)
 
@@ -128,10 +131,7 @@ class BatchingGeneratorActor(GeneratorActor):
             # Exact per-request sampling semantics: solo path.
             return super().Generate(prompt, max_new_tokens, temperature,
                                     seed)
-        prompt = jnp.asarray(prompt, jnp.int32)
-        if prompt.ndim == 1:
-            prompt = prompt[None]
-        req = _Pending(prompt, int(max_new_tokens))
+        req = _Pending(_norm_prompt(prompt), int(max_new_tokens))
         with self._cond:
             if self._closed:
                 raise RuntimeError("generator actor is closed")
