@@ -103,11 +103,43 @@ def main() -> None:
                                 compress=os.environ.get("COMPRESS")
                                 or None)
             trainer = StoreDPTrainer(model_cfg, store)
+            # CKPT_DIR persists the Store's parameter space (the
+            # durability etcd's data-dir gave the reference Store).
+            # Resume restores params INTO the store after the trainer
+            # seeded it — optimizer moments restart, the Store-tier
+            # "resume = join + Store pull" semantic (SURVEY.md §5).
+            sc = None
+            ckpt_every = int(os.environ.get("CKPT_EVERY", "50"))
+            if os.environ.get("CKPT_DIR"):
+                from ptype_tpu.checkpoint import StoreCheckpoint
+
+                # params/ only: the store also holds transient grads/*
+                # whose bytes equal the params' — don't double saves.
+                sc = StoreCheckpoint(store, os.environ["CKPT_DIR"],
+                                     keys_prefix="params/")
+                # Probe emptiness explicitly so a CORRUPT checkpoint
+                # still fails loudly instead of silently restarting
+                # from step 0.
+                if sc.latest_step() is not None:
+                    restored = sc.resume()
+                    print(f"resumed {len(restored)} Store keys",
+                          flush=True)
+            saved_i = -1
             for i in range(steps):
                 out = trainer.step(next(stream))
                 if i % 10 == 0 or i == steps - 1:
                     print(f"step {out['step']:5d} loss {out['loss']:.4f} "
                           f"grad_epoch {out['grad_epoch']}", flush=True)
+                if sc is not None and ckpt_every and (
+                        i + 1) % ckpt_every == 0:
+                    # Step passed explicitly: params epochs don't bump
+                    # on put() (resume semantics pin them), so the
+                    # derived step would always be 0.
+                    sc.save(step=out["step"])
+                    saved_i = i
+            if sc is not None and saved_i != steps - 1:
+                print(f"store checkpoint: {sc.save(step=out['step'])}",
+                      flush=True)
         elif mode == "async":
             from ptype_tpu.parallel.tensorstore import TensorStore
             from ptype_tpu.train.param_server import AsyncWorker, ParamServer

@@ -520,17 +520,31 @@ class StoreCheckpoint:
     the durability role etcd's data-dir played for the reference Store.
     """
 
-    def __init__(self, store, directory: str, keep: int = 3):
+    def __init__(self, store, directory: str, keep: int = 3,
+                 keys_prefix: str | None = None):
         from ptype_tpu.parallel.tensorstore import TensorStore  # typing
 
         assert isinstance(store, TensorStore)
         self.store = store
+        #: Persist only keys under this prefix (e.g. ``"params/"``) —
+        #: a training store also holds transient grads/* whose bytes
+        #: match the params'; checkpointing them doubles every save for
+        #: state the next step overwrites.
+        self.keys_prefix = keys_prefix
         self._ckpt = Checkpointer(directory, keep=keep)
+
+    def latest_step(self) -> int | None:
+        """Latest complete step on disk, or None — the is-there-
+        anything-to-resume probe (real restore errors then propagate
+        from :meth:`resume` instead of being conflated with 'empty')."""
+        return self._ckpt.latest_step()
 
     def save(self, step: int | None = None) -> str:
         from ptype_tpu.parallel.tensorstore import spec_to_json
 
         keys = self.store.keys()
+        if self.keys_prefix:
+            keys = [k for k in keys if k.startswith(self.keys_prefix)]
         tree = {k: self.store.get(k) for k in keys}
         step = step if step is not None else max(
             (self.store.epoch(k) for k in keys), default=0

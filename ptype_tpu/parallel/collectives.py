@@ -260,19 +260,13 @@ def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,
     ``stacked``: ``(axis_size, *rest)`` with ``rest[0] % axis_size
     == 0``; returns ``rest`` in f32, replicated.
     """
-    if op not in ("sum", "mean"):
-        raise ValueError(
-            f"quantized_all_reduce: op must be 'sum' or 'mean', "
-            f"got {op!r}")
     n = int(mesh.shape[axis])
-    if stacked.shape[0] != n:
+    if not quantized_all_reduce_eligible(stacked.shape, n, op):
         raise ValueError(
-            f"quantized_all_reduce: leading dim {stacked.shape[0]} != "
-            f"axis size {n}")
-    if stacked.ndim < 2 or stacked.shape[1] % n != 0:
-        raise ValueError(
-            f"quantized_all_reduce: payload dim 0 ({stacked.shape[1:]})"
-            f" must divide by axis size {n}")
+            f"quantized_all_reduce: need op in sum/mean (got {op!r}), "
+            f"leading dim == axis size {n} (got {stacked.shape[0]}), "
+            f"and payload dim 0 to divide by {n} "
+            f"(got {stacked.shape[1:]})")
     stacked = jax.device_put(
         stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim))))
     return _quantized_all_reduce_fn(mesh, axis, stacked.ndim, op)(stacked)
