@@ -55,3 +55,37 @@ def coord_server():
     server = CoordServer("127.0.0.1:0", CoordState(sweep_interval=0.05))
     yield server
     server.close()
+
+
+def wait_output(proc, needle: str, timeout: float):
+    """Wait until ``proc`` prints a line containing ``needle``.
+    Select-based so a live-but-silent child fails at the deadline
+    instead of blocking readline forever; returns the lines seen."""
+    import os
+    import select
+    import time
+
+    deadline = time.time() + timeout
+    lines = []
+    buf = ""
+    fd = proc.stdout.fileno()
+    while time.time() < deadline:
+        ready, _, _ = select.select([fd], [], [], 0.25)
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
+        chunk = os.read(fd, 4096).decode(errors="replace")
+        if not chunk:
+            if proc.poll() is not None:
+                break
+            continue
+        buf += chunk
+        while "\n" in buf:
+            line, buf = buf.split("\n", 1)
+            lines.append(line + "\n")
+            if needle in line:
+                return lines
+    raise AssertionError(
+        f"did not see {needle!r} within {timeout}s; got: {''.join(lines)}"
+    )

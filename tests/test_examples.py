@@ -45,36 +45,7 @@ def _write_cfgs(tmp_path, service, node, port, coord_addr, seed):
     return cfg
 
 
-def _wait_output(proc, needle: str, timeout: float):
-    """Wait until the process prints a line containing ``needle``.
-    Select-based so a live-but-silent child fails the test at the
-    deadline instead of blocking readline forever."""
-    import select
-
-    deadline = time.time() + timeout
-    lines = []
-    buf = ""
-    fd = proc.stdout.fileno()
-    while time.time() < deadline:
-        ready, _, _ = select.select([fd], [], [], 0.25)
-        if not ready:
-            if proc.poll() is not None:
-                break
-            continue
-        chunk = os.read(fd, 4096).decode(errors="replace")
-        if not chunk:
-            if proc.poll() is not None:
-                break
-            continue
-        buf += chunk
-        while "\n" in buf:
-            line, buf = buf.split("\n", 1)
-            lines.append(line + "\n")
-            if needle in line:
-                return lines
-    raise AssertionError(
-        f"did not see {needle!r} within {timeout}s; got: {''.join(lines)}"
-    )
+from conftest import wait_output as _wait_output  # noqa: E402
 
 
 def test_calculator_example(tmp_path):

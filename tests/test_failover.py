@@ -413,6 +413,67 @@ def test_training_rides_through_coordinator_failover(tmp_path,
             seed.wait(timeout=10)
 
 
+def test_standby_cli_process(tmp_path, free_port_pair):
+    """The operator path end to end: `python -m ptype_tpu standby` as a
+    real process (config/env parsing included) promotes after the seed
+    is SIGKILLed, and clients reach the promoted address."""
+    primary_addr, standby_addr = free_port_pair
+    data_dir = tmp_path / "d"
+    seed = _start_seed(primary_addr, str(data_dir / "coord"))
+
+    (tmp_path / "platform.yaml").write_text(
+        f"name: sb\ncoordinator_address: \"{primary_addr}\"\n"
+        f"data_dir: {data_dir}\n")
+    (tmp_path / "standby.yaml").write_text(
+        "service_name: standby\nnode_name: sb1\nport: 0\n"
+        "platform_config_file: platform.yaml\n")
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.update(CONFIG=str(tmp_path / "standby.yaml"),
+               STANDBY_ADDR=standby_addr,
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    sb = subprocess.Popen(
+        [sys.executable, "-m", "ptype_tpu", "standby"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+    try:
+        from conftest import wait_output
+
+        wait_output(sb, "standby for", timeout=30)
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+
+        # Promotion takes ~failure_threshold probe rounds; the client
+        # constructor dials eagerly, so construction retries too.
+        deadline = time.monotonic() + 30
+        val, coord = None, None
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    if coord is None:
+                        coord = RemoteCoord([standby_addr],
+                                            reconnect_timeout=10.0)
+                    coord.put("store/cli", "up")
+                    val = coord.range("store/cli").items[0].value
+                    break
+                except CoordinationError:
+                    time.sleep(0.3)
+            assert val == "up", "promoted standby CLI never served"
+        finally:
+            if coord is not None:
+                coord.close()
+    finally:
+        sb.terminate()
+        try:
+            sb.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            sb.kill()
+            sb.wait(timeout=10)
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
