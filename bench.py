@@ -205,13 +205,34 @@ def _attempt(extra_env: dict | None = None,
     return None, " | ".join(tail)[-800:], False
 
 
+def _backend_probe(timeout: int = 120) -> bool:
+    """True when the accelerator backend initializes in a fresh
+    process. A wedged device tunnel HANGS backend init (observed on
+    this harness for hours); without this probe every ladder attempt
+    would burn its full WORKER_TIMEOUT discovering the same hang, and
+    a driver-side cap could zero the round before the CPU fallback."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout, env=dict(os.environ))
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     if "--worker" in sys.argv:
         worker_main()
         return
 
     errs: list[str] = []
-    prev_timed_out = False
+    # A hung/broken backend shortens every attempt's budget up front:
+    # the retries still run (the tunnel may come back between them),
+    # but the worst case stays ~3×RETRY_TIMEOUT + CPU fallback instead
+    # of 3×WORKER_TIMEOUT.
+    prev_timed_out = not _backend_probe()
+    if prev_timed_out:
+        errs.append("backend probe hung/failed; short attempt budgets")
     for delay in RETRY_DELAYS:
         if delay:
             time.sleep(delay)

@@ -232,14 +232,25 @@ class Checkpointer:
         no marker ⇒ restore ignores the step (never a silent partial)."""
         final = self._step_dir(step)
         os.makedirs(final, exist_ok=True)
+        if os.path.exists(os.path.join(final, _COMPLETE)):
+            # A COMMITTED checkpoint of this step already exists.
+            # Re-writing in place would delete its marker/manifests
+            # before the new save commits — a peer crash at the
+            # barrier would then have destroyed good committed state.
+            # Keep the committed copy; a caller that truly wants a
+            # fresh save of the same step deletes the dir first.
+            log.info("checkpoint step already committed; keeping it",
+                     kv={"step": step, "dir": final, "process": pid})
+            return final
         # Stale-attempt debris (a previous save of this step that timed
         # out or crashed) must never satisfy the barrier: process 0
-        # clears EVERY old manifest + the marker before writing anything;
-        # peers clear their own. A peer's fresh manifest caught in
-        # process 0's sweep surfaces as a barrier timeout — loud failure,
+        # clears EVERY old manifest before writing anything; peers
+        # clear their own. A peer's fresh manifest caught in process
+        # 0's sweep surfaces as a barrier timeout — loud failure,
         # never a silent merge of two attempts' shards.
         if pid == 0:
-            for p in _glob.glob(os.path.join(final, "manifest*.json")):
+            for p in _glob.glob(
+                    os.path.join(_glob.escape(final), "manifest*.json")):
                 os.unlink(p)
             _rm_f(os.path.join(final, _COMPLETE))
         else:
@@ -257,7 +268,11 @@ class Checkpointer:
         _atomic_write(final, mf_name, mf_json)
         deadline = time.monotonic() + self.barrier_timeout
         if pid == 0:
-            pat = os.path.join(final, "manifest.p*.json")
+            # glob.escape: a checkpoint dir containing [ ? * (legal
+            # POSIX path chars) must not turn the pattern into a
+            # character class that matches nothing — that presents as
+            # a spurious barrier timeout only on multi-host runs.
+            pat = os.path.join(_glob.escape(final), "manifest.p*.json")
             while len(_glob.glob(pat)) < nproc:
                 if time.monotonic() > deadline:
                     # Leave the dir clearly incomplete for the next
@@ -428,7 +443,8 @@ def _merged_manifest(sdir: str, step: int) -> dict:
     legacy save's replicated copies) keep the first occurrence so the
     tiling check still holds."""
     paths = sorted(
-        p for p in _glob.glob(os.path.join(sdir, "manifest*.json")))
+        p for p in _glob.glob(
+            os.path.join(_glob.escape(sdir), "manifest*.json")))
     if not paths:
         raise ClusterError(f"restore: step {step} has no manifest")
     per_proc = [p for p in paths

@@ -228,6 +228,15 @@ class ReplFeed:
     (service.py pump) which cancels the feed.
     """
 
+    #: Max buffered items before the feed self-cancels. A follower
+    #: whose process is wedged (SIGSTOP, stuck disk) keeps its TCP
+    #: window open, so the pump blocks in sendall and never errors —
+    #: without this bound every mutation would accumulate in the
+    #: feed's list and the COORDINATOR would OOM. A cancelled follower
+    #: re-syncs from a fresh snapshot on reconnect, so dropping the
+    #: feed is always safe.
+    MAX_BUFFER = 100_000
+
     def __init__(self, feed_id: int, cancel_fn):
         self.id = feed_id
         self._cancel_fn = cancel_fn
@@ -236,11 +245,19 @@ class ReplFeed:
         self._closed = False
 
     def _push(self, kind: str, data: dict) -> None:
+        overflow = False
         with self._cond:
             if self._closed:
                 return
             self._items.append((kind, data))
+            if len(self._items) > self.MAX_BUFFER:
+                overflow = True
             self._cond.notify_all()
+        if overflow:
+            log.warning("replication feed overflowed; cancelling "
+                        "(follower will re-sync on reconnect)",
+                        kv={"feed": self.id, "buffered": self.MAX_BUFFER})
+            self.cancel()
 
     def get(self, timeout: float | None = None) -> list[tuple[str, dict]]:
         """Block for the next batch; [] on timeout or close."""

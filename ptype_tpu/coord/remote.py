@@ -201,9 +201,9 @@ class RemoteCoord(CoordBackend):
         def current() -> bool:
             return gen == getattr(self, "_rewatch_gen", gen)
 
-        first = True
         try:
             while not self._closed.is_set() and current():
+                failed = False
                 with self._watches_lock:
                     todo = [w for w in self._watches.values()
                             if not w.closed
@@ -212,6 +212,7 @@ class RemoteCoord(CoordBackend):
                     try:
                         new_id = self._call("watch", prefix=w.prefix)
                     except CoordinationError:
+                        failed = True
                         continue  # retried next round
                     with self._watches_lock:
                         if self._watches.pop(w.id, None) is not None:
@@ -221,11 +222,25 @@ class RemoteCoord(CoordBackend):
                             # the loss and this re-arm were missed.
                             w.epoch += 1
                             self._watches[new_id] = w
-                if first:
+                            continue
+                    # The local watch was closed concurrently: the
+                    # server-side watch we just created is orphaned —
+                    # cancel it or it pumps events nobody reads for
+                    # the connection's lifetime.
+                    try:
+                        self._call("watch_cancel", watch=new_id)
+                    except CoordinationError:
+                        pass  # connection died; server cleans up
+                # Open the gate only once every watch re-armed — the
+                # gate's contract is that a caller's post-reconnect
+                # write cannot race ahead of its own watches, which a
+                # partially-armed set would silently break. (Callers
+                # have a bounded gate wait, so a persistently failing
+                # re-arm degrades to that timeout, not a deadlock.)
+                if not failed:
                     with self._watches_lock:
                         if current():
                             self._rewatch_gate.set()
-                    first = False
                 with self._watches_lock:
                     if not any(not w.closed
                                and not getattr(w, "_armed", True)

@@ -361,9 +361,18 @@ class Standby:
             return self.server  # idempotent: already serving
         self._closed.set()  # stop the monitor; we promote deliberately
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # The monitor is MID-automatic-promotion (CoordServer
+            # construction can replay a large WAL); racing it would
+            # spin against our own server's flock and misdiagnose as
+            # "primary still alive". Wait for its outcome instead.
+            if self.promoted.wait(timeout=timeout) and self.server:
+                return self.server
+            raise RuntimeError(
+                "promote: standby monitor wedged mid-promotion — "
+                "inspect the coordinator data_dir before retrying")
         # The monitor may have completed an AUTOMATIC promotion while we
-        # were joining it — spinning against our own server's WAL fence
-        # would misdiagnose as "primary still alive".
+        # were joining it.
         if self.promoted.is_set() and self.server is not None:
             return self.server
         if self.follower is not None:
