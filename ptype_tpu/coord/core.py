@@ -380,7 +380,10 @@ class CoordState:
 
     def _append(self, rec: dict) -> None:
         """Log one mutation (called under the lock, before ack)."""
-        for feed in self._repl_feeds:
+        # Copy: an overflowing feed self-cancels INSIDE _push, which
+        # removes it from this list mid-iteration — a sibling feed
+        # would silently miss this record (divergent mirror).
+        for feed in list(self._repl_feeds):
             feed._push("rec", rec)
         if self._wal is None:
             return
@@ -430,7 +433,7 @@ class CoordState:
 
         new_gen = self._wal_gen + 1
         snap = self._snapshot_dict(wal_gen=new_gen)
-        for feed in self._repl_feeds:
+        for feed in list(self._repl_feeds):  # _push may self-cancel
             feed._push("snap", snap)
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:

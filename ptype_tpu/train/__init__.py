@@ -12,6 +12,8 @@ from ptype_tpu.train.trainer import (
     Trainer,
     TrainState,
     make_train_step,
+    make_eval_step,
+    evaluate,
     init_state,
     default_optimizer,
 )
@@ -22,6 +24,8 @@ __all__ = [
     "Trainer",
     "TrainState",
     "make_train_step",
+    "make_eval_step",
+    "evaluate",
     "init_state",
     "default_optimizer",
     "StoreDPTrainer",

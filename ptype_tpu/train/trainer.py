@@ -245,6 +245,68 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     )
 
 
+def make_eval_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                   attn_fn: Callable | None = None,
+                   seq_axis: bool = False,
+                   batch_keys: tuple[str, ...] = ("tokens", "targets")):
+    """Compile the evaluation step: (params, batch) → mean NLL.
+
+    Same shardings and loss lowering as the train step (the fused
+    head+loss, so (B,S,V) never materializes) with no optimizer and no
+    state mutation — the held-out-loss / perplexity path.
+    """
+    axis_sizes = {n: int(mesh.shape[n]) for n in mesh.axis_names}
+    batch_sh = NamedSharding(mesh, tfm.batch_spec(axis_sizes, seq_axis))
+    batch_shardings = {k: batch_sh for k in batch_keys}
+    repl = NamedSharding(mesh, P())
+
+    def step(params, batch):
+        nll_sum, denom, _aux = tfm.loss_terms(params, batch, cfg,
+                                              attn_fn)
+        return nll_sum / jnp.maximum(denom, 1.0)
+
+    return jax.jit(step, in_shardings=(None, batch_shardings),
+                   out_shardings=repl)
+
+
+#: Batch keys the loss reads; extra stream keys (ids, metadata) are
+#: dropped before sharding/tracing — same filter the train path uses.
+EVAL_BATCH_KEYS = ("tokens", "targets", "loss_mask")
+
+
+def evaluate(params, cfg: tfm.TransformerConfig, mesh: Mesh,
+             batches, steps: int, attn_fn: Callable | None = None,
+             seq_axis: bool = False, _step_cache: dict | None = None)\
+        -> dict:
+    """Mean loss + perplexity over ``steps`` batches from ``batches``.
+
+    Token-weighted across batches (sums NLL and token counts, divides
+    once) so ragged masks can't skew the mean. ``_step_cache`` (any
+    dict the caller keeps alive, e.g. the Trainer's) reuses compiled
+    eval steps across calls instead of retracing per evaluation.
+    """
+    cache = _step_cache if _step_cache is not None else {}
+    nll_total, tok_total = 0.0, 0.0
+    for _ in range(steps):
+        batch = next(batches)
+        batch = {k: v for k, v in batch.items()
+                 if k in EVAL_BATCH_KEYS}
+        keys = tuple(sorted(batch))
+        if keys not in cache:
+            cache[keys] = make_eval_step(cfg, mesh, attn_fn, seq_axis,
+                                         keys)
+        mask = batch.get("loss_mask")
+        n_tok = (float(jnp.sum(mask.astype(jnp.float32)))
+                 if mask is not None else float(batch["targets"].size))
+        nll_total += float(cache[keys](params, batch)) * n_tok
+        tok_total += n_tok
+    loss = nll_total / max(tok_total, 1.0)
+    import math as _math
+
+    return {"loss": loss, "perplexity": _math.exp(min(loss, 700.0)),
+            "tokens": int(tok_total)}
+
+
 class Trainer:
     """Convenience loop: init + compiled step + throughput stats.
 
@@ -362,6 +424,18 @@ class Trainer:
         """Drain the device queue (call before reading final stats)."""
         jax.block_until_ready(self.state.params)
         self._fold_pending()
+
+    def evaluate(self, batches, steps: int) -> dict:
+        """Held-out mean loss + perplexity with this trainer's mesh,
+        attention lowering, and sharding — no state mutation. Compiled
+        eval steps are cached on the trainer across calls."""
+        self.sync()  # evaluate the CURRENT params, not a queued update
+        if not hasattr(self, "_eval_steps"):
+            self._eval_steps: dict = {}
+        return evaluate(self.state.params, self.cfg, self.mesh, batches,
+                        steps, attn_fn=self._attn_fn,
+                        seq_axis=self._seq_axis,
+                        _step_cache=self._eval_steps)
 
     def throughput(self) -> dict:
         """Drained throughput rates. Call after :meth:`sync` (or at any

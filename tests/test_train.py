@@ -228,3 +228,31 @@ def test_resolve_attn_fn_auto(monkeypatch):
     fn = tfm.resolve_attn_fn(cfg)
     assert fn is not tfm._attention
     assert fn.__module__ == "ptype_tpu.ops.flash_attention"
+
+
+def test_evaluate_matches_loss_and_mutates_nothing():
+    """evaluate() returns the same mean NLL loss_fn computes, leaves the
+    trainer state untouched, and exp()s into perplexity."""
+    import math
+
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.trainer import Trainer
+
+    cfg = tfm.preset("tiny", dtype=jnp.float32, attn_impl="xla")
+    tr = Trainer(cfg, build_mesh({"data": 8}), sync_every=1)
+    probe = next(synthetic_batches(cfg.vocab_size, 8, 32, seed=3))
+    want = float(tfm.loss_fn(tr.state.params, probe, cfg))
+
+    before = jax.tree.map(lambda x: np.asarray(x), tr.state.params)
+    out = tr.evaluate(synthetic_batches(cfg.vocab_size, 8, 32, seed=3),
+                      steps=1)
+    np.testing.assert_allclose(out["loss"], want, rtol=1e-5)
+    assert out["perplexity"] == pytest.approx(math.exp(out["loss"]))
+    assert out["tokens"] == 8 * 32
+    after = jax.tree.map(lambda x: np.asarray(x), tr.state.params)
+    jax.tree.map(np.testing.assert_array_equal, before, after)
+
+    # Multi-batch: token-weighted mean across steps.
+    out3 = tr.evaluate(synthetic_batches(cfg.vocab_size, 8, 32, seed=3),
+                       steps=3)
+    assert out3["tokens"] == 3 * 8 * 32
