@@ -11,6 +11,9 @@ operations need. Commands:
 - ``train``  — join + train ($PRESET/$STEPS/$BATCH/$SEQ/$MODE as in
                examples/optimus/trainer.py; $CKPT_DIR/$CKPT_EVERY for
                save/resume, $COMPRESS for store-mode grad wire)
+- ``eval``   — held-out loss/perplexity of a checkpoint ($CKPT_DIR;
+               $PRESET/$BATCH/$SEQ/$EVAL_STEPS; $CORPUS points at a raw
+               token file, else a fixed synthetic stream)
 - ``bench``  — the headline one-line JSON benchmark
 - ``standby`` — warm-standby coordinator: probe the seed, take over on
                failure ($STANDBY_ADDR to listen on; the platform
@@ -110,6 +113,54 @@ def _train() -> None:
     mod.main()
 
 
+def _eval() -> None:
+    import json as _json
+    import os
+
+    import jax
+
+    from ptype_tpu.checkpoint import Checkpointer
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.data import TokenFileDataset, synthetic_batches
+    from ptype_tpu.train.trainer import Trainer, default_optimizer
+
+    ckpt_dir = os.environ.get("CKPT_DIR")
+    if not ckpt_dir:
+        print("eval: set CKPT_DIR to the checkpoint directory",
+              file=sys.stderr)
+        raise SystemExit(2)
+    ck = Checkpointer(ckpt_dir)
+    step = ck.latest_step()
+    if step is None:
+        print(f"eval: no complete checkpoint under {ckpt_dir}",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+    cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
+    mesh = build_mesh({"data": jax.device_count()})
+    steps = int(os.environ.get("EVAL_STEPS", "10"))
+    batch = int(os.environ.get("BATCH", str(8 * mesh.devices.size)))
+    seq = int(os.environ.get("SEQ", "1024"))
+
+    # The TrainState skeleton + shardings come from a Trainer; restore
+    # replaces its fresh params with the checkpoint's, and
+    # Trainer.evaluate threads the attention lowering AND its matching
+    # seq-axis sharding (ring/ulysses presets shard batches over "seq").
+    tr = Trainer(cfg, mesh, optimizer=default_optimizer())
+    tr.state = ck.restore(tr.state, step=step,
+                          shardings=tr.state_shardings)
+
+    corpus = os.environ.get("CORPUS")
+    if corpus:
+        stream = TokenFileDataset(corpus).batches(batch, seq, seed=1234)
+    else:
+        stream = synthetic_batches(cfg.vocab_size, batch, seq, seed=1234)
+    out = tr.evaluate(stream, steps)
+    print(_json.dumps({"checkpoint_step": step, "eval_steps": steps,
+                       "batch": batch, "seq": seq, **out}))
+
+
 def _bench() -> None:
     import importlib.util
     import os
@@ -172,6 +223,7 @@ COMMANDS = {
     "join": _join,
     "serve": _serve,
     "train": _train,
+    "eval": _eval,
     "bench": _bench,
     "standby": _standby,
 }

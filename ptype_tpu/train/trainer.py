@@ -245,15 +245,24 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     )
 
 
+#: Batch keys the loss reads; extra stream keys (ids, metadata) are
+#: dropped before sharding/tracing. One constant for the train path's
+#: filter and the eval path's — two copies would silently drift.
+BATCH_KEYS = ("tokens", "targets", "loss_mask")
+
+
 def make_eval_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                    attn_fn: Callable | None = None,
                    seq_axis: bool = False,
                    batch_keys: tuple[str, ...] = ("tokens", "targets")):
-    """Compile the evaluation step: (params, batch) → mean NLL.
+    """Compile the evaluation step: (params, batch) → (nll_sum, denom)
+    as replicated device scalars.
 
     Same shardings and loss lowering as the train step (the fused
     head+loss, so (B,S,V) never materializes) with no optimizer and no
-    state mutation — the held-out-loss / perplexity path.
+    state mutation. Returning the unnormalized pieces lets callers
+    accumulate lazily (no per-batch host sync) and token-weight across
+    ragged masks exactly.
     """
     axis_sizes = {n: int(mesh.shape[n]) for n in mesh.axis_names}
     batch_sh = NamedSharding(mesh, tfm.batch_spec(axis_sizes, seq_axis))
@@ -263,15 +272,10 @@ def make_eval_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     def step(params, batch):
         nll_sum, denom, _aux = tfm.loss_terms(params, batch, cfg,
                                               attn_fn)
-        return nll_sum / jnp.maximum(denom, 1.0)
+        return nll_sum, denom
 
     return jax.jit(step, in_shardings=(None, batch_shardings),
-                   out_shardings=repl)
-
-
-#: Batch keys the loss reads; extra stream keys (ids, metadata) are
-#: dropped before sharding/tracing — same filter the train path uses.
-EVAL_BATCH_KEYS = ("tokens", "targets", "loss_mask")
+                   out_shardings=(repl, repl))
 
 
 def evaluate(params, cfg: tfm.TransformerConfig, mesh: Mesh,
@@ -281,25 +285,26 @@ def evaluate(params, cfg: tfm.TransformerConfig, mesh: Mesh,
     """Mean loss + perplexity over ``steps`` batches from ``batches``.
 
     Token-weighted across batches (sums NLL and token counts, divides
-    once) so ragged masks can't skew the mean. ``_step_cache`` (any
-    dict the caller keeps alive, e.g. the Trainer's) reuses compiled
-    eval steps across calls instead of retracing per evaluation.
+    once) so ragged masks can't skew the mean; the per-batch scalars
+    stay on device until the end, so dispatch overlaps compute.
+    ``_step_cache`` (any dict the caller keeps alive, e.g. the
+    Trainer's) reuses compiled eval steps across calls instead of
+    retracing per evaluation.
     """
     cache = _step_cache if _step_cache is not None else {}
-    nll_total, tok_total = 0.0, 0.0
+    nlls, denoms = [], []
     for _ in range(steps):
         batch = next(batches)
-        batch = {k: v for k, v in batch.items()
-                 if k in EVAL_BATCH_KEYS}
+        batch = {k: v for k, v in batch.items() if k in BATCH_KEYS}
         keys = tuple(sorted(batch))
         if keys not in cache:
             cache[keys] = make_eval_step(cfg, mesh, attn_fn, seq_axis,
                                          keys)
-        mask = batch.get("loss_mask")
-        n_tok = (float(jnp.sum(mask.astype(jnp.float32)))
-                 if mask is not None else float(batch["targets"].size))
-        nll_total += float(cache[keys](params, batch)) * n_tok
-        tok_total += n_tok
+        nll_sum, denom = cache[keys](params, batch)
+        nlls.append(nll_sum)
+        denoms.append(denom)
+    nll_total = float(sum(nlls))
+    tok_total = float(sum(denoms))
     loss = nll_total / max(tok_total, 1.0)
     import math as _math
 
@@ -337,6 +342,7 @@ class Trainer:
         # Compiled steps keyed by the batch's key set (tokens/targets
         # always; loss_mask when the data provides one).
         self._steps: dict[tuple[str, ...], Callable] = {}
+        self._eval_steps: dict[tuple[str, ...], Callable] = {}
         self.n_params = tfm.count_params(self.state.params)
         self._stats: StepStats | None = None
         self._peak = device_peak_tflops(mesh.devices.flat[0])
@@ -346,7 +352,7 @@ class Trainer:
         self.sync_every = sync_every
         self._host_step = 0
 
-    _BATCH_KEYS = ("tokens", "targets", "loss_mask")
+    _BATCH_KEYS = BATCH_KEYS
 
     def _step_for(self, batch: dict) -> Callable:
         keys = tuple(k for k in self._BATCH_KEYS if k in batch)
@@ -430,8 +436,6 @@ class Trainer:
         attention lowering, and sharding — no state mutation. Compiled
         eval steps are cached on the trainer across calls."""
         self.sync()  # evaluate the CURRENT params, not a queued update
-        if not hasattr(self, "_eval_steps"):
-            self._eval_steps: dict = {}
         return evaluate(self.state.params, self.cfg, self.mesh, batches,
                         steps, attn_fn=self._attn_fn,
                         seq_axis=self._seq_axis,
