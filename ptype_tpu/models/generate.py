@@ -140,9 +140,11 @@ import functools
 
 @functools.lru_cache(maxsize=64)
 def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
-                       max_new_tokens: int, temperature: float):
-    """One jitted prefill+decode program per (cfg, shapes, temperature)
-    — repeated calls (the serving hot path) reuse the compilation."""
+                       max_new_tokens: int, temperature: float,
+                       top_k: int, top_p: float):
+    """One jitted prefill+decode program per (cfg, shapes, sampling
+    params) — repeated calls (the serving hot path) reuse the
+    compilation."""
 
     def run(params, prompt, rng):
         # Size the cache to THIS request's reach (128-lane aligned),
@@ -156,9 +158,13 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
         def sample(logits, key):
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                key, logits / jnp.float32(temperature), axis=-1
-            ).astype(jnp.int32)
+            # Temperature FIRST: the nucleus must be measured on the
+            # distribution actually sampled (HF/llama.cpp semantics) —
+            # top-k is scale-invariant but top-p is not.
+            logits = logits / jnp.float32(temperature)
+            logits = _filter_logits(logits, top_k, top_p)
+            return jax.random.categorical(key, logits,
+                                          axis=-1).astype(jnp.int32)
 
         first = sample(logits, jax.random.fold_in(rng, 0))
 
@@ -175,15 +181,43 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
     return jax.jit(run)
 
 
+def _filter_logits(logits: jax.Array, top_k: int,
+                   top_p: float) -> jax.Array:
+    """Nucleus/top-k filtering: mask logits outside the top-k set and
+    outside the smallest prefix whose probability mass reaches top_p.
+    ``top_k <= 0`` / ``top_p >= 1`` disable the respective filter.
+    logits: (B, V) f32."""
+    if top_k > 0:
+        k = min(top_k, logits.shape[-1])  # top_k > V means "keep all"
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep every token whose PRECEDING mass is < top_p (the first
+        # token always survives; the one that crosses the threshold is
+        # included, matching the standard nucleus definition).
+        keep_sorted = (cum - probs) < top_p
+        # Threshold back in logit space: the smallest kept logit.
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(params: dict, cfg: tfm.TransformerConfig,
              prompt: jax.Array, max_new_tokens: int,
              temperature: float = 0.0,
-             rng: jax.Array | None = None) -> jax.Array:
+             rng: jax.Array | None = None,
+             top_k: int = 0, top_p: float = 1.0) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
-    One compiled program (cached per cfg/shape/temperature): prefill
-    then a ``lax.scan`` decode loop. ``temperature == 0`` → greedy;
-    else softmax sampling.
+    One compiled program (cached per cfg/shape/sampling params):
+    prefill then a ``lax.scan`` decode loop. ``temperature == 0`` →
+    greedy; else softmax sampling, optionally filtered to the top-k
+    logits and/or the top-p (nucleus) probability mass.
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -192,7 +226,15 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
             f"generate: prompt {S} + new {max_new_tokens} exceeds "
             f"max_seq {cfg.max_seq}"
         )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if temperature == 0.0:
+        # Greedy ignores the filters — normalize them out of the
+        # compile-cache key so differing sampling params can't force
+        # redundant recompiles of an identical program.
+        top_k, top_p = 0, 1.0
     run = _compiled_generate(cfg, B, S, int(max_new_tokens),
-                             float(temperature))
+                             float(temperature), int(top_k),
+                             float(top_p))
     return run(params, prompt, rng)

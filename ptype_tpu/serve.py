@@ -50,7 +50,8 @@ class GeneratorActor:
             lambda p, t: tfm.forward(p, t, self.cfg))
 
     def Generate(self, prompt, max_new_tokens: int = 16,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0):
         """prompt: (B, S) int32 tokens → (B, max_new_tokens) int32."""
         prompt = _norm_prompt(prompt)
         with self._lock:
@@ -58,6 +59,7 @@ class GeneratorActor:
             out = gen.generate(
                 self.params, self.cfg, prompt, int(max_new_tokens),
                 float(temperature), jax.random.PRNGKey(int(seed)),
+                top_k=int(top_k), top_p=float(top_p),
             )
         return out
 
@@ -126,11 +128,12 @@ class BatchingGeneratorActor(GeneratorActor):
         self._thread.start()
 
     def Generate(self, prompt, max_new_tokens: int = 16,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0):
         if float(temperature) != 0.0:
             # Exact per-request sampling semantics: solo path.
             return super().Generate(prompt, max_new_tokens, temperature,
-                                    seed)
+                                    seed, top_k, top_p)
         req = _Pending(_norm_prompt(prompt), int(max_new_tokens))
         with self._cond:
             if self._closed:

@@ -102,3 +102,54 @@ def test_gqa_generate_matches_forward():
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
         seq = jnp.concatenate([seq, nxt], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 6:]))
+
+
+def test_filter_logits_top_k_and_top_p():
+    from ptype_tpu.models.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.10]]))
+    # top_k=2: only the two largest survive.
+    out = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    assert np.isfinite(out[0, :2]).all() and np.isneginf(out[0, 2:]).all()
+    # top_p=0.6: 0.5 alone is < 0.6 of preceding mass for token 2? The
+    # nucleus keeps {0.5, 0.25} (0.5 < 0.6 at the second token's
+    # preceding mass) and drops the rest.
+    out = np.asarray(_filter_logits(logits, top_k=0, top_p=0.6))
+    assert np.isfinite(out[0, :2]).all() and np.isneginf(out[0, 2:]).all()
+    # top_p tiny: the argmax always survives.
+    out = np.asarray(_filter_logits(logits, top_k=0, top_p=1e-9))
+    assert np.isfinite(out[0, 0]) and np.isneginf(out[0, 1:]).all()
+    # Disabled filters are a no-op.
+    out = np.asarray(_filter_logits(logits, top_k=0, top_p=1.0))
+    np.testing.assert_array_equal(out, np.asarray(logits))
+
+
+def test_generate_top_k1_equals_greedy():
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    greedy = gen.generate(params, cfg, prompt, 6)
+    k1 = gen.generate(params, cfg, prompt, 6, temperature=0.9,
+                      top_k=1, rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_generate_top_p_validation():
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="top_p"):
+        gen.generate(params, cfg, jnp.zeros((1, 4), jnp.int32), 2,
+                     top_p=0.0)
+
+
+def test_greedy_normalizes_sampling_params_in_cache():
+    from ptype_tpu.models.generate import _compiled_generate
+
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    before = _compiled_generate.cache_info().currsize
+    gen.generate(params, cfg, prompt, 2, temperature=0.0, top_k=5)
+    gen.generate(params, cfg, prompt, 2, temperature=0.0, top_p=0.5)
+    after = _compiled_generate.cache_info().currsize
+    assert after - before <= 1, "greedy sampling params fragmented cache"
