@@ -189,7 +189,9 @@ def _filter_logits(logits: jax.Array, top_k: int,
     logits: (B, V) f32."""
     if top_k > 0:
         k = min(top_k, logits.shape[-1])  # top_k > V means "keep all"
-        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+        # lax.top_k (selection) beats a full-vocab sort in the decode
+        # hot loop; the smallest of the k kept values is the threshold.
+        kth = lax.top_k(logits, k)[0][:, -1][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
@@ -211,13 +213,17 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
              prompt: jax.Array, max_new_tokens: int,
              temperature: float = 0.0,
              rng: jax.Array | None = None,
-             top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+             top_k: int = 0, top_p: float = 1.0,
+             stop_token: int = -1, pad_token: int = 0) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
     One compiled program (cached per cfg/shape/sampling params):
     prefill then a ``lax.scan`` decode loop. ``temperature == 0`` →
     greedy; else softmax sampling, optionally filtered to the top-k
     logits and/or the top-p (nucleus) probability mass.
+    ``stop_token >= 0``: output positions after a row's first stop
+    token are filled with ``pad_token`` (static-shape early stopping —
+    the loop length never varies, only the output mask).
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -237,4 +243,14 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
     run = _compiled_generate(cfg, B, S, int(max_new_tokens),
                              float(temperature), int(top_k),
                              float(top_p))
-    return run(params, prompt, rng)
+    out = run(params, prompt, rng)
+    if stop_token >= 0:
+        # Post-processing OUTSIDE the jitted program: everything after
+        # a row's first stop token becomes pad. Keeping stop/pad out of
+        # the compile key means two tokenizers' EOS ids share one
+        # compiled decode program; the O(B·max_new) mask is trivial.
+        hit = out == stop_token
+        after_stop = (jnp.cumsum(hit.astype(jnp.int32), axis=1)
+                      - hit.astype(jnp.int32)) > 0
+        out = jnp.where(after_stop, jnp.int32(pad_token), out)
+    return out

@@ -153,3 +153,26 @@ def test_greedy_normalizes_sampling_params_in_cache():
     gen.generate(params, cfg, prompt, 2, temperature=0.0, top_p=0.5)
     after = _compiled_generate.cache_info().currsize
     assert after - before <= 1, "greedy sampling params fragmented cache"
+
+
+def test_stop_token_masks_tail():
+    """Positions after a row's first stop token become pad; the stop
+    token itself is kept; rows without a stop are untouched."""
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    plain = np.asarray(gen.generate(params, cfg, prompt, 8))
+    # Use the model's own most-emitted token as the stop token so the
+    # masking path actually triggers.
+    stop = int(np.bincount(plain.ravel()).argmax())
+    out = np.asarray(gen.generate(params, cfg, prompt, 8,
+                                  stop_token=stop, pad_token=255))
+    for row_plain, row in zip(plain, out):
+        hits = np.where(row_plain == stop)[0]
+        if hits.size == 0:
+            np.testing.assert_array_equal(row, row_plain)
+            continue
+        first = hits[0]
+        np.testing.assert_array_equal(row[:first + 1],
+                                      row_plain[:first + 1])
+        assert (row[first + 1:] == 255).all()
