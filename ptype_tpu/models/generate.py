@@ -141,10 +141,11 @@ import functools
 @functools.lru_cache(maxsize=64)
 def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
                        max_new_tokens: int, temperature: float,
-                       top_k: int, top_p: float):
+                       top_k: int, top_p: float, rep_penalty: float):
     """One jitted prefill+decode program per (cfg, shapes, sampling
     params) — repeated calls (the serving hot path) reuse the
     compilation."""
+    penalize = rep_penalty != 1.0
 
     def run(params, prompt, rng):
         # Size the cache to THIS request's reach (128-lane aligned),
@@ -154,8 +155,20 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
         reach = min(cfg.max_seq, -(-(S + max_new_tokens) // 128) * 128)
         cache = init_cache(cfg, B, max_seq=reach)
         logits, cache = prefill(params, prompt, cfg, cache)
+        # Token-presence mask for repetition penalty: prompt tokens
+        # count as seen (HF semantics), emitted tokens join per step.
+        seen = (jnp.zeros((B, cfg.vocab_size), jnp.bool_)
+                .at[jnp.arange(B)[:, None], prompt].set(True)
+                if penalize else None)
 
-        def sample(logits, key):
+        def sample(logits, key, seen):
+            if penalize:
+                # HF repetition penalty: seen tokens' positive logits
+                # divide by the penalty, negative multiply — both push
+                # probability down for penalty > 1.
+                pen = jnp.where(logits > 0, logits / rep_penalty,
+                                logits * rep_penalty)
+                logits = jnp.where(seen, pen, logits)
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             # Temperature FIRST: the nucleus must be measured on the
@@ -166,16 +179,22 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
             return jax.random.categorical(key, logits,
                                           axis=-1).astype(jnp.int32)
 
-        first = sample(logits, jax.random.fold_in(rng, 0))
+        def mark(seen, token):
+            if not penalize:
+                return None
+            return seen.at[jnp.arange(B), token].set(True)
+
+        first = sample(logits, jax.random.fold_in(rng, 0), seen)
+        seen = mark(seen, first)
 
         def step(carry, i):
-            token, cache = carry
+            token, cache, seen = carry
             logits, cache = decode_step(params, token, S + i, cfg, cache)
-            nxt = sample(logits, jax.random.fold_in(rng, i + 1))
-            return (nxt, cache), token
+            nxt = sample(logits, jax.random.fold_in(rng, i + 1), seen)
+            return (nxt, cache, mark(seen, nxt)), token
 
-        (_, _), toks = lax.scan(
-            step, (first, cache), jnp.arange(max_new_tokens))
+        (_, _, _), toks = lax.scan(
+            step, (first, cache, seen), jnp.arange(max_new_tokens))
         return toks.T  # (B, max_new_tokens): ys are the emitted tokens
 
     return jax.jit(run)
@@ -214,7 +233,8 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
              temperature: float = 0.0,
              rng: jax.Array | None = None,
              top_k: int = 0, top_p: float = 1.0,
-             stop_token: int = -1, pad_token: int = 0) -> jax.Array:
+             stop_token: int = -1, pad_token: int = 0,
+             repetition_penalty: float = 1.0) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
     One compiled program (cached per cfg/shape/sampling params):
@@ -224,6 +244,8 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
     ``stop_token >= 0``: output positions after a row's first stop
     token are filled with ``pad_token`` (static-shape early stopping —
     the loop length never varies, only the output mask).
+    ``repetition_penalty > 1`` discounts logits of every token already
+    seen (prompt + emitted, HF semantics) — applies to greedy too.
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -240,9 +262,13 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
         # compile-cache key so differing sampling params can't force
         # redundant recompiles of an identical program.
         top_k, top_p = 0, 1.0
+    if repetition_penalty <= 0.0:
+        raise ValueError(
+            f"generate: repetition_penalty must be > 0, "
+            f"got {repetition_penalty}")
     run = _compiled_generate(cfg, B, S, int(max_new_tokens),
                              float(temperature), int(top_k),
-                             float(top_p))
+                             float(top_p), float(repetition_penalty))
     out = run(params, prompt, rng)
     if stop_token >= 0:
         # Post-processing OUTSIDE the jitted program: everything after

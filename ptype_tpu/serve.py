@@ -51,7 +51,9 @@ class GeneratorActor:
 
     def Generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0,
+                 stop_token: int = -1, pad_token: int = 0,
+                 repetition_penalty: float = 1.0):
         """prompt: (B, S) int32 tokens → (B, max_new_tokens) int32."""
         prompt = _norm_prompt(prompt)
         with self._lock:
@@ -60,6 +62,8 @@ class GeneratorActor:
                 self.params, self.cfg, prompt, int(max_new_tokens),
                 float(temperature), jax.random.PRNGKey(int(seed)),
                 top_k=int(top_k), top_p=float(top_p),
+                stop_token=int(stop_token), pad_token=int(pad_token),
+                repetition_penalty=float(repetition_penalty),
             )
         return out
 
@@ -129,11 +133,17 @@ class BatchingGeneratorActor(GeneratorActor):
 
     def Generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0,
-                 top_k: int = 0, top_p: float = 1.0):
-        if float(temperature) != 0.0:
-            # Exact per-request sampling semantics: solo path.
+                 top_k: int = 0, top_p: float = 1.0,
+                 stop_token: int = -1, pad_token: int = 0,
+                 repetition_penalty: float = 1.0):
+        if (float(temperature) != 0.0
+                or float(repetition_penalty) != 1.0
+                or int(stop_token) >= 0):
+            # Sampling params / stop masking are per-request semantics:
+            # solo path (greedy same-shape requests still batch).
             return super().Generate(prompt, max_new_tokens, temperature,
-                                    seed, top_k, top_p)
+                                    seed, top_k, top_p, stop_token,
+                                    pad_token, repetition_penalty)
         req = _Pending(_norm_prompt(prompt), int(max_new_tokens))
         with self._cond:
             if self._closed:

@@ -176,3 +176,22 @@ def test_stop_token_masks_tail():
         np.testing.assert_array_equal(row[:first + 1],
                                       row_plain[:first + 1])
         assert (row[first + 1:] == 255).all()
+
+
+def test_repetition_penalty_suppresses_repeats():
+    """A huge penalty forbids re-emitting any seen token (greedy): all
+    emitted tokens are distinct from each other and from the prompt."""
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = np.asarray(gen.generate(params, cfg, prompt, 12,
+                                  repetition_penalty=1e9))[0]
+    emitted = list(out)
+    assert len(set(emitted)) == len(emitted), f"repeat in {emitted}"
+    assert not (set(emitted) & {1, 2, 3, 4}), "prompt token re-emitted"
+    # penalty=1.0 is the identity (same program as before the feature).
+    a = gen.generate(params, cfg, prompt, 6)
+    b = gen.generate(params, cfg, prompt, 6, repetition_penalty=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        gen.generate(params, cfg, prompt, 2, repetition_penalty=0.0)
