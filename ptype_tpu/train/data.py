@@ -48,6 +48,31 @@ def write_token_file(path: str, tokens, dtype=None) -> None:
     arr.astype(dtype or arr.dtype).tofile(path)
 
 
+def local_row_range(sharding, batch: int, seq: int) -> tuple[int, int]:
+    """[lo, hi) batch rows this process's addressable devices cover
+    under ``sharding`` for a (batch, seq) array — contiguous for the
+    standard data-axis batch specs, so a multi-controller loader can
+    materialize only its slice of the global batch."""
+    idx = sharding.addressable_devices_indices_map((batch, seq))
+    row_slices = [s[0] for s in idx.values()]
+    lo = min(s.start or 0 for s in row_slices)
+    hi = max(batch if s.stop is None else s.stop for s in row_slices)
+    covered = {r for s in row_slices
+               for r in range((s.start or 0),
+                              batch if s.stop is None else s.stop)}
+    if covered != set(range(lo, hi)):
+        # Interleaved/gapped device placement (a mesh NOT built via the
+        # registry's process-id ordering): min/max would claim rows
+        # this process doesn't own and the loader would feed
+        # make_array_from_process_local_data the wrong rows — fail
+        # loudly instead.
+        raise ValueError(
+            "local_row_range: this process's batch rows are not "
+            "contiguous under the sharding; use a process-contiguous "
+            "mesh (mesh_from_registry) or load the full batch")
+    return lo, hi
+
+
 class TokenFileDataset:
     """Memory-mapped flat token corpus → prefetched device batches.
 
@@ -86,17 +111,39 @@ class TokenFileDataset:
         def producer():
             import jax
 
+            # Multi-controller: every process draws the SAME window
+            # starts (shared seed → identical rng stream), but each
+            # MATERIALIZES only the batch rows its addressable shards
+            # cover — per-host IO and memmap reads scale down with the
+            # process count instead of every host reading the full
+            # global batch.
+            def make_batch(rows_for, to_device):
+                starts = rng.integers(
+                    0, self.n_tokens - seq - 1, size=batch)
+                rows = np.stack([
+                    np.asarray(self._data[s: s + seq + 1])
+                    for s in rows_for(starts)
+                ]).astype(np.int32)
+                out = {"tokens": rows[:, :-1], "targets": rows[:, 1:]}
+                return {k: to_device(v) for k, v in out.items()}
+
+            sh = self._sharding
+            local_rows = (local_row_range(sh, batch, seq)
+                          if sh is not None and jax.process_count() > 1
+                          else None)
+
             try:
                 while not stop.is_set():
-                    starts = rng.integers(
-                        0, self.n_tokens - seq - 1, size=batch)
-                    rows = np.stack([
-                        np.asarray(self._data[s: s + seq + 1])
-                        for s in starts
-                    ]).astype(np.int32)
-                    out = {"tokens": rows[:, :-1], "targets": rows[:, 1:]}
-                    out = {k: jax.device_put(v, self._sharding)
-                           for k, v in out.items()}
+                    if local_rows is not None:
+                        lo, hi = local_rows
+                        out = make_batch(
+                            lambda st: st[lo:hi],
+                            lambda v: jax.make_array_from_process_local_data(
+                                sh, v, (batch,) + v.shape[1:]))
+                    else:
+                        out = make_batch(
+                            lambda st: st,
+                            lambda v: jax.device_put(v, sh))
                     # Bounded put so the thread exits promptly once the
                     # consumer abandons the iterator (no immortal thread
                     # pinning device buffers).

@@ -110,9 +110,32 @@ def main() -> None:
 
         Checkpointer(ckpt_dir).save(int(out["step"]), state)
 
+    # Per-process data loading: each controller materializes ONLY its
+    # row slice of the global batch (train/data.py local_row_range +
+    # make_array_from_process_local_data); every addressable shard must
+    # carry exactly the rows a full single-reader pass would produce.
+    corpus = sys.argv[5] if len(sys.argv) > 5 else None
+    data_ok = None
+    if corpus:
+        from ptype_tpu.train.data import TokenFileDataset
+
+        ds = TokenFileDataset(corpus, dtype="uint16", sharding=sh)
+        it = ds.batches(B, S, seed=9)
+        b = next(it)
+        it.close()
+        rng2 = np.random.default_rng(9)
+        starts = rng2.integers(0, ds.n_tokens - S - 1, size=B)
+        ref = np.stack([np.asarray(ds._data[s:s + S + 1])
+                        for s in starts]).astype(np.int32)
+        data_ok = all(
+            np.array_equal(np.asarray(shd.data),
+                           ref[:, :-1][shd.index[0]])
+            for shd in b["tokens"].addressable_shards)
+
     print(json.dumps({"ready": True, "pid": os.getpid(),
                       "process_id": pid, "losses": losses,
                       "n_devices": len(jax.devices()),
+                      "data_ok": data_ok,
                       "step": int(out["step"])}), flush=True)
     threading.Event().wait()  # runner reaps us
 

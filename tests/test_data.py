@@ -65,3 +65,19 @@ def test_token_file_too_small(tmp_path):
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_local_row_range_covers_addressable_rows():
+    """The multi-controller loader's row slice: in a single process the
+    addressable rows are the whole batch; a sharding that replicates
+    rows still yields the full [0, batch)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.data import local_row_range
+
+    mesh = build_mesh({"data": 8})
+    lo, hi = local_row_range(NamedSharding(mesh, P("data", None)), 16, 32)
+    assert (lo, hi) == (0, 16)
+    lo, hi = local_row_range(NamedSharding(mesh, P()), 16, 32)
+    assert (lo, hi) == (0, 16)

@@ -52,6 +52,9 @@ def _reference_losses(n: int = 4) -> list[float]:
 def test_two_process_sharded_training_step(tmp_path):
     coord_port = _free_port()
     ckpt_dir = str(tmp_path / "ckpt")
+    corpus = str(tmp_path / "corpus.bin")
+    np.random.default_rng(0).integers(
+        0, 250, 4096).astype(np.uint16).tofile(corpus)
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -60,7 +63,7 @@ def test_two_process_sharded_training_step(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(pid), "2", str(coord_port),
-             ckpt_dir],
+             ckpt_dir, corpus],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=REPO)
         for pid in (0, 1)
@@ -89,6 +92,9 @@ def test_two_process_sharded_training_step(tmp_path):
     for rec in results.values():
         assert rec["n_devices"] == 4, rec
         assert rec["step"] == 3, rec
+        # Per-process loader: each controller's shards matched the
+        # single-reader reference rows.
+        assert rec["data_ok"] is True, rec
     reference = _reference_losses(4)
     # Replicated loss: both controllers must hold the same value.
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
