@@ -14,6 +14,7 @@ and train. Three modes:
   push/pull.
 
 Env knobs: PRESET (optimus-125m), STEPS, BATCH, SEQ, MODE,
+LR/WARMUP/WEIGHT_DECAY/DECAY_STEPS (optimizer), METRICS_PATH (JSONL sink),
 COMPRESS (store mode: bf16|int8 gradient-push wire compression).
 """
 
@@ -45,11 +46,28 @@ def main() -> None:
     print(f"optimus[{mode}] {preset} on {n_dev} devices, "
           f"batch={batch} seq={seq}", flush=True)
 
+    # Optimizer knobs ($LR/$WARMUP/$WEIGHT_DECAY/$DECAY_STEPS) and a
+    # JSONL metrics sink ($METRICS_PATH — tail-able, one line per log
+    # interval) for real runs.
+    from ptype_tpu.train.trainer import default_optimizer
+
+    optimizer = default_optimizer(
+        lr=float(os.environ.get("LR", "3e-4")),
+        weight_decay=float(os.environ.get("WEIGHT_DECAY", "0.1")),
+        warmup=int(os.environ.get("WARMUP", "100")),
+        decay_steps=int(os.environ.get("DECAY_STEPS", "100000")),
+    )
+    mw = None
+    if os.environ.get("METRICS_PATH"):
+        from ptype_tpu.metrics import MetricsWriter
+
+        mw = MetricsWriter(os.environ["METRICS_PATH"])
+
     try:
         if mode == "gspmd":
             from ptype_tpu.train.trainer import Trainer
 
-            trainer = Trainer(model_cfg, mesh)
+            trainer = Trainer(model_cfg, mesh, optimizer=optimizer)
             print(f"params: {trainer.n_params/1e6:.1f}M", flush=True)
             # CKPT_DIR enables save/resume: restart the process with the
             # same dir and training continues from the latest complete
@@ -73,6 +91,12 @@ def main() -> None:
                     print(f"step {out['step']:5d} loss {out['loss']:.4f} "
                           f"tok/s/chip {out['tokens_per_sec_per_chip']:.0f} "
                           f"mfu {out['mfu']:.3f}", flush=True)
+                    if mw is not None:
+                        mw.emit(int(out["step"]), loss=out["loss"],
+                                grad_norm=out["grad_norm"],
+                                tokens_per_sec_per_chip=out[
+                                    "tokens_per_sec_per_chip"],
+                                mfu=out["mfu"])
                 if (ck is not None and ckpt_every
                         and (i + 1) % ckpt_every == 0):
                     trainer.sync()
@@ -102,7 +126,8 @@ def main() -> None:
             store = TensorStore(mesh, kv=cluster.store,
                                 compress=os.environ.get("COMPRESS")
                                 or None)
-            trainer = StoreDPTrainer(model_cfg, store)
+            trainer = StoreDPTrainer(model_cfg, store,
+                                     optimizer=optimizer)
             # CKPT_DIR persists the Store's parameter space (the
             # durability etcd's data-dir gave the reference Store).
             # Resume restores params INTO the store after the trainer
@@ -130,6 +155,9 @@ def main() -> None:
                 if i % 10 == 0 or i == steps - 1:
                     print(f"step {out['step']:5d} loss {out['loss']:.4f} "
                           f"grad_epoch {out['grad_epoch']}", flush=True)
+                    if mw is not None:
+                        mw.emit(int(out["step"]), loss=out["loss"],
+                                grad_epoch=out["grad_epoch"])
                 if sc is not None and ckpt_every and (
                         i + 1) % ckpt_every == 0:
                     # Step passed explicitly: params epochs don't bump
@@ -145,16 +173,21 @@ def main() -> None:
             from ptype_tpu.train.param_server import AsyncWorker, ParamServer
 
             store = TensorStore(mesh, kv=cluster.store)
-            server = ParamServer(model_cfg, store)
+            server = ParamServer(model_cfg, store, optimizer=optimizer)
             worker = AsyncWorker(model_cfg, server)
             for i in range(steps):
                 out = worker.step(next(stream))
                 if i % 10 == 0 or i == steps - 1:
                     print(f"step {i:5d} loss {out['loss']:.4f} "
                           f"applied={out['applied']}", flush=True)
+                    if mw is not None:
+                        mw.emit(i, loss=out["loss"],
+                                applied=float(out["applied"]))
         else:
             raise SystemExit(f"unknown MODE {mode!r}")
     finally:
+        if mw is not None:
+            mw.close()
         cluster.close()
 
 

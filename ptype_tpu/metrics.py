@@ -130,6 +130,50 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+class MetricsWriter:
+    """Append-only JSONL metrics sink for training runs.
+
+    One ``{"ts": ..., "step": ..., **scalars}`` line per emit —
+    tail-able during a run, trivially loadable after (pandas/jq); the
+    file-based observability tier beneath profiler traces. Flushed per
+    line so a SIGKILLed run keeps everything emitted before the kill.
+    """
+
+    def __init__(self, path: str):
+        import os
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def emit(self, step: int, **scalars) -> None:
+        import math
+
+        rec = {"ts": round(time.time(), 3), "step": int(step)}
+        for k, v in scalars.items():
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                rec[k] = str(v)
+                continue
+            # json.dumps would emit the invalid-JSON token `NaN` and
+            # break jq/strict parsers on exactly the diverging runs
+            # where the file matters most — stringify non-finite.
+            rec[k] = f if math.isfinite(f) else str(f)
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
 # ------------------------------------------------------------- profiling
 # The reference had zap logging only (SURVEY.md §5 "Tracing/profiling:
 # Absent"); the TPU build owes JAX profiler traces (XPlane/TensorBoard)

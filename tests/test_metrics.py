@@ -1,0 +1,21 @@
+"""Metrics sinks: the JSONL writer (file-based observability tier)."""
+
+def test_metrics_writer_jsonl(tmp_path):
+    import json
+
+    from ptype_tpu.metrics import MetricsWriter
+
+    path = tmp_path / "m.jsonl"
+    w = MetricsWriter(str(path))
+    w.emit(1, loss=2.5, note="warmup")
+    w.emit(2, loss=2.25)
+    w.close()
+    recs = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[0]["loss"] == 2.5 and recs[0]["note"] == "warmup"
+    assert all("ts" in r for r in recs)
+    # Append-only across writers (restart keeps history).
+    w2 = MetricsWriter(str(path))
+    w2.emit(3, loss=2.0)
+    w2.close()
+    assert len(path.read_text().splitlines()) == 3
