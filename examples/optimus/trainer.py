@@ -32,19 +32,6 @@ from ptype_tpu.train.data import synthetic_batches
 
 def main() -> None:
     cfg = config_from_env()
-    cluster = join(cfg)
-    mode = os.environ.get("MODE", "gspmd")
-    preset = os.environ.get("PRESET", "optimus-125m")
-    steps = int(os.environ.get("STEPS", "50"))
-    seq = int(os.environ.get("SEQ", "1024"))
-
-    model_cfg = tfm.preset(preset)
-    mesh = cluster.mesh()
-    n_dev = mesh.devices.size
-    batch = int(os.environ.get("BATCH", str(8 * n_dev)))
-    stream = synthetic_batches(model_cfg.vocab_size, batch, seq)
-    print(f"optimus[{mode}] {preset} on {n_dev} devices, "
-          f"batch={batch} seq={seq}", flush=True)
 
     # Optimizer knobs ($LR/$WARMUP/$WEIGHT_DECAY/$DECAY_STEPS) and a
     # JSONL metrics sink ($METRICS_PATH — tail-able, one line per log
@@ -62,6 +49,20 @@ def main() -> None:
         from ptype_tpu.metrics import MetricsWriter
 
         mw = MetricsWriter(os.environ["METRICS_PATH"])
+
+    cluster = join(cfg)
+    mode = os.environ.get("MODE", "gspmd")
+    preset = os.environ.get("PRESET", "optimus-125m")
+    steps = int(os.environ.get("STEPS", "50"))
+    seq = int(os.environ.get("SEQ", "1024"))
+
+    model_cfg = tfm.preset(preset)
+    mesh = cluster.mesh()
+    n_dev = mesh.devices.size
+    batch = int(os.environ.get("BATCH", str(8 * n_dev)))
+    stream = synthetic_batches(model_cfg.vocab_size, batch, seq)
+    print(f"optimus[{mode}] {preset} on {n_dev} devices, "
+          f"batch={batch} seq={seq}", flush=True)
 
     try:
         if mode == "gspmd":

@@ -198,6 +198,29 @@ def _q_int8_chunks(x: jax.Array):
     return q, scale
 
 
+def _int8_phase1(x, axis: str, op: str):
+    """The int8 reduce-scatter leg, shared by the quantized allreduce
+    and the standalone quantized reduce_scatter (one implementation so
+    numerics fixes can't drift between them): slice my contribution
+    into n chunks, quantize each with one absmax scale, all_to_all so
+    device j collects everyone's chunk j, dequantize and reduce.
+    Returns this device's reduced f32 chunk ``(rest[0]/n, *tail)``."""
+    n = lax.axis_size(axis)
+    c = x.shape[0] // n
+    chunks = x.reshape((n, c) + x.shape[1:])
+    q, scale = _q_int8_chunks(chunks)
+    q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
+                           tiled=True)
+    q = q.reshape((n, c) + x.shape[1:])
+    red = jnp.sum(
+        q.astype(jnp.float32) * scale.reshape((n,) + (1,) * x.ndim),
+        axis=0)
+    if op == "mean":
+        red = red / n
+    return red
+
+
 @functools.lru_cache(maxsize=256)
 def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
     in_spec = P(axis, *_rest(ndim))
@@ -206,36 +229,56 @@ def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
     def f(local):
         x = jnp.squeeze(local, axis=0)  # my contribution, shape `rest`
         n = lax.axis_size(axis)
-        c = x.shape[0] // n
-        bcast = (n,) + (1,) * x.ndim  # chunk scales → chunk shapes
-        # Phase 1 (reduce-scatter leg): slice my contribution into n
-        # chunks, quantize, all_to_all so device j collects everyone's
-        # chunk j — int8 payload + one f32 scale per chunk on the wire
-        # (≈4× fewer bytes than f32).
-        chunks = x.reshape((n, c) + x.shape[1:])
-        q, scale = _q_int8_chunks(chunks)
-        q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
-                           tiled=True)
-        scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
-                               tiled=True)
-        q = q.reshape((n, c) + x.shape[1:])
-        red = jnp.sum(q.astype(jnp.float32) * scale.reshape(bcast),
-                      axis=0)
-        if op == "mean":
-            red = red / n
+        red = _int8_phase1(x, axis, op)
         # Phase 2 (all_gather leg): re-quantize my reduced chunk with
         # one scale, gather, dequantize — every device reassembles the
         # full reduced tensor.
         q2, s2 = _q_int8_chunks(red[None])  # one chunk → one scale
         qg = lax.all_gather(jnp.squeeze(q2, 0), axis)   # (n, c, *tail)
         sg = lax.all_gather(s2[0], axis)                # (n,)
-        out = qg.astype(jnp.float32) * sg.reshape(bcast)
+        out = qg.astype(jnp.float32) * sg.reshape(
+            (n,) + (1,) * x.ndim)
         return out.reshape(x.shape)
 
     return jax.jit(
         shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
                   check_vma=False)
     )
+
+
+@functools.lru_cache(maxsize=256)
+def _quantized_reduce_scatter_fn(mesh: Mesh, axis: str, ndim: int,
+                                 op: str):
+    in_spec = P(axis, *_rest(ndim))
+    out_spec = P(axis, *_rest(ndim - 1))
+
+    def f(local):
+        return _int8_phase1(jnp.squeeze(local, axis=0), axis, op)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_spec,
+                             out_specs=out_spec))
+
+
+def quantized_reduce_scatter(stacked: jax.Array, mesh: Mesh,
+                             axis: str = "data",
+                             op: str = "sum") -> jax.Array:
+    """Phase 1 of :func:`quantized_all_reduce` alone: int8-quantized
+    all_to_all + local dequant-reduce — each device keeps ONE f32
+    shard of the reduced tensor (the bandwidth-optimal int8 grad
+    reduction for consumers that are themselves sharded, ZeRO/FSDP
+    style). Same shape contract and error bound as the allreduce's
+    first phase (one round-to-nearest quantization)."""
+    n = int(mesh.shape[axis])
+    if not quantized_all_reduce_eligible(stacked.shape, n, op):
+        raise ValueError(
+            f"quantized_reduce_scatter: need op in sum/mean (got "
+            f"{op!r}), leading dim == axis size {n} (got "
+            f"{stacked.shape[0]}), and payload dim 0 to divide by {n} "
+            f"(got {stacked.shape[1:]})")
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim))))
+    return _quantized_reduce_scatter_fn(mesh, axis, stacked.ndim,
+                                        op)(stacked)
 
 
 def quantized_all_reduce_eligible(shape: tuple, n: int,

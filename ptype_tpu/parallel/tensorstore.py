@@ -209,12 +209,19 @@ class TensorStore:
         bandwidth-optimal allreduce decomposition."""
         b = Binding(P(self.axis), op or self.binding(key).reduce_op)
         stacked = jnp.asarray(stacked)
-        # int8 applies to push() only; scatter under int8 stays exact.
-        wire = (stacked.astype(jnp.bfloat16) if self.compress == "bf16"
-                else stacked)
-        reduced = collectives.reduce_scatter(
-            wire, self.mesh, self.axis, b.reduce_op
-        )
+        n = int(self.mesh.shape[self.axis])
+        if (self.compress == "int8"
+                and collectives.quantized_all_reduce_eligible(
+                    stacked.shape, n, b.reduce_op)):
+            reduced = collectives.quantized_reduce_scatter(
+                stacked, self.mesh, self.axis, b.reduce_op)
+        else:
+            # int8-ineligible leaves ride the exact allreduce — the
+            # caller opted into int8 loss, not bf16 loss.
+            wire = (stacked.astype(jnp.bfloat16)
+                    if self.compress == "bf16" else stacked)
+            reduced = collectives.reduce_scatter(
+                wire, self.mesh, self.axis, b.reduce_op)
         if self.compress:
             reduced = reduced.astype(stacked.dtype)
         return self._commit(key, reduced, b)

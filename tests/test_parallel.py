@@ -151,6 +151,14 @@ class TestCollectives:
         with pytest.raises(ValueError, match="op"):
             C.quantized_all_reduce(jnp.ones((8, 16)), mesh8, op="max")
 
+    def test_quantized_reduce_scatter_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(8, 64)).astype(np.float32)
+        rs = C.quantized_reduce_scatter(jnp.asarray(x), mesh8, op="sum")
+        tol = 1.5 * np.abs(x).max() / 127.0 * 8  # one quantization, sum of 8
+        np.testing.assert_allclose(np.asarray(rs), x.sum(0), atol=tol)
+        assert not rs.sharding.is_fully_replicated
+
     def test_ring_shift(self, mesh8):
         x = jnp.arange(8, dtype=jnp.float32)[:, None]
         out = np.asarray(C.ring_shift(x, mesh8, shift=1))
@@ -227,6 +235,10 @@ class TestTensorStore:
         assert out.dtype == jnp.float32
         tol = 2.5 * np.abs(x).max() / 127.0
         np.testing.assert_allclose(np.asarray(out), x.mean(0), atol=tol)
+        # Scatter variant under int8: quantized phase-1 path.
+        rs = ts.push_scatter("gs", jnp.asarray(x), op="mean")
+        tol = 1.5 * np.abs(x).max() / 127.0
+        np.testing.assert_allclose(np.asarray(rs), x.mean(0), atol=tol)
         # Leaves too small to chunk over the axis ride the EXACT
         # allreduce (not bf16): the caller opted into int8 loss only.
         small = ts.push("b", jnp.full((8, 4), 1.001, jnp.float32),
