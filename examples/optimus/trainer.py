@@ -146,10 +146,16 @@ def main() -> None:
                 # Probe emptiness explicitly so a CORRUPT checkpoint
                 # still fails loudly instead of silently restarting
                 # from step 0.
-                if sc.latest_step() is not None:
+                resumed_step = sc.latest_step()
+                if resumed_step is not None:
                     restored = sc.resume()
-                    print(f"resumed {len(restored)} Store keys",
-                          flush=True)
+                    # Continue the step numbering: a counter restarting
+                    # at 0 would re-save the previous run's step
+                    # numbers, hit the already-committed guard, and
+                    # silently never persist new progress.
+                    trainer.step_count = resumed_step
+                    print(f"resumed {len(restored)} Store keys at "
+                          f"step {resumed_step}", flush=True)
             saved_i = -1
             for i in range(steps):
                 out = trainer.step(next(stream))

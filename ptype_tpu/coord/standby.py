@@ -365,14 +365,22 @@ class Standby:
             # The monitor is MID-automatic-promotion (CoordServer
             # construction can replay a large WAL); racing it would
             # spin against our own server's flock and misdiagnose as
-            # "primary still alive". Wait for its outcome instead.
-            if self.promoted.wait(timeout=timeout) and self.server:
-                return self.server
-            raise RuntimeError(
-                "promote: standby monitor wedged mid-promotion — "
-                "inspect the coordinator data_dir before retrying")
+            # "primary still alive". Wait for its outcome — but a
+            # monitor whose attempt FAILS exits cleanly (it saw
+            # _closed) without promoting: fall through to the
+            # deliberate promotion below rather than misdiagnosing a
+            # healthy standby as wedged.
+            deadline = _time.monotonic() + timeout
+            while (self._thread.is_alive()
+                   and _time.monotonic() < deadline):
+                if self.promoted.wait(timeout=0.2):
+                    break
+            if self._thread.is_alive() and not self.promoted.is_set():
+                raise RuntimeError(
+                    "promote: standby monitor wedged mid-promotion — "
+                    "inspect the coordinator data_dir before retrying")
         # The monitor may have completed an AUTOMATIC promotion while we
-        # were joining it.
+        # were joining/waiting on it.
         if self.promoted.is_set() and self.server is not None:
             return self.server
         if self.follower is not None:

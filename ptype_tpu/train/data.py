@@ -108,15 +108,22 @@ class TokenFileDataset:
         stop = threading.Event()
         ERR = "__prefetch_error__"
 
-        def producer():
-            import jax
+        import jax
 
-            # Multi-controller: every process draws the SAME window
-            # starts (shared seed → identical rng stream), but each
-            # MATERIALIZES only the batch rows its addressable shards
-            # cover — per-host IO and memmap reads scale down with the
-            # process count instead of every host reading the full
-            # global batch.
+        # Multi-controller: every process draws the SAME window starts
+        # (shared seed → identical rng stream), but each MATERIALIZES
+        # only the batch rows its addressable shards cover — per-host
+        # IO scales down with the process count. Computed in the
+        # CALLING thread: local_row_range's non-contiguous-placement
+        # ValueError must surface here, not kill the producer thread
+        # before its error-routing try block (the consumer would hang
+        # in q.get() forever).
+        sh = self._sharding
+        local_rows = (local_row_range(sh, batch, seq)
+                      if sh is not None and jax.process_count() > 1
+                      else None)
+
+        def producer():
             def make_batch(rows_for, to_device):
                 starts = rng.integers(
                     0, self.n_tokens - seq - 1, size=batch)
@@ -126,11 +133,6 @@ class TokenFileDataset:
                 ]).astype(np.int32)
                 out = {"tokens": rows[:, :-1], "targets": rows[:, 1:]}
                 return {k: to_device(v) for k, v in out.items()}
-
-            sh = self._sharding
-            local_rows = (local_row_range(sh, batch, seq)
-                          if sh is not None and jax.process_count() > 1
-                          else None)
 
             try:
                 while not stop.is_set():
