@@ -175,3 +175,25 @@ def test_restore_rejects_overlapping_shards(tmp_path):
         json.dump(manifest, f)
     with pytest.raises(ClusterError, match="overlap"):
         ckpt.restore({"w": jnp.zeros((8, 4), jnp.float32)}, step=1)
+
+
+def test_multi_recommit_of_committed_step_is_kept(tmp_path):
+    """Multi-controller path: re-saving an already-committed step must
+    keep the committed copy (deleting its marker before the new save
+    commits would let a peer crash at the barrier destroy good state)
+    — callers that want a fresh save of the same step delete the dir
+    first."""
+    # Drive _write_multi directly as "process 0 of 1" — the barrier
+    # sees its own manifest and commits immediately.
+    mesh = build_mesh({"data": 2})
+    tree = {"w": jax.device_put(jnp.ones((4,)),
+                                named_sharding(mesh, P()))}
+    ckpt = Checkpointer(str(tmp_path))
+    # Force the multi path regardless of process count.
+    path = ckpt._write_multi(5, ckpt._snapshot(tree), None, 0, 1)
+    marker = os.path.join(path, ".complete")
+    mtime = os.path.getmtime(marker)
+    assert ckpt._write_multi(5, ckpt._snapshot(tree), None, 0, 1) \
+        == path  # kept, not rewritten
+    assert os.path.getmtime(marker) == mtime
+    assert ckpt.latest_step() == 5
