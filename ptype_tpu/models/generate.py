@@ -55,12 +55,17 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int,
     return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
-def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
+def _cached_attention(q, k_cache, v_cache, pos_limit, cfg,
+                      valid_from=None):
     """q: (B, 1, H, Dh); caches: (B, Smax, Kh, Dh); attend to
     positions < pos_limit. GQA-native: query heads are grouped onto
     their kv head inside the einsum — no ``jnp.repeat``
     materializing H-head caches every decode step (the G=1 MHA case
-    is the same einsum)."""
+    is the same einsum).
+
+    ``valid_from`` (B,), optional: per-row first valid cache slot —
+    left-padded ragged prompts leave pad rows in slots
+    [0, valid_from); they stay masked for the row's whole decode."""
     B, _, H, Dh = q.shape
     Kh = k_cache.shape[2]
     G = H // Kh
@@ -68,8 +73,11 @@ def _cached_attention(q, k_cache, v_cache, pos_limit, cfg):
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
                         k_cache).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(Dh))
-    mask = jnp.arange(k_cache.shape[1]) < pos_limit  # (Smax,)
-    scores = jnp.where(mask[None, None, None, None, :], scores,
+    cols = jnp.arange(k_cache.shape[1])  # (Smax,)
+    mask = (cols < pos_limit)[None, :]
+    if valid_from is not None:
+        mask = mask & (cols[None, :] >= valid_from[:, None])
+    scores = jnp.where(mask[:, None, None, None, :], scores,
                        jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     o = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
@@ -84,21 +92,45 @@ def _head_logits(params, x_last, cfg):
 
 
 def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
-            cache: KVCache) -> tuple[jax.Array, KVCache]:
+            cache: KVCache,
+            prompt_lens: jax.Array | None = None
+            ) -> tuple[jax.Array, KVCache]:
     """Full-sequence forward, filling cache[:, :, :S]. Returns
     (last-position logits (B, V), cache). Block math is the shared
     transformer pieces (qkv_proj/attn_residual/mlp_residual), so
-    training and generation can never diverge."""
+    training and generation can never diverge.
+
+    ``prompt_lens`` (B,), optional: tokens are LEFT-padded — row i's
+    real prompt occupies columns [S - L_i, S). RoPE positions shift
+    per row so every prompt starts at position 0, pad keys are masked
+    out of attention, and the last column is every row's final real
+    token (which is why left-padding is the serving layout)."""
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
-    sin, cos = tfm.rope_tables(cfg, S)
+    if prompt_lens is None:
+        sin, cos = tfm.rope_tables(cfg, S)
+        kv_mask = None
+    else:
+        pad = S - prompt_lens  # (B,)
+        positions = jnp.maximum(
+            jnp.arange(S)[None, :] - pad[:, None], 0)
+        sin, cos = tfm.rope_tables(cfg, positions=positions)
+        kv_mask = jnp.arange(S)[None, :] >= pad[:, None]  # (B, S)
+
+    # MoE: ragged batches give every routed assignment an expert slot
+    # (capacity = T·top_k, the zero-drop bound). With the default
+    # token-priority capacity, LEFT-pad columns — which come first in
+    # each row — would claim expert slots ahead of real prompt tokens
+    # and make rows diverge from their solo decode.
+    cap = (B * S * cfg.expert_top_k
+           if prompt_lens is not None and cfg.n_experts else None)
 
     def body(x, inputs):
         layer, kc, vc = inputs
         q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
-        o = tfm._attention(q, k, v, cfg)
+        o = tfm._attention(q, k, v, cfg, kv_mask=kv_mask)
         x = tfm.attn_residual(x, o, layer, cfg)
-        x, _aux = tfm.mlp_residual(x, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=cap)
         kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
         return x, (kc, vc)
@@ -110,21 +142,33 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
 
 
 def decode_step(params: dict, token: jax.Array, pos: jax.Array,
-                cfg: tfm.TransformerConfig,
-                cache: KVCache) -> tuple[jax.Array, KVCache]:
-    """One decode step. token: (B,) int32 at position ``pos`` (scalar).
-    Returns (logits (B, V), updated cache). MoE capacity is pinned to
-    the step's token count (B) so no routed token can drop at decode."""
+                cfg: tfm.TransformerConfig, cache: KVCache,
+                rope_pos: jax.Array | None = None,
+                valid_from: jax.Array | None = None
+                ) -> tuple[jax.Array, KVCache]:
+    """One decode step. token: (B,) int32 at CACHE slot ``pos``
+    (scalar). Returns (logits (B, V), updated cache). MoE capacity is
+    pinned to the step's token count (B) so no routed token can drop
+    at decode.
+
+    Ragged (left-padded) prompts: ``rope_pos`` (B,) gives each row's
+    TOKEN position (cache slot minus its pad) and ``valid_from`` (B,)
+    its first real cache slot — slot and position coincide only in the
+    uniform-length case."""
     B = token.shape[0]
     x = params["embed"][token][:, None, :].astype(cfg.dtype)  # (B, 1, D)
-    sin, cos = tfm.rope_tables(cfg, positions=jnp.asarray(pos)[None])
+    if rope_pos is None:
+        sin, cos = tfm.rope_tables(cfg, positions=jnp.asarray(pos)[None])
+    else:
+        sin, cos = tfm.rope_tables(cfg, positions=rope_pos[:, None])
 
     def body(x, inputs):
         layer, kc, vc = inputs  # kc/vc: (B, Smax, Kh, Dh)
         q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        o = _cached_attention(q, kc, vc, pos + 1, cfg)
+        o = _cached_attention(q, kc, vc, pos + 1, cfg,
+                              valid_from=valid_from)
         x = tfm.attn_residual(x, o, layer, cfg)
         x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
         return x, (kc, vc)
@@ -144,22 +188,38 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
                        top_k: int, top_p: float, rep_penalty: float):
     """One jitted prefill+decode program per (cfg, shapes, sampling
     params) — repeated calls (the serving hot path) reuse the
-    compilation."""
+    compilation. ``run(params, prompt, lens, rng)``: ``lens`` is None
+    for uniform-length prompts (a static, empty pytree under jit) or
+    a traced (B,) lengths array for LEFT-padded ragged batches — ONE
+    implementation for both, so sampling fixes can't drift between
+    them."""
     penalize = rep_penalty != 1.0
 
-    def run(params, prompt, rng):
+    def run(params, prompt, lens, rng):
         # Size the cache to THIS request's reach (128-lane aligned),
         # not cfg.max_seq: decode reads the whole static cache every
         # step, so a 128+128-token call against a 1024-slot cache was
         # paying 4× the attention HBM traffic for masked-out zeros.
         reach = min(cfg.max_seq, -(-(S + max_new_tokens) // 128) * 128)
         cache = init_cache(cfg, B, max_seq=reach)
-        logits, cache = prefill(params, prompt, cfg, cache)
+        logits, cache = prefill(params, prompt, cfg, cache,
+                                prompt_lens=lens)
+        # (B,) first valid cache slot per row (0 when uniform).
+        pad = None if lens is None else S - lens
         # Token-presence mask for repetition penalty: prompt tokens
         # count as seen (HF semantics), emitted tokens join per step.
-        seen = (jnp.zeros((B, cfg.vocab_size), jnp.bool_)
-                .at[jnp.arange(B)[:, None], prompt].set(True)
-                if penalize else None)
+        seen = None
+        if penalize:
+            if lens is None:
+                idx = prompt
+            else:
+                # Pad columns must not count as "seen": redirect them
+                # to an out-of-bounds index dropped by the scatter.
+                valid = jnp.arange(S)[None, :] >= pad[:, None]
+                idx = jnp.where(valid, prompt, cfg.vocab_size)
+            seen = (jnp.zeros((B, cfg.vocab_size), jnp.bool_)
+                    .at[jnp.arange(B)[:, None], idx]
+                    .set(True, mode="drop"))
 
         def sample(logits, key, seen):
             if penalize:
@@ -189,7 +249,12 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
 
         def step(carry, i):
             token, cache, seen = carry
-            logits, cache = decode_step(params, token, S + i, cfg, cache)
+            # Cache slot S+i is uniform; each ragged row's TOKEN
+            # position is its own length + i (the left-pad offset).
+            logits, cache = decode_step(
+                params, token, S + i, cfg, cache,
+                rope_pos=None if lens is None else lens + i,
+                valid_from=pad)
             nxt = sample(logits, jax.random.fold_in(rng, i + 1), seen)
             return (nxt, cache, mark(seen, nxt)), token
 
@@ -198,6 +263,20 @@ def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
         return toks.T  # (B, max_new_tokens): ys are the emitted tokens
 
     return jax.jit(run)
+
+
+def pad_prompts(prompts, pad_token: int = 0):
+    """LEFT-pad a list of 1-D token arrays to one (B, S) batch.
+    Returns (padded int32 (B, S), lens int32 (B,)) for
+    ``generate(..., prompt_lens=lens)``."""
+    import numpy as np
+
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    S = int(lens.max())
+    out = np.full((len(prompts), S), pad_token, np.int32)
+    for i, p in enumerate(prompts):
+        out[i, S - len(p):] = np.asarray(p, np.int32)
+    return jnp.asarray(out), jnp.asarray(lens)
 
 
 def _filter_logits(logits: jax.Array, top_k: int,
@@ -234,7 +313,8 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
              rng: jax.Array | None = None,
              top_k: int = 0, top_p: float = 1.0,
              stop_token: int = -1, pad_token: int = 0,
-             repetition_penalty: float = 1.0) -> jax.Array:
+             repetition_penalty: float = 1.0,
+             prompt_lens: jax.Array | None = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
     One compiled program (cached per cfg/shape/sampling params):
@@ -246,6 +326,13 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
     the loop length never varies, only the output mask).
     ``repetition_penalty > 1`` discounts logits of every token already
     seen (prompt + emitted, HF semantics) — applies to greedy too.
+    ``prompt_lens`` (B,): the prompt batch is LEFT-padded ragged
+    (``pad_prompts``); lengths are traced, so one compiled program
+    serves any mix of lengths at this padded shape. Pad keys are
+    masked and RoPE offsets are per-row, so a GREEDY row decodes
+    exactly as it would solo; sampled rows draw from the batch-shaped
+    RNG stream, which differs from a solo call (same caveat as
+    uniform batching — the serving batcher coalesces greedy only).
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -266,10 +353,23 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
         raise ValueError(
             f"generate: repetition_penalty must be > 0, "
             f"got {repetition_penalty}")
+    lens = None
+    if prompt_lens is not None:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        if lens.shape != (B,):
+            raise ValueError(
+                f"generate: prompt_lens shape {lens.shape} != ({B},)")
+        import numpy as _np
+
+        ln = _np.asarray(lens)
+        if (ln <= 0).any() or (ln > S).any():
+            raise ValueError(
+                f"generate: prompt_lens must be in [1, {S}], got "
+                f"range [{ln.min()}, {ln.max()}]")
     run = _compiled_generate(cfg, B, S, int(max_new_tokens),
                              float(temperature), int(top_k),
                              float(top_p), float(repetition_penalty))
-    out = run(params, prompt, rng)
+    out = run(params, prompt, lens, rng)
     if stop_token >= 0:
         # Post-processing OUTSIDE the jitted program: everything after
         # a row's first stop token becomes pad. Keeping stop/pad out of

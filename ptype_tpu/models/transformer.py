@@ -234,26 +234,40 @@ def rope_tables(cfg: TransformerConfig, seq_len: int | None = None,
     )
     if positions is None:
         positions = jnp.arange(seq_len)
-    angles = jnp.outer(positions.astype(jnp.float32), inv_freq)
+    # Broadcast (not outer, which flattens): positions may be (S,) —
+    # shared, the training path — or (B, S) for per-row ragged offsets.
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.sin(angles), jnp.cos(angles)
 
 
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
-    """Rotate pairs (x1, x2) of the head dim. x: (B, S, H, Dh)."""
+    """Rotate pairs (x1, x2) of the head dim. x: (B, S, H, Dh).
+
+    ``sin``/``cos`` are (S, half) — shared positions, the training
+    path — or (B, S, half) for PER-ROW positions (left-padded ragged
+    prompts, where row i's column s sits at position s - pad_i)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    sin = sin[None, :, None, :]
-    cos = cos[None, :, None, :]
+    if sin.ndim == 2:
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    else:
+        sin = sin[:, :, None, :]
+        cos = cos[:, :, None, :]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: TransformerConfig):
+def _attention(q, k, v, cfg: TransformerConfig, kv_mask=None):
     """Causal attention; q:(B,S,H,Dh) k,v:(B,S,K,Dh). Softmax in f32.
 
     GQA-native: query heads are grouped as (K, G) and contracted against
     the K kv heads directly — no ``jnp.repeat`` materializing H-head K/V
-    (the memory GQA exists to avoid; VERDICT r2 weak #4)."""
+    (the memory GQA exists to avoid; VERDICT r2 weak #4).
+
+    ``kv_mask`` (B, S) bool, optional: keys where False are masked out
+    for every query — the left-pad validity mask of ragged-prompt
+    prefill (models/generate.py). Training never passes it."""
     B, S, H, Dh = q.shape
     K = k.shape[2]
     qg = q.reshape(B, S, K, H // K, Dh)
@@ -263,6 +277,9 @@ def _attention(q, k, v, cfg: TransformerConfig):
     if cfg.causal:
         causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
         scores = jnp.where(causal[None, None, None], scores,
+                           jnp.float32(-1e30))
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, None, :], scores,
                            jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     o = jnp.einsum("bngqs,bsnd->bqngd", probs, v)
