@@ -117,13 +117,15 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
         sin, cos = tfm.rope_tables(cfg, positions=positions)
         kv_mask = jnp.arange(S)[None, :] >= pad[:, None]  # (B, S)
 
-    # MoE: ragged batches give every routed assignment an expert slot
-    # (capacity = T·top_k, the zero-drop bound). With the default
-    # token-priority capacity, LEFT-pad columns — which come first in
-    # each row — would claim expert slots ahead of real prompt tokens
-    # and make rows diverge from their solo decode.
-    cap = (B * S * cfg.expert_top_k
-           if prompt_lens is not None and cfg.n_experts else None)
+    # MoE: generation prefill always uses ZERO-DROP expert capacity
+    # (per-expert bound = T, since each token routes to top_k DISTINCT
+    # experts — the same reasoning behind decode_step's capacity=B).
+    # Factor-capacity dropping is a TRAINING regularizer; at inference
+    # it would (a) silently degrade prompts whose routing concentrates
+    # and (b) break batched-equals-solo parity — batch composition
+    # would change which tokens drop (left-pad columns, coming first,
+    # would even outrank real tokens in token-priority order).
+    cap = B * S if cfg.n_experts else None
 
     def body(x, inputs):
         layer, kc, vc = inputs

@@ -98,14 +98,15 @@ class _Pending:
 class BatchingGeneratorActor(GeneratorActor):
     """GeneratorActor with dynamic request batching.
 
-    Concurrent GREEDY requests that share a prompt length and
-    ``max_new_tokens`` coalesce into one decode loop: the batcher
+    Concurrent GREEDY requests that share ``max_new_tokens`` coalesce
+    into one decode loop — MIXED prompt lengths included: the batcher
     thread takes the first queued request, drains more for up to
-    ``window_ms``, partitions by shape, row-concatenates each group and
-    pads rows to the next power of two (bounding the compile cache —
-    one program per (B_bucket, S, max_new)). Greedy rows are
-    independent (no cross-row ops in the model), so batched results
-    match solo results. Sampled requests (``temperature > 0``) keep
+    ``window_ms``, left-pads ragged groups (``generate``'s
+    ``prompt_lens`` path — exact greedy parity with solo), and buckets
+    both rows and padded length to powers of two so the compile cache
+    stays bounded (one program per (B_bucket, S_bucket, max_new)).
+    Greedy rows are independent (no cross-row ops in the model), so
+    batched results match solo results. Sampled requests (``temperature > 0``) keep
     their exact per-request RNG semantics by running through the solo
     path — batching them would change which fold_in stream each row
     sees.
@@ -195,31 +196,49 @@ class BatchingGeneratorActor(GeneratorActor):
             self._run_round(batch)
 
     def _run_round(self, batch: list[_Pending]) -> None:
-        groups: dict[tuple[int, int], list[_Pending]] = {}
+        """Group by max_new only: MIXED prompt lengths coalesce via the
+        ragged left-padded path (exact greedy parity with solo). Rows
+        AND padded lengths bucket to powers of two so the compile cache
+        stays bounded; lengths themselves are traced, not compiled."""
+        import numpy as np
+
+        groups: dict[int, list[_Pending]] = {}
         for p in batch:
-            groups.setdefault(
-                (p.prompt.shape[1], p.max_new), []).append(p)
-        for (_s, max_new), reqs in groups.items():
+            groups.setdefault(p.max_new, []).append(p)
+        for max_new, reqs in groups.items():
             try:
-                prompts = jnp.concatenate([p.prompt for p in reqs])
-                n = prompts.shape[0]
+                rows = [np.asarray(p.prompt[i])
+                        for p in reqs for i in range(p.prompt.shape[0])]
+                n = len(rows)
                 # Row-pad to the next power of two: one compiled
                 # program per bucket instead of per request count.
                 # Never capped below n — a clamp would hand XLA the raw
                 # request count again (one compile per distinct n, the
                 # unbounded cache this padding exists to avoid).
                 bucket = 1 << max(n - 1, 0).bit_length()
-                if bucket > n:
-                    pad = jnp.broadcast_to(
-                        prompts[:1], (bucket - n,) + prompts.shape[1:])
-                    prompts = jnp.concatenate([prompts, pad])
+                rows += [rows[0]] * (bucket - n)
+                # One path for uniform AND mixed lengths: always the
+                # ragged lens route, so the compile cache is bounded
+                # by (B_bucket, S_bucket, max_new) — a uniform fast
+                # path would compile one program per distinct length.
+                prompts, lens = gen.pad_prompts(rows)
+                # Bucket the PADDED length too (further left-pad; lens
+                # stay exact, so results are unchanged) — capped so
+                # bucketing can never push a group past max_seq that
+                # its members individually fit in.
+                S = prompts.shape[1]
+                S_b = max(S, min(1 << max(S - 1, 0).bit_length(),
+                                 self.cfg.max_seq - max_new))
+                if S_b > S:
+                    prompts = jnp.pad(prompts, ((0, 0), (S_b - S, 0)))
                 with self._lock:
                     self._calls += len(reqs)
                     self._batches += 1
                     self._batched_requests += len(reqs)
                     out = gen.generate(self.params, self.cfg, prompts,
                                        max_new, 0.0,
-                                       jax.random.PRNGKey(0))
+                                       jax.random.PRNGKey(0),
+                                       prompt_lens=lens)
                 row = 0
                 for p in reqs:
                     b = p.prompt.shape[0]

@@ -132,3 +132,40 @@ def test_lifecycle_methods_not_remotely_callable():
         server.close()
     finally:
         actor.close()
+
+
+def test_batching_generator_coalesces_mixed_lengths():
+    """Mixed prompt lengths coalesce into ONE ragged round, each
+    caller's rows matching its solo decode exactly."""
+    import threading
+
+    from ptype_tpu.models import generate as gen
+    from ptype_tpu.serve import BatchingGeneratorActor
+
+    actor = BatchingGeneratorActor(CFG, window_ms=200.0, max_batch=16)
+    try:
+        rng = np.random.default_rng(9)
+        prompts = [jnp.asarray(rng.integers(1, CFG.vocab_size, n),
+                               jnp.int32)[None] for n in (3, 5, 8, 6)]
+        outs = [None] * len(prompts)
+        barrier = threading.Barrier(len(prompts))
+
+        def call(i):
+            barrier.wait()
+            outs[i] = actor.Generate(prompts[i], 5)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i, p in enumerate(prompts):
+            want = gen.generate(actor.params, CFG, p, 5)
+            np.testing.assert_array_equal(np.asarray(outs[i]),
+                                          np.asarray(want),
+                                          err_msg=f"req {i}")
+        info = actor.Info()
+        assert info["batches"] < len(prompts), info
+    finally:
+        actor.close()
