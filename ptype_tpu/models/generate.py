@@ -23,6 +23,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ptype_tpu.models import transformer as tfm
@@ -271,8 +272,6 @@ def pad_prompts(prompts, pad_token: int = 0):
     """LEFT-pad a list of 1-D token arrays to one (B, S) batch.
     Returns (padded int32 (B, S), lens int32 (B,)) for
     ``generate(..., prompt_lens=lens)``."""
-    import numpy as np
-
     lens = np.asarray([len(p) for p in prompts], np.int32)
     S = int(lens.max())
     out = np.full((len(prompts), S), pad_token, np.int32)
@@ -361,9 +360,7 @@ def generate(params: dict, cfg: tfm.TransformerConfig,
         if lens.shape != (B,):
             raise ValueError(
                 f"generate: prompt_lens shape {lens.shape} != ({B},)")
-        import numpy as _np
-
-        ln = _np.asarray(lens)
+        ln = np.asarray(lens)
         if (ln <= 0).any() or (ln > S).any():
             raise ValueError(
                 f"generate: prompt_lens must be in [1, {S}], got "

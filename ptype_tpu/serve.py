@@ -84,6 +84,11 @@ class GeneratorActor:
         }
 
 
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (compile-cache bucketing)."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
 class _Pending:
     __slots__ = ("prompt", "max_new", "done", "out", "err")
 
@@ -215,7 +220,7 @@ class BatchingGeneratorActor(GeneratorActor):
                 # Never capped below n — a clamp would hand XLA the raw
                 # request count again (one compile per distinct n, the
                 # unbounded cache this padding exists to avoid).
-                bucket = 1 << max(n - 1, 0).bit_length()
+                bucket = _pow2(n)
                 rows += [rows[0]] * (bucket - n)
                 # One path for uniform AND mixed lengths: always the
                 # ragged lens route, so the compile cache is bounded
@@ -227,8 +232,7 @@ class BatchingGeneratorActor(GeneratorActor):
                 # bucketing can never push a group past max_seq that
                 # its members individually fit in.
                 S = prompts.shape[1]
-                S_b = max(S, min(1 << max(S - 1, 0).bit_length(),
-                                 self.cfg.max_seq - max_new))
+                S_b = max(S, min(_pow2(S), self.cfg.max_seq - max_new))
                 if S_b > S:
                     prompts = jnp.pad(prompts, ((0, 0), (S_b - S, 0)))
                 with self._lock:
