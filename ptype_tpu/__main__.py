@@ -79,11 +79,13 @@ def _serve() -> None:
     model_cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
     server = ActorServer(port=cfg.port)
     # Dynamic batching: concurrent greedy requests coalesce into one
-    # decode round ($SERVE_WINDOW_MS to tune; sampled requests run solo).
+    # decode round ($SERVE_WINDOW_MS/$SERVE_MAX_BATCH to tune; sampled
+    # requests run solo).
     server.register(
         BatchingGeneratorActor(
             model_cfg,
-            window_ms=float(os.environ.get("SERVE_WINDOW_MS", "5"))),
+            window_ms=float(os.environ.get("SERVE_WINDOW_MS", "5")),
+            max_batch=int(os.environ.get("SERVE_MAX_BATCH", "32"))),
         "Generator")
     server.serve()
     cfg.port = server.port
