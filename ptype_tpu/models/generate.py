@@ -11,10 +11,18 @@ TPU-first decisions:
 - **Prefill + decode split**: prefill runs the full-sequence forward
   (MXU-efficient batched matmuls) while collecting per-layer K/V;
   decode steps attend against the cache with a position mask.
-- Sampling: greedy or temperature; RNG is explicit (fold_in per step).
+- Sampling: greedy or temperature with top-k / top-p (nucleus,
+  temperature-first semantics), HF-style repetition penalty, and
+  stop-token early stopping (output-masked outside the compiled
+  program); RNG is explicit (fold_in per step).
+- Ragged serving: ``generate(prompt_lens=...)`` decodes a LEFT-padded
+  mixed-length batch in one compiled program — lengths are traced,
+  pad keys masked, RoPE offsets per row; greedy rows match their solo
+  decode exactly.
 
-Works for any dense ``TransformerConfig`` (MoE decode falls back to the
-same path — experts run per token). GQA caches only ``kv_heads`` heads.
+Works for any dense ``TransformerConfig`` (MoE generation uses
+zero-drop expert capacity — dropping is a training regularizer). GQA
+caches only ``kv_heads`` heads.
 """
 
 from __future__ import annotations
