@@ -413,6 +413,82 @@ def test_training_rides_through_coordinator_failover(tmp_path,
             seed.wait(timeout=10)
 
 
+def test_wal_stream_failover_chain(tmp_path):
+    """The documented operator lifecycle, twice over: primary → standby
+    A takes over → a NEW standby B guards the promoted A → A dies → B
+    takes over — registrations and KV survive BOTH failovers."""
+    import socket as _socket
+
+    def _port():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    addrs = [f"127.0.0.1:{_port()}" for _ in range(3)]
+    seed = _start_seed(addrs[0], str(tmp_path / "p0"))
+    sb_a = Standby(addrs[0], addrs[1], str(tmp_path / "p1"),
+                   check_interval=0.2, failure_threshold=3,
+                   probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord(addrs, reconnect_timeout=30.0)
+    registry = CoordRegistry(coord, lease_ttl=TTL)
+    try:
+        assert sb_a.follower.synced.wait(timeout=10)
+        reg = registry.register("svc", "n0", "127.0.0.1", 7100)
+        coord.put("store/gen", "1")
+        time.sleep(0.5)  # let the mirror stream the records
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert sb_a.promoted.wait(timeout=10), "first failover failed"
+
+        # Chain: B replicates from the PROMOTED A.
+        sb_b = Standby(addrs[1], addrs[2], str(tmp_path / "p2"),
+                       check_interval=0.2, failure_threshold=3,
+                       probe_timeout=0.5, replicate=True)
+        try:
+            assert sb_b.follower.synced.wait(timeout=10), (
+                "second standby never synced from the promoted server")
+            # Mutation on the new primary (retry while the client's
+            # reconnect loop rides over to it).
+            deadline = time.monotonic() + 15
+            while True:
+                try:
+                    coord.put("store/gen", "2")
+                    break
+                except CoordinationError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+            time.sleep(0.5)
+            sb_a.server.close()  # second "death" (hard close)
+            assert sb_b.promoted.wait(timeout=15), (
+                "second failover failed")
+
+            deadline = time.monotonic() + TTL * 8
+            val, nodes = None, []
+            while time.monotonic() < deadline:
+                try:
+                    res = coord.range("store/gen")
+                    val = res.items[0].value if res.items else None
+                    nodes = registry.nodes("svc")
+                    if val == "2" and len(nodes) == 1:
+                        break
+                except CoordinationError:
+                    pass
+                time.sleep(0.1)
+            assert val == "2", f"KV lost across the chain: {val!r}"
+            assert len(nodes) == 1, f"registration lost: {nodes}"
+            del reg
+        finally:
+            sb_b.close()
+    finally:
+        coord.close()
+        sb_a.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 def test_standby_cli_process(tmp_path, free_port_pair):
     """The operator path end to end: `python -m ptype_tpu standby` as a
     real process (config/env parsing included) promotes after the seed
