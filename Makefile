@@ -2,7 +2,7 @@
 # wall-clock budget, Makefile:1-6) — Python's analog: the full suite on the
 # virtual 8-device CPU mesh with a hard timeout.
 
-.PHONY: test bench lint native tpu-smoke
+.PHONY: test bench lint native tpu-smoke tpu-validate
 
 test:
 	python -m pytest tests/ -x -q
@@ -15,6 +15,11 @@ bench:
 # treated as skip, not failure).
 tpu-smoke:
 	python tests/tpu_smoke.py || test $$? -eq 42
+
+# Full hardware revalidation after a tunnel outage / kernel change:
+# the Mosaic-visible smoke (flash fwd+bwd, MoE step, KV-cache
+# generate), then the headline bench JSON line.
+tpu-validate: tpu-smoke bench
 
 lint:
 	python -m compileall -q ptype_tpu
