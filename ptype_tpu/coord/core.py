@@ -222,10 +222,12 @@ class ReplFeed:
     item with the full state at subscribe time (and after each
     compaction), then a ``("rec", dict)`` item per mutation, in commit
     order. Consumed by the WAL-shipping standby
-    (:class:`ptype_tpu.coord.standby.WalFollower`); the queue is
-    unbounded — control-plane mutation volume is leases + registry
-    churn, and a follower that stops draining loses its connection
-    (service.py pump) which cancels the feed.
+    (:class:`ptype_tpu.coord.standby.WalFollower`). The queue is
+    bounded at :data:`MAX_BUFFER` items and SELF-CANCELS on overflow
+    (see below) — a cancelled follower re-syncs from a fresh snapshot
+    on reconnect, so dropping the feed is always safe; a follower that
+    stops draining without wedging simply loses its connection
+    (service.py pump), which also cancels the feed.
     """
 
     #: Max buffered items before the feed self-cancels. A follower
@@ -308,10 +310,18 @@ class CoordState:
 
     def __init__(self, sweep_interval: float = 0.25,
                  data_dir: str | None = None,
-                 compact_every: int = 10_000):
+                 compact_every: int = 10_000,
+                 bump_term: bool = False):
         self._lock = threading.RLock()
         self._kv: dict[str, KVItem] = {}
         self._rev = 0
+        #: Promotion generation (fencing token). Persisted in the
+        #: snapshot; bumped when a standby takes over (``bump_term``).
+        #: Clients carry the highest term they have seen and a
+        #: superseded primary — lower term — refuses their requests,
+        #: the role raft's leader epoch played for the reference
+        #: (/root/reference/cluster/cluster.go:120-147).
+        self._term = 0
         self._leases: dict[int, Lease] = {}
         self._next_lease = 1
         self._watches: list[Watch] = []
@@ -352,6 +362,14 @@ class CoordState:
                     "live coordinator — refusing to double-write the WAL"
                 ) from e
             self._replay(data_dir)
+            if bump_term:
+                # Promotion: supersede every prior primary BEFORE the
+                # compact below persists the new term — a crash after
+                # serving even one request must not resurrect at the
+                # old term.
+                self._term += 1
+                log.info("coordination term bumped (promotion)",
+                         kv={"term": self._term})
             self._wal = open(self._wal_path(), "a", encoding="utf-8")
             # Compact-on-start: fold the recovered state into a fresh
             # snapshot + truncated WAL. Appending to the replayed file
@@ -362,6 +380,8 @@ class CoordState:
             # every start leave a consistent (snap, WAL-gen) pair —
             # and bounds future replay work as a side effect.
             self._compact()
+        elif bump_term:
+            self._term += 1
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="coord-lease-sweeper", daemon=True
         )
@@ -407,6 +427,7 @@ class CoordState:
         """
         return {
             "wal_gen": self._wal_gen if wal_gen is None else wal_gen,
+            "term": self._term,
             "rev": self._rev,
             "next_lease": self._next_lease,
             "next_member": self._next_member,
@@ -461,6 +482,7 @@ class CoordState:
             with open(snap_path, encoding="utf-8") as f:
                 snap = json.load(f)
             snap_gen = snap.get("wal_gen", 0)
+            self._term = snap.get("term", 0)
             self._rev = snap["rev"]
             self._next_lease = snap["next_lease"]
             self._next_member = snap["next_member"]
@@ -803,6 +825,11 @@ class CoordState:
     def revision(self) -> int:
         with self._lock:
             return self._rev
+
+    @property
+    def term(self) -> int:
+        with self._lock:
+            return self._term
 
     def close(self) -> None:
         self._closed.set()

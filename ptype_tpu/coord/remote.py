@@ -31,6 +31,20 @@ class _Pending:
         self.reply: dict | None = None
 
 
+class _StaleCoordinator(CoordinationError):
+    """The endpoint answered but is a SUPERSEDED primary (its fencing
+    term is behind this client's). The request was refused before
+    execution, so retrying against another endpoint is always safe."""
+
+
+class _SendFailed(CoordinationError):
+    """The request never left this client (send error, or the bytes
+    went into a socket the reader had already replaced). The server
+    cannot have executed it, so the fence-bounce loop may re-send;
+    a timeout or lost-mid-request is NOT this — the op may have
+    executed, and only the caller knows whether a retry is safe."""
+
+
 class RemoteCoord(CoordBackend):
     """Client over one persistent connection; safe for concurrent use.
 
@@ -38,6 +52,15 @@ class RemoteCoord(CoordBackend):
     reachable one and, on connection loss, cycles through ALL of them —
     so a warm standby (coord.standby) that takes over on a different
     address picks up the clientele without any client-side action.
+
+    Fencing: every reply carries the server's promotion ``term``; the
+    client remembers the highest it has seen and stamps it on every
+    request (``min_term``). A superseded primary — e.g. the old seed
+    restarted on its old address after a wal-stream takeover — refuses
+    the request, and the client abandons that endpoint and re-dials
+    until it finds the current primary. This is the client half of the
+    epoch fence raft gave the reference for free
+    (/root/reference/cluster/cluster.go:120-147).
 
     Dial timeout defaults to the reference's 5 s (registry.go:37,
     store.go:25, cluster.go:53).
@@ -64,6 +87,13 @@ class RemoteCoord(CoordBackend):
                 f"failed to dial coordination service at {eps}: {e}"
             ) from e
         self._send_lock = threading.Lock()
+        #: Highest fencing term seen in any reply (never decreases).
+        self._term = 0
+        #: Set while a dialed connection is live; cleared on loss and
+        #: by a stale-endpoint bounce, so fence retries can wait for
+        #: the reader's re-dial instead of spinning on a dead socket.
+        self._connected = threading.Event()
+        self._connected.set()
         self._pending: dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
         self._watches: dict[int, Watch] = {}
@@ -124,6 +154,7 @@ class RemoteCoord(CoordBackend):
                 # every watch dis-armed, and try to reach a coordinator
                 # again (seed restarting from its WAL, or a standby
                 # taking over). Deliberate close() skips the re-dial.
+                self._connected.clear()
                 self._fail_pending()
                 with self._watches_lock:
                     for w in self._watches.values():
@@ -189,6 +220,7 @@ class RemoteCoord(CoordBackend):
                                  args=(gen,), daemon=True)
             self._rewatch_thread = t
             t.start()
+            self._connected.set()
             return True
         return False
 
@@ -273,6 +305,60 @@ class RemoteCoord(CoordBackend):
         w._push(events)
 
     def _call(self, op: str, reply_timeout: float | None = None, **kwargs):
+        """One request/response, with fence-aware endpoint cycling: a
+        ``stale`` refusal (superseded primary — the op was NOT
+        executed) bounces to the next endpoint and retries until the
+        current primary is found or the endpoint list is exhausted."""
+        stale: _StaleCoordinator | None = None
+        for _ in range(2 * len(self.endpoints) + 2):
+            if stale is not None:
+                # Wait for the reader's re-dial after the bounce.
+                self._connected.wait(timeout=5.0)
+            try:
+                return self._call_once(op, reply_timeout, kwargs)
+            except _StaleCoordinator as e:
+                stale = e
+                self._bounce_endpoint()
+            except _SendFailed:
+                if stale is None:
+                    raise  # ordinary failure: callers own the retry
+                time.sleep(0.3)  # mid-re-dial; let the reader land
+            # Any other CoordinationError (timeout, lost mid-request)
+            # propagates even after a bounce: the op may have EXECUTED
+            # on the current primary, and re-sending a non-idempotent
+            # op (grant, member_add) here would double-apply it.
+        raise CoordinationError(
+            f"no current-term coordinator among {self.endpoints}: {stale}")
+
+    def _bounce_endpoint(self) -> None:
+        """Abandon a superseded primary: advance the endpoint cursor so
+        the reader's re-dial starts at the NEXT endpoint, then drop the
+        socket to trigger the reconnect loop."""
+        try:
+            idx = self.endpoints.index(self.address)
+        except ValueError:
+            idx = -1
+        stale_ep = self.address
+        self.address = self.endpoints[(idx + 1) % len(self.endpoints)]
+        self._connected.clear()
+        log.info("abandoning superseded coordinator",
+                 kv={"stale": stale_ep, "next": self.address,
+                     "fence_term": self._term})
+        sock = self._sock
+        try:
+            # shutdown() interrupts the reader parked in recv(2) on this
+            # socket; close() alone does not (same reason as
+            # WalFollower.close) — without it the reconnect loop never
+            # runs and the bounce strands the client.
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _call_once(self, op: str, reply_timeout: float | None, kwargs):
         if self._closed.is_set():
             raise CoordinationError(f"coordination connection to {self.address} closed")
         if (not self._rewatch_gate.is_set()
@@ -286,12 +372,25 @@ class RemoteCoord(CoordBackend):
         p = _Pending()
         with self._pending_lock:
             self._pending[req_id] = p
+        sock = self._sock
         try:
-            wire.send_msg(self._sock, self._send_lock, {"id": req_id, "op": op, **kwargs})
+            wire.send_msg(sock, self._send_lock,
+                          {"id": req_id, "op": op,
+                           "min_term": self._term, **kwargs})
         except (wire.WireError, OSError) as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
-            raise CoordinationError(f"send to {self.address} failed: {e}") from e
+            raise _SendFailed(f"send to {self.address} failed: {e}") from e
+        if sock is not self._sock and not p.event.is_set():
+            # The reader replaced the connection while we were sending:
+            # the bytes went into the dead socket (a kill's RST races
+            # the local send buffer, so send() "succeeds") and
+            # _fail_pending has already run — this reply can never
+            # arrive. Fail fast; callers retry like any connection loss.
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise _SendFailed(
+                f"connection to {self.address} replaced mid-request")
         if not p.event.wait(reply_timeout if reply_timeout is not None
                             else self._request_timeout):
             with self._pending_lock:
@@ -299,7 +398,13 @@ class RemoteCoord(CoordBackend):
             raise CoordinationError(f"request {op!r} to {self.address} timed out")
         if p.reply is None:
             raise CoordinationError(f"connection to {self.address} lost mid-request")
+        t = p.reply.get("term")
+        if isinstance(t, int) and t > self._term:
+            self._term = t  # adopt the newest primary's fence
         if not p.reply.get("ok"):
+            if p.reply.get("stale"):
+                raise _StaleCoordinator(
+                    p.reply.get("error", "stale coordinator"))
             raise CoordinationError(p.reply.get("error", "unknown coordination error"))
         return p.reply.get("result")
 
@@ -371,6 +476,11 @@ class RemoteCoord(CoordBackend):
                           name=name, count=count, timeout=timeout)
 
     # ---------------------------------------------------------------- misc
+
+    @property
+    def term(self) -> int:
+        """Highest coordinator fencing term this client has seen."""
+        return self._term
 
     def ping(self, timeout: float = 5.0) -> bool:
         try:

@@ -47,8 +47,13 @@ class CoordServer:
 
     def __init__(self, address: str = "127.0.0.1:0",
                  state: CoordState | None = None,
-                 data_dir: str | None = None):
-        self.state = state or CoordState(data_dir=data_dir)
+                 data_dir: str | None = None,
+                 bump_term: bool = False):
+        # bump_term=True marks this server a PROMOTED successor: the
+        # recovered state's fencing term is incremented so clients that
+        # adopt it refuse any superseded primary (coord/standby).
+        self.state = state or CoordState(data_dir=data_dir,
+                                         bump_term=bump_term)
         self._owns_state = state is None
         host, _, port = address.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -146,6 +151,24 @@ class CoordServer:
         op = msg.get("op", "")
         pump_watch: Watch | None = None
         pump_feed = None
+        # Fencing check BEFORE any dispatch: a client that has seen a
+        # newer primary (higher term) must get refused here — this
+        # server is a superseded primary still running on stale state
+        # (wal-stream failover has no shared flock; the client-carried
+        # term is the fence, mirroring raft's leader epoch —
+        # /root/reference/cluster/cluster.go:120-147).
+        min_term = msg.get("min_term", 0)
+        my_term = self.state.term
+        if min_term > my_term:
+            try:
+                wire.send_msg(conn, send_lock, {
+                    "id": req_id, "ok": False, "stale": True,
+                    "term": my_term,
+                    "error": (f"stale coordinator: term {my_term} is "
+                              f"behind client fence {min_term}")})
+            except (wire.WireError, OSError):
+                pass
+            return
         try:
             if op == "watch":
                 # The pump must not start until the create-reply is on the
@@ -166,9 +189,11 @@ class CoordServer:
             else:
                 result = self._dispatch(conn, send_lock, watches,
                                         watches_lock, op, msg)
-            reply = {"id": req_id, "ok": True, "result": result}
+            reply = {"id": req_id, "ok": True, "result": result,
+                     "term": my_term}
         except Exception as e:  # noqa: BLE001 — remote surface must not die
-            reply = {"id": req_id, "ok": False, "error": str(e)}
+            reply = {"id": req_id, "ok": False, "error": str(e),
+                     "term": my_term}
         try:
             wire.send_msg(conn, send_lock, reply)
         except (wire.WireError, OSError):

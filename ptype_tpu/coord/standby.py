@@ -25,14 +25,20 @@ with no shared storage):
   standby with no client-side action; re-watch + snapshot-then-delta
   semantics make watch consumers whole.
 
-Split-brain scope: ONE standby per primary, and the old primary must
-not be restarted on its old address after a takeover (its WAL is now
-stale). The reference's raft gave fencing for free; here the operator
-contract is documented instead — matching the single-writer WAL model.
-In shared-dir mode the WAL-dir flock additionally fences a
-wedged-but-alive primary; wal-stream mode has no cross-host fence, so
-its only guards are the probe threshold (automatic) and a
-refuse-while-primary-answers check (operator promote).
+Split-brain scope: ONE standby per primary. Promotion bumps the
+persisted fencing *term* (coord/core.py) — the epoch raft's leader
+election gave the reference for free
+(/root/reference/cluster/cluster.go:120-147). Clients stamp the
+highest term they have seen on every request, so an old primary
+restarted on its old address after a takeover (stale WAL, stale term)
+refuses them and they re-dial to the current primary
+(coord/remote.py). In shared-dir mode the WAL-dir flock additionally
+fences a wedged-but-alive primary at the filesystem. The residual gap
+is inherent to two nodes: during a live network partition, clients
+that can ONLY reach the old primary (and have never seen the new
+term) keep being served by it — resolving that needs a quorum tier,
+which is why auto-promotion still requires a synced mirror and the
+operator path refuses while the primary answers.
 """
 
 from __future__ import annotations
@@ -331,12 +337,15 @@ class Standby:
                 return False
             self.follower = None
         try:
-            # The WAL-dir flock (coord/core.py) is the fence: if the
-            # primary is wedged-but-alive and still holds it, this
-            # raises instead of double-writing the WAL — probes keep
-            # running and promotion retries once the primary truly dies.
+            # The WAL-dir flock (coord/core.py) is the shared-dir
+            # fence: if the primary is wedged-but-alive and still holds
+            # it, this raises instead of double-writing the WAL — probes
+            # keep running and promotion retries once the primary truly
+            # dies. bump_term marks this server the successor so
+            # clients refuse any stale primary (the wal-stream fence).
             self.server = CoordServer(self.listen_address,
-                                      data_dir=self.data_dir)
+                                      data_dir=self.data_dir,
+                                      bump_term=True)
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
@@ -403,18 +412,32 @@ class Standby:
         while True:
             try:
                 self.server = CoordServer(self.listen_address,
-                                          data_dir=self.data_dir)
+                                          data_dir=self.data_dir,
+                                          bump_term=True)
                 break
-            except Exception as e:  # noqa: BLE001 — fence still held
+            except Exception as e:  # noqa: BLE001 — fence / transient
                 if _time.monotonic() > deadline:
                     # Re-arm automatic failover (monitor + follower)
                     # before surfacing the error: a caller that
                     # catches it expects the standby to keep guarding
                     # the (still-live) primary.
                     self._start_guarding()
+                    if self._replicate:
+                        # wal-stream: the mirror dir is LOCAL — no
+                        # flock contention with the primary is
+                        # possible, so the failure is this host's own
+                        # (port bind, replay error). Say so; "primary
+                        # holds the fence" would send the operator to
+                        # the wrong host.
+                        raise RuntimeError(
+                            f"promote: standby server failed to start "
+                            f"after {timeout}s (wal-stream mode; local "
+                            f"cause — last error: {e})"
+                        ) from e
                     raise RuntimeError(
                         f"promote: primary still holds the WAL fence "
-                        f"after {timeout}s — shut it down first"
+                        f"after {timeout}s — shut it down first "
+                        f"(last error: {e})"
                     ) from e
                 _time.sleep(0.2)
         self.promoted.set()

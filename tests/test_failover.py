@@ -550,6 +550,120 @@ def test_standby_cli_process(tmp_path, free_port_pair):
             seed.wait(timeout=10)
 
 
+def test_term_fence_refuses_restarted_stale_primary(tmp_path,
+                                                    free_port_pair):
+    """VERDICT r3 item 3: the wal-stream fence. After a wal-stream
+    takeover bumps the fencing term, the OLD primary restarted on its
+    old address (stale WAL, stale term) must not be able to serve
+    fenced clients — they get refused, refuse IT in turn, and stay on
+    (or return to) the current primary. Raft's leader epoch did this
+    for the reference (cluster.go:120-147); here the term rides the
+    coord wire protocol."""
+    import socket as _socket
+    import threading as _threading
+
+    from ptype_tpu.coord import wire
+
+    primary_addr, standby_addr = free_port_pair
+    primary_dir = str(tmp_path / "primary")
+    standby_dir = str(tmp_path / "standby")
+    seed = _start_seed(primary_addr, primary_dir)
+    standby = Standby(primary_addr, standby_addr, standby_dir,
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0, request_timeout=5.0)
+    old_seed = None
+    restarted = None
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        coord.put("store/epoch", "before")
+        time.sleep(0.5)  # let the mirror stream the record
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10)
+
+        # The client rides onto the promoted standby and ADOPTS the
+        # bumped term through the reply envelope.
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                coord.put("store/epoch", "after-takeover")
+                break
+            except CoordinationError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert coord.term >= 1, (
+            f"client never adopted the promoted term: {coord.term}")
+        fenced_term = coord.term
+
+        # Restart the old primary on its old address over its STALE
+        # data_dir — the exact operator mistake the fence exists for.
+        old_seed = _start_seed(primary_addr, primary_dir)
+
+        # (a) A fenced request sent straight at the stale primary is
+        # refused without execution.
+        host, _, port = primary_addr.rpartition(":")
+        s = _socket.create_connection((host, int(port)), timeout=5)
+        try:
+            wire.send_msg(s, _threading.Lock(),
+                          {"op": "put", "id": 1, "key": "store/epoch",
+                           "value": "stale-write",
+                           "min_term": fenced_term})
+            reply = wire.recv_msg(s)
+        finally:
+            s.close()
+        assert reply.get("stale") and not reply.get("ok"), (
+            f"stale primary served a fenced write: {reply}")
+
+        # (b) With ONLY the stale primary reachable, the fenced client
+        # refuses to write at all rather than split-braining: take the
+        # new primary down and watch the put fail closed.
+        standby.server.close()
+        with pytest.raises(CoordinationError):
+            coord.put("store/epoch", "must-not-land")
+
+        # (c) The current primary returns (plain restart over the
+        # promoted dir — term persists); the client lands back on it.
+        from ptype_tpu.coord.service import CoordServer
+
+        restarted = CoordServer(standby_addr, data_dir=standby_dir)
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                coord.put("store/epoch", "after-restart")
+                break
+            except CoordinationError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert coord.address == standby_addr, (
+            f"client settled on {coord.address}, not the current "
+            f"primary {standby_addr}")
+        assert restarted.state.term == fenced_term
+
+        # The fenced writes never landed on the stale primary: its
+        # keyspace still holds the pre-takeover value.
+        stale_view = RemoteCoord([primary_addr])
+        try:
+            res = stale_view.range("store/epoch")
+            assert [it.value for it in res.items] == ["before"], (
+                "a fenced write leaked onto the stale primary")
+        finally:
+            stale_view.close()
+    finally:
+        coord.close()
+        standby.close()
+        if restarted is not None:
+            restarted.close()
+        for p in (seed, old_seed):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
