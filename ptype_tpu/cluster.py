@@ -214,8 +214,11 @@ def join(cfg: Config) -> Cluster:
             try:
                 # The FULL endpoint list goes to the client: on a later
                 # connection loss it fails over to any standby
-                # (coord.standby) in the list, not just the seed.
-                coord = connect(endpoints, dial_timeout=per_dial)
+                # (coord.standby) in the list, not just the seed —
+                # and discovery extends the list with promote-eligible
+                # standbys attached after this process joined.
+                coord = connect(endpoints, dial_timeout=per_dial,
+                                discovery_interval=5.0)
             except CoordinationError as e:
                 last = e
                 if _time.monotonic() >= deadline:

@@ -45,6 +45,9 @@ class CoordBackend(abc.ABC):
     def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member: ...
 
     @abc.abstractmethod
+    def member_promote(self, member_id: int) -> Member: ...
+
+    @abc.abstractmethod
     def member_remove(self, member_id: int) -> bool: ...
 
     @abc.abstractmethod
@@ -63,6 +66,7 @@ def connect(
     *,
     dial_timeout: float = 5.0,
     in_process: bool = False,
+    discovery_interval: float = 0.0,
 ) -> CoordBackend:
     """Dial a coordination backend.
 
@@ -71,6 +75,10 @@ def connect(
     Otherwise dials the TCP coordination service with the reference's 5s
     default dial timeout (registry.go:37). ``address`` may be a list of
     endpoints (primary + standbys); the client fails over between them.
+    ``discovery_interval`` > 0 additionally polls the membership for
+    promote-eligible standbys attached at runtime and extends the
+    failover list with them (no-op for the in-process tier, which has
+    no failover).
     """
     from ptype_tpu.coord.local import local_coord
     from ptype_tpu.coord.remote import RemoteCoord
@@ -80,4 +88,5 @@ def connect(
         name = (address.split(":", 1)[1]
                 if address.startswith("local:") else address)
         return local_coord(name)
-    return RemoteCoord(address, dial_timeout=dial_timeout)
+    return RemoteCoord(address, dial_timeout=dial_timeout,
+                       discovery_interval=discovery_interval)

@@ -556,6 +556,8 @@ class CoordState:
             self.revoke(rec["id"])
         elif op == "ma":
             self.member_add(rec["n"], rec["a"], rec.get("md") or {})
+        elif op == "mp":
+            self.member_promote(rec["id"])
         elif op == "mr":
             self.member_remove(rec["id"])
 
@@ -778,6 +780,23 @@ class CoordState:
             self._append({"o": "ma", "id": m.id, "n": m.name,
                           "a": m.peer_addr, "md": m.metadata})
             return m
+
+    def member_promote(self, member_id: int) -> Member:
+        """Clear a member's ``learner`` flag — the analog of the
+        reference's MemberPromote in the learner add→catch-up→promote
+        lifecycle (cluster.go:120-147, 183-195). Idempotent; WAL-logged
+        so the promoted status survives coordinator restart."""
+        with self._lock:
+            m = self._members.get(member_id)
+            if m is None:
+                raise CoordinationError(
+                    f"member_promote: member {member_id} not found")
+            md = dict(m.metadata)
+            md["learner"] = False
+            promoted = replace(m, metadata=md)
+            self._members[member_id] = promoted
+            self._append({"o": "mp", "id": member_id})
+            return promoted
 
     def member_remove(self, member_id: int) -> bool:
         with self._lock:

@@ -63,6 +63,9 @@ class LocalCoord(CoordBackend):
     def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member:
         return self.state.member_add(name, peer_addr, metadata)
 
+    def member_promote(self, member_id: int) -> Member:
+        return self.state.member_promote(member_id)
+
     def member_remove(self, member_id: int) -> bool:
         return self.state.member_remove(member_id)
 

@@ -68,7 +68,8 @@ class RemoteCoord(CoordBackend):
 
     def __init__(self, address: str | list[str], dial_timeout: float = 5.0,
                  request_timeout: float = 30.0,
-                 reconnect_timeout: float = 30.0):
+                 reconnect_timeout: float = 30.0,
+                 discovery_interval: float = 0.0):
         eps = [address] if isinstance(address, str) else list(address)
         if not eps:
             raise CoordinationError("RemoteCoord: no endpoints")
@@ -112,6 +113,14 @@ class RemoteCoord(CoordBackend):
             daemon=True
         )
         self._reader.start()
+        # discovery_interval > 0: periodically merge promote-eligible
+        # standbys from the membership into the endpoint list, so this
+        # client can fail over to standbys attached after it connected.
+        if discovery_interval > 0:
+            threading.Thread(
+                target=self._discovery_loop, args=(discovery_interval,),
+                name=f"coord-discovery-{self.address}", daemon=True,
+            ).start()
 
     # ------------------------------------------------------------- plumbing
 
@@ -460,11 +469,38 @@ class RemoteCoord(CoordBackend):
                        metadata=metadata or {})
         return Member(**m)
 
+    def member_promote(self, member_id: int) -> Member:
+        return Member(**self._call("member_promote", member=member_id))
+
     def member_remove(self, member_id: int) -> bool:
         return self._call("member_remove", member=member_id)
 
     def member_list(self) -> list[Member]:
         return [Member(**m) for m in self._call("member_list")]
+
+    def discover_endpoints(self) -> list[str]:
+        """Merge promote-eligible standbys from the membership into the
+        failover endpoint list — how a client learns about a standby
+        attached AFTER this client was constructed (the dynamic
+        counterpart of the static initial_cluster_client_urls list;
+        ref: learner add→promote, cluster.go:120-147). Learners are
+        skipped: failing over to a standby whose mirror never caught up
+        would serve stale or empty state."""
+        for m in self.member_list():
+            md = m.metadata or {}
+            if (md.get("role") == "standby" and not md.get("learner", True)
+                    and m.peer_addr and m.peer_addr not in self.endpoints):
+                self.endpoints.append(m.peer_addr)
+                log.info("discovered standby endpoint",
+                         kv={"addr": m.peer_addr})
+        return list(self.endpoints)
+
+    def _discovery_loop(self, interval: float) -> None:
+        while not self._closed.wait(interval):
+            try:
+                self.discover_endpoints()
+            except CoordinationError:
+                pass  # transient (reconnect in flight); next round
 
     # ------------------------------------------------------------- barriers
 

@@ -249,6 +249,8 @@ class CoordServer:
         if op == "member_add":
             m = st.member_add(msg["name"], msg["peer_addr"], msg.get("metadata") or {})
             return _member_wire(m)
+        if op == "member_promote":
+            return _member_wire(st.member_promote(msg["member"]))
         if op == "member_remove":
             return st.member_remove(msg["member"])
         if op == "member_list":

@@ -204,7 +204,8 @@ class Standby:
                  data_dir: str, check_interval: float = 1.0,
                  failure_threshold: int = 3,
                  probe_timeout: float = 2.0,
-                 replicate: bool = False):
+                 replicate: bool = False,
+                 register: bool = True):
         self.primary_address = primary_address
         self.listen_address = listen_address
         self.data_dir = data_dir
@@ -214,6 +215,17 @@ class Standby:
         self.promoted = threading.Event()
         self.server: CoordServer | None = None
         self._closed = threading.Event()
+        # Learner lifecycle (ref: memberAdd-as-learner → catch up →
+        # MemberPromote, cluster.go:120-147, 183-195): the standby
+        # joins the primary's membership as a learner, and is promoted
+        # to a promote-eligible member only once its mirror caught up —
+        # making "which standbys can take over right now" observable
+        # through member_list, and letting clients' endpoint discovery
+        # pick up standbys attached at runtime.
+        self._register = register
+        self.member_id: int | None = None
+        self._member_promoted = False
+        self._admin = None  # lazy RemoteCoord to the primary
         # replicate=True: ``data_dir`` is LOCAL and a WalFollower
         # mirrors the primary's WAL into it over TCP — the cross-host
         # deployment. False: ``data_dir`` IS the primary's (shared
@@ -285,6 +297,64 @@ class Standby:
             except OSError:
                 pass
 
+    @property
+    def promote_eligible(self) -> bool:
+        """True when promotion would recover full cluster state: always
+        in shared-dir mode (the data_dir IS the primary's), and once
+        the WAL mirror has received its first snapshot in wal-stream
+        mode. The learner→member transition in the primary's
+        membership mirrors this flag."""
+        if self.promoted.is_set():
+            return True
+        if self.follower is not None:
+            return self.follower.synced.is_set()
+        return not self._replicate
+
+    def _sync_membership(self) -> None:
+        """Keep the standby's learner/member record on the (live)
+        primary current. Called from the monitor after each successful
+        probe; every step is retried on the next round on failure."""
+        if not self._register:
+            return
+        from ptype_tpu.coord.remote import RemoteCoord
+        from ptype_tpu.errors import CoordinationError
+
+        try:
+            if self._admin is None:
+                self._admin = RemoteCoord(
+                    [self.primary_address], dial_timeout=2.0,
+                    request_timeout=5.0, reconnect_timeout=5.0)
+            if self.member_id is None:
+                # A previous incarnation of this standby (same address)
+                # may still be registered: replace it, don't accumulate.
+                for m in self._admin.member_list():
+                    md = m.metadata or {}
+                    if (md.get("role") == "standby"
+                            and m.peer_addr == self.listen_address):
+                        self._admin.member_remove(m.id)
+                member = self._admin.member_add(
+                    f"standby:{self.listen_address}", self.listen_address,
+                    metadata={"role": "standby", "learner": True,
+                              "mode": ("wal-stream" if self._replicate
+                                       else "shared-dir")})
+                self.member_id = member.id
+                log.info("standby joined membership as learner",
+                         kv={"member": member.id,
+                             "addr": self.listen_address})
+            if not self._member_promoted and self.promote_eligible:
+                self._admin.member_promote(self.member_id)
+                self._member_promoted = True
+                log.info("standby promoted to member: mirror caught up",
+                         kv={"member": self.member_id})
+        except CoordinationError as e:
+            log.debug("standby membership sync failed; retrying",
+                      kv={"err": str(e)})
+
+    def _close_admin(self) -> None:
+        if self._admin is not None:
+            self._admin.close()
+            self._admin = None
+
     def _monitor(self) -> None:
         failures = 0
         while not self._closed.is_set():
@@ -293,6 +363,7 @@ class Standby:
                 # The primary is back after a failed/deferred promotion
                 # attempt that closed the follower: resume mirroring.
                 self._ensure_follower()
+                self._sync_membership()
             else:
                 failures += 1
                 log.debug("primary probe failed",
@@ -355,15 +426,19 @@ class Standby:
             self._ensure_follower()
             return False
         self.promoted.set()
+        self._close_admin()  # it pointed at the dead primary
         return True
 
     # ------------------------------------------------------------- admin
 
-    def promote(self, timeout: float = 30.0) -> "CoordServer":
+    def promote(self, timeout: float = 30.0,
+                force: bool = False) -> "CoordServer":
         """Operator-triggered switchover — the analog of the reference's
         learner PROMOTE (cluster.go:183-195): stop monitoring, wait for
         the primary to release the WAL fence (shut it down first), and
-        serve. Returns the live server; raises on fence timeout."""
+        serve. Returns the live server; raises on fence timeout.
+        ``force=True`` overrides the never-synced-mirror refusal (for
+        deliberately bootstrapping an empty control plane)."""
         import time as _time
 
         if self.promoted.is_set() and self.server is not None:
@@ -393,6 +468,14 @@ class Standby:
         if self.promoted.is_set() and self.server is not None:
             return self.server
         if self.follower is not None:
+            if not force and not self.follower.synced.is_set():
+                # Same refusal as auto-promotion: a mirror that never
+                # received a snapshot holds NOTHING — serving it would
+                # silently wipe the control plane.
+                self._start_guarding()
+                raise RuntimeError(
+                    "promote: WAL mirror never synced — promoting would "
+                    "serve an empty control plane (force=True overrides)")
             # Cross-host mode has no flock fence to refuse a split
             # brain — the probe is the only guard. Refuse while the
             # primary still answers, and keep guarding.
@@ -441,14 +524,25 @@ class Standby:
                     ) from e
                 _time.sleep(0.2)
         self.promoted.set()
+        self._close_admin()  # it pointed at the superseded primary
         log.info("standby promoted by operator",
                  kv={"standby": self.listen_address})
         return self.server
 
     def close(self) -> None:
-        """Stop monitoring; shut the promoted server down if any."""
+        """Stop monitoring; shut the promoted server down if any.
+        Deregisters from the (live) primary's membership — a detached
+        standby must not look promote-eligible to endpoint discovery."""
         self._closed.set()
         self._thread.join(timeout=5)
+        if self._admin is not None and self.member_id is not None:
+            from ptype_tpu.errors import CoordinationError
+
+            try:
+                self._admin.member_remove(self.member_id)
+            except CoordinationError:
+                pass  # best-effort: primary may already be gone
+        self._close_admin()
         if self.follower is not None:
             self.follower.close()
             self.follower = None

@@ -164,6 +164,44 @@ def test_member_lifecycle(coord):
     assert m2.metadata == {}
 
 
+def test_member_promote_learner(coord):
+    """Learner add → promote lifecycle (ref: cluster.go:120-147): the
+    learner flag is cleared in place, the id is stable, and promoting
+    an unknown member is an error."""
+    m = coord.member_add("sb", "127.0.0.1:9", {"role": "standby",
+                                               "learner": True})
+    assert coord.member_list()[0].metadata["learner"] is True
+    promoted = coord.member_promote(m.id)
+    assert promoted.id == m.id
+    assert promoted.metadata["learner"] is False
+    assert coord.member_list()[0].metadata["learner"] is False
+    # Idempotent (replay-safe).
+    assert coord.member_promote(m.id).metadata["learner"] is False
+    with pytest.raises(CoordinationError, match="not found"):
+        coord.member_promote(9999)
+
+
+def test_member_promote_survives_restart(tmp_path):
+    """The promoted status is WAL-logged: a coordinator restarted from
+    its data_dir still knows which standbys are promote-eligible."""
+    from ptype_tpu.coord.core import CoordState
+
+    d = str(tmp_path / "coord")
+    st = CoordState(data_dir=d)
+    m = st.member_add("sb", "127.0.0.1:9", {"role": "standby",
+                                            "learner": True})
+    st.member_promote(m.id)
+    st.close()
+
+    st2 = CoordState(data_dir=d)
+    try:
+        (member,) = st2.member_list()
+        assert member.id == m.id
+        assert member.metadata["learner"] is False
+    finally:
+        st2.close()
+
+
 def test_barrier(coord):
     results = []
 
