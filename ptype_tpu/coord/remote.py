@@ -74,6 +74,10 @@ class RemoteCoord(CoordBackend):
         if not eps:
             raise CoordinationError("RemoteCoord: no endpoints")
         self.endpoints = eps
+        #: The configured endpoints — never pruned by discovery
+        #: (discovered standbys come and go; the static list is the
+        #: operator's contract).
+        self._seed_endpoints = list(eps)
         self.address = eps[0]
         self._dial_timeout = dial_timeout
         self._request_timeout = request_timeout
@@ -486,13 +490,26 @@ class RemoteCoord(CoordBackend):
         ref: learner add→promote, cluster.go:120-147). Learners are
         skipped: failing over to a standby whose mirror never caught up
         would serve stale or empty state."""
+        eligible = set()
         for m in self.member_list():
             md = m.metadata or {}
-            if (md.get("role") == "standby" and not md.get("learner", True)
-                    and m.peer_addr and m.peer_addr not in self.endpoints):
-                self.endpoints.append(m.peer_addr)
-                log.info("discovered standby endpoint",
-                         kv={"addr": m.peer_addr})
+            if (md.get("role") == "standby"
+                    and md.get("learner", True) is False and m.peer_addr):
+                eligible.add(m.peer_addr)
+                if m.peer_addr not in self.endpoints:
+                    self.endpoints.append(m.peer_addr)
+                    log.info("discovered standby endpoint",
+                             kv={"addr": m.peer_addr})
+        # Reconcile removals: a decommissioned standby (Standby.close
+        # deregisters it) must not linger as a dead dial target — each
+        # stale entry can burn a full dial_timeout per reconnect cycle.
+        # Configured seeds and the endpoint currently in use are kept.
+        for addr in list(self.endpoints):
+            if (addr not in eligible and addr not in self._seed_endpoints
+                    and addr != self.address):
+                self.endpoints.remove(addr)
+                log.info("pruned decommissioned standby endpoint",
+                         kv={"addr": addr})
         return list(self.endpoints)
 
     def _discovery_loop(self, interval: float) -> None:
@@ -517,6 +534,12 @@ class RemoteCoord(CoordBackend):
     def term(self) -> int:
         """Highest coordinator fencing term this client has seen."""
         return self._term
+
+    @property
+    def closed(self) -> bool:
+        """True once the client is closed for good (deliberate close,
+        or the reconnect window lapsed) — no call can ever succeed."""
+        return self._closed.is_set()
 
     def ping(self, timeout: float = 5.0) -> bool:
         try:

@@ -320,6 +320,11 @@ class Standby:
         from ptype_tpu.errors import CoordinationError
 
         try:
+            if self._admin is not None and self._admin.closed:
+                # The client gave up for good during a primary outage
+                # that outlasted its reconnect window — it can never
+                # serve another call; rebuild now that probes succeed.
+                self._close_admin()
             if self._admin is None:
                 self._admin = RemoteCoord(
                     [self.primary_address], dial_timeout=2.0,
@@ -342,7 +347,16 @@ class Standby:
                          kv={"member": member.id,
                              "addr": self.listen_address})
             if not self._member_promoted and self.promote_eligible:
-                self._admin.member_promote(self.member_id)
+                try:
+                    self._admin.member_promote(self.member_id)
+                except CoordinationError as e:
+                    if "not found" in str(e):
+                        # Our record was removed out from under us
+                        # (operator cleanup, or a same-address dedup):
+                        # forget the stale id so the next round
+                        # re-registers instead of retrying it forever.
+                        self.member_id = None
+                    raise
                 self._member_promoted = True
                 log.info("standby promoted to member: mirror caught up",
                          kv={"member": self.member_id})

@@ -281,6 +281,28 @@ def test_remote_barrier_across_clients(coord_server):
             c.close()
 
 
+def test_discover_endpoints_merge_and_prune(coord_server):
+    """Endpoint discovery merges promote-eligible standbys, skips
+    learners (their mirror may hold nothing), and prunes decommissioned
+    standbys so dead addresses don't burn dial timeouts on failover —
+    while never touching the configured seed list."""
+    c = RemoteCoord(coord_server.address)
+    try:
+        m = c.member_add("standby:x", "127.0.0.1:7777",
+                         {"role": "standby", "learner": True})
+        c.discover_endpoints()
+        assert "127.0.0.1:7777" not in c.endpoints  # learner: skipped
+        c.member_promote(m.id)
+        c.discover_endpoints()
+        assert "127.0.0.1:7777" in c.endpoints
+        c.member_remove(m.id)
+        c.discover_endpoints()
+        assert "127.0.0.1:7777" not in c.endpoints  # pruned
+        assert coord_server.address in c.endpoints  # seed kept
+    finally:
+        c.close()
+
+
 def test_remote_error_propagates(coord_server):
     c = RemoteCoord(coord_server.address)
     try:
