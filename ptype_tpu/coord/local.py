@@ -75,6 +75,12 @@ class LocalCoord(CoordBackend):
     def barrier(self, name: str, count: int, timeout: float | None = None) -> bool:
         return self.state.barrier(name, count, timeout)
 
+    @property
+    def closed(self) -> bool:
+        """True once the underlying state is closed — keepalive loops
+        use this to go quiet instead of warn-spinning forever."""
+        return self.state._closed.is_set()
+
     def close(self) -> None:
         # Shared named states are closed via reset_local_coords(); closing a
         # handle must not tear down state other Cluster handles still use.

@@ -2,9 +2,11 @@
 
 from __future__ import annotations
 
+import atexit
 import socket
 import threading
 import time
+import weakref
 
 from ptype_tpu import logs
 from ptype_tpu.coord import wire
@@ -22,13 +24,30 @@ from ptype_tpu.errors import CoordinationError
 
 log = logs.get_logger("coord.remote")
 
+#: Live clients, quiesced at interpreter exit: reconnect/rewatch/
+#: discovery threads that outlive logging teardown die loudly. Weak so
+#: the set never pins a client.
+_live_clients: "weakref.WeakSet[RemoteCoord]" = weakref.WeakSet()
+
+
+@atexit.register
+def _quiesce_clients() -> None:
+    for c in list(_live_clients):
+        c._closed.set()
+
 
 class _Pending:
-    __slots__ = ("event", "reply")
+    __slots__ = ("event", "reply", "sock")
 
-    def __init__(self):
+    def __init__(self, sock):
         self.event = threading.Event()
         self.reply: dict | None = None
+        #: The socket this request was sent on. After a reconnect, any
+        #: pending still tagged with an OLD socket was sent into the
+        #: void (a half-closed socket accepts exactly one post-FIN
+        #: write) — its reply can never come and it must be failed
+        #: rather than left to burn the full request timeout.
+        self.sock = sock
 
 
 class _StaleCoordinator(CoordinationError):
@@ -125,6 +144,7 @@ class RemoteCoord(CoordBackend):
                 target=self._discovery_loop, args=(discovery_interval,),
                 name=f"coord-discovery-{self.address}", daemon=True,
             ).start()
+        _live_clients.add(self)
 
     # ------------------------------------------------------------- plumbing
 
@@ -191,10 +211,17 @@ class RemoteCoord(CoordBackend):
         for w in watches:
             w.cancel()
 
-    def _fail_pending(self) -> None:
+    def _fail_pending(self, keep_sock=None) -> None:
+        """Fail outstanding requests. ``keep_sock``: spare requests
+        sent on that (current) socket — used after a re-dial to reap
+        only the stragglers that raced the reconnect onto the old
+        socket."""
         with self._pending_lock:
-            pending, self._pending = list(self._pending.values()), {}
-        for p in pending:
+            doomed = [(i, p) for i, p in self._pending.items()
+                      if keep_sock is None or p.sock is not keep_sock]
+            for i, _ in doomed:
+                del self._pending[i]
+        for _, p in doomed:
             p.event.set()
 
     def _try_reconnect(self) -> bool:
@@ -215,6 +242,11 @@ class RemoteCoord(CoordBackend):
                 continue
             log.info("coordination connection re-established",
                      kv={"addr": self.address})
+            # Reap requests that were sent while we were re-dialing:
+            # they went into the OLD socket (its first post-FIN write
+            # "succeeds" locally) after the loss-path _fail_pending had
+            # already run, so nothing else will ever complete them.
+            self._fail_pending(keep_sock=self._sock)
             # Re-arm watches on a fresh thread — _call needs this read
             # loop back in recv. The rewatch gate holds OTHER callers'
             # requests until re-arm completes, so a client's own
@@ -382,10 +414,10 @@ class RemoteCoord(CoordBackend):
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
-        p = _Pending()
+        sock = self._sock
+        p = _Pending(sock)
         with self._pending_lock:
             self._pending[req_id] = p
-        sock = self._sock
         try:
             wire.send_msg(sock, self._send_lock,
                           {"id": req_id, "op": op,

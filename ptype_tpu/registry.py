@@ -16,9 +16,11 @@ ordinals so the cluster topology *is* the pod mesh"); see
 from __future__ import annotations
 
 import abc
+import atexit
 import json
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 
 from ptype_tpu import logs
@@ -27,6 +29,18 @@ from ptype_tpu.coord.core import RangeOptions
 from ptype_tpu.errors import CoordinationError
 
 log = logs.get_logger("registry")
+
+#: Every live Registration, for atexit quiescing: keepalive beats that
+#: outlive the interpreter's logging teardown spew tracebacks into the
+#: tail of otherwise-clean runs (daemon threads die abruptly; threads
+#: mid-log die loudly). Weak so the set never keeps a handle alive.
+_live_registrations: "weakref.WeakSet[Registration]" = weakref.WeakSet()
+
+
+@atexit.register
+def _quiesce_registrations() -> None:
+    for r in list(_live_registrations):
+        r._stop.set()
 
 SERVICES_PREFIX = "services"
 
@@ -150,38 +164,68 @@ class Registration:
         self.ttl = ttl
         self._node_json = node_json
         self._stop = threading.Event()
+        self._failures = 0
+        # The loop holds only a WEAK reference to this handle between
+        # beats: an abandoned Registration (a crash simulation's `del`,
+        # a test that leaked one) becomes garbage, and its thread exits
+        # on the next beat instead of heartbeating — and warning —
+        # forever. A bound-method target would pin the handle alive.
         self._thread = threading.Thread(
-            target=self._keepalive_loop,
+            target=Registration._keepalive_entry,
+            args=(weakref.ref(self), self._stop, ttl / 2.0),
             name=f"lease-keepalive-{service}/{node}",
             daemon=True,
         )
         self._thread.start()
+        _live_registrations.add(self)
 
-    def _keepalive_loop(self) -> None:
+    @staticmethod
+    def _keepalive_entry(ref: "weakref.ref[Registration]",
+                         stop: threading.Event, interval: float) -> None:
         # Refresh at half the TTL, the usual heartbeat cadence
         # (ref: clientv3 KeepAlive drained in a goroutine, registry.go:69-83).
-        interval = self.ttl / 2.0
-        failures = 0
-        while not self._stop.wait(interval):
-            try:
-                self._registry._coord.keepalive(self.lease_id)
-                if failures:
-                    log.info("lease refresh recovered",
-                             kv={"service": self.service, "node": self.node})
-                failures = 0
-                log.debug("lease refreshed",
-                          kv={"service": self.service, "node": self.node})
-            except CoordinationError as e:
-                failures += 1
-                if failures <= 3 or failures % 10 == 0:  # bound log spam
-                    log.warning("lease refresh failed",
-                                kv={"service": self.service, "node": self.node,
-                                    "err": str(e), "failures": failures})
-                # If the lease itself is gone (expired server-side during a
-                # partition), a retry can never succeed — re-register with a
-                # fresh lease instead of heartbeating a dead registration.
-                if "not found" in str(e).lower():
-                    self._reregister()
+        while not stop.wait(interval):
+            self = ref()
+            if self is None:
+                return  # handle was abandoned; nothing left to keep alive
+            self._keepalive_once(stop)
+            del self  # drop the strong ref before parking on the event
+
+    def _keepalive_once(self, stop: threading.Event) -> None:
+        if getattr(self._registry._coord, "closed", False):
+            # Checked unconditionally, not just on error: a closed
+            # LocalCoord's state still ANSWERS keepalives (close()
+            # stops the sweeper but keeps leases), so an exception-path
+            # check would never fire there and the loop would heartbeat
+            # a closed state forever.
+            log.debug("keepalive stopping: coordination client closed",
+                      kv={"service": self.service, "node": self.node})
+            stop.set()
+            return
+        try:
+            self._registry._coord.keepalive(self.lease_id)
+            if self._failures:
+                log.info("lease refresh recovered",
+                         kv={"service": self.service, "node": self.node})
+            self._failures = 0
+            log.debug("lease refreshed",
+                      kv={"service": self.service, "node": self.node})
+        except CoordinationError as e:
+            if getattr(self._registry._coord, "closed", False):
+                # Closed for good mid-flight; next beat exits via the
+                # unconditional check — just don't warn about it.
+                stop.set()
+                return
+            self._failures += 1
+            if self._failures <= 3 or self._failures % 10 == 0:  # bound spam
+                log.warning("lease refresh failed",
+                            kv={"service": self.service, "node": self.node,
+                                "err": str(e), "failures": self._failures})
+            # If the lease itself is gone (expired server-side during a
+            # partition), a retry can never succeed — re-register with a
+            # fresh lease instead of heartbeating a dead registration.
+            if "not found" in str(e).lower():
+                self._reregister()
 
     def _reregister(self) -> None:
         # A close() racing with an in-flight keepalive must not resurrect
@@ -318,6 +362,11 @@ class CoordRegistry(Registry):
                         try:
                             nw._push(self.nodes(service_name))
                         except CoordinationError as e:
+                            if getattr(self._coord, "closed", False):
+                                # Closed for good: the reader has (or
+                                # will) cancel the coord watch; exit
+                                # quietly instead of warn-spinning.
+                                return
                             log.warning(
                                 "service watch re-list failed; retrying",
                                 kv={"service": service_name,
