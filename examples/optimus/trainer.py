@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import os
 
-import jax
 
 from ptype_tpu.cluster import join
 from ptype_tpu.config import config_from_env

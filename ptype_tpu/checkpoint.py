@@ -48,7 +48,6 @@ from typing import Any
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu import logs
 from ptype_tpu.errors import ClusterError

@@ -184,7 +184,7 @@ class ElasticTrainer:
         if self.detector.changed:
             lost, joined = self.detector.drain_changes()
             raise MembershipChanged(lost, joined)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding
 
         from ptype_tpu.models import transformer as tfm
 

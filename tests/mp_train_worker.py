@@ -58,7 +58,6 @@ def main() -> None:
     cluster = join(cfg)  # runs jax.distributed.initialize inside
 
     import jax
-    import jax.numpy as jnp
 
     from ptype_tpu.models import transformer as tfm
     from ptype_tpu.parallel.mesh import mesh_from_registry

@@ -1,6 +1,5 @@
 """Config contract tests (mirrors reference config_test.go:9-45 table)."""
 
-import os
 
 import pytest
 

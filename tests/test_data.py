@@ -40,8 +40,6 @@ def test_token_file_roundtrip(tmp_path):
 
 def test_token_file_trains(tmp_path):
     """End to end: corpus file → prefetched batches → train step."""
-    import jax
-
     from ptype_tpu.models import transformer as tfm
     from ptype_tpu.parallel.mesh import build_mesh
     from ptype_tpu.train.trainer import Trainer

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.parallel.mesh import build_mesh

@@ -2,7 +2,6 @@
 
 import socket
 import threading
-import zlib
 
 import numpy as np
 import pytest

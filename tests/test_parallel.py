@@ -4,7 +4,6 @@ This is the numerics tier SURVEY.md §4 calls for: collective results
 checked against NumPy references, plus the registry→mesh lowering.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -8,7 +8,6 @@ from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ptype_tpu.actor import ActorServer
 from ptype_tpu.metrics import MetricsRegistry

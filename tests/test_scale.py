@@ -10,7 +10,6 @@ collective must be well-formed) the way the real compile would.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import NamedSharding
 
 from ptype_tpu.models import transformer as tfm

@@ -1,0 +1,238 @@
+"""Static analysis for environments without ruff/flake8.
+
+The reference gated CI on golangci-lint (/root/reference/.golangci.yml,
+.travis.yml:1-11); this image bakes in no Python linter and installs
+are barred, so `make lint` runs this stdlib-only checker instead (and
+prefers `ruff check` when one is on PATH — see the Makefile).
+
+Checks (pyflakes-grade, conservative to stay false-positive-free):
+
+- syntax errors (ast.parse)
+- unused imports (module scope; ``as _``-style and __init__ re-exports
+  exempted — re-export surfaces exist to be imported FROM)
+- undefined names, via the symtable module's scope analysis: a name
+  loaded in a scope that neither that scope, an enclosing scope, the
+  module, nor builtins binds
+- mutable default arguments (list/dict/set displays)
+- bare ``except:`` clauses
+- ``== / !=`` comparisons against None / True / False
+- f-strings with no placeholders
+
+Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import sys
+import symtable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Names importable from typing/__future__ semantics or runtime magic
+#: that symtable reports oddly.
+_IMPLICIT = {"__file__", "__name__", "__doc__", "__package__",
+             "__spec__", "__loader__", "__builtins__", "__debug__",
+             "__path__", "__class__", "NotImplemented"}
+_BUILTINS = set(dir(builtins)) | _IMPLICIT
+
+
+def _iter_py(paths: list[str]):
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for f in filenames:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _scope_bound_names(table: symtable.SymbolTable) -> set[str]:
+    bound = set()
+    for sym in table.get_symbols():
+        if sym.is_assigned() or sym.is_imported() or sym.is_parameter():
+            bound.add(sym.get_name())
+    for child in table.get_children():
+        bound.add(child.get_name())  # nested def/class names
+    return bound
+
+
+def _check_undefined(path: str, src: str, findings: list[str]) -> None:
+    try:
+        top = symtable.symtable(src, path, "exec")
+    except SyntaxError:
+        return  # already reported by the ast pass
+
+    module_bound = _scope_bound_names(top)
+
+    def walk(table: symtable.SymbolTable, enclosing: set[str]) -> None:
+        bound = enclosing | _scope_bound_names(table)
+        for sym in table.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced():
+                continue
+            if (sym.is_assigned() or sym.is_imported()
+                    or sym.is_parameter() or sym.is_global()
+                    or sym.is_declared_global() or sym.is_nonlocal()):
+                continue
+            if sym.is_free():  # bound in an enclosing function scope
+                continue
+            if name in bound or name in _BUILTINS:
+                continue
+            findings.append(
+                f"{path}:{table.get_lineno()}: F821 undefined name "
+                f"{name!r} (scope {table.get_name()!r})")
+        for child in table.get_children():
+            # Class scopes do not enclose their methods' name lookup.
+            nxt = (enclosing | module_bound
+                   if table.get_type() == "class" else bound)
+            walk(child, nxt)
+
+    walk(top, set())
+
+
+class _AstChecks(ast.NodeVisitor):
+    def __init__(self, path: str, is_init: bool, findings: list[str]):
+        self.path = path
+        self.is_init = is_init
+        self.findings = findings
+        self.imported: dict[str, int] = {}  # name -> lineno
+        self.used: set[str] = set()
+        self.exported: set[str] = set()
+
+    def _f(self, node, code, msg):
+        self.findings.append(f"{self.path}:{node.lineno}: {code} {msg}")
+
+    # -- imports / usage for the unused-import pass (module level only)
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            if not name.startswith("_"):
+                self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directives, not bindings to "use"
+        for a in node.names:
+            if a.name == "*":
+                continue
+            name = a.asname or a.name
+            if not name.startswith("_"):
+                self.imported.setdefault(name, node.lineno)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if (isinstance(t, ast.Name) and t.id == "__all__"
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        self.exported.add(str(elt.value))
+        self.generic_visit(node)
+
+    # -- style/bug checks
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._f(node, "E722", "bare except")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self._f(d, "B006", "mutable default argument")
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if (isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and (comp.value is None or comp.value is True
+                         or comp.value is False)):
+                # == True/False/None: identity is the correct test.
+                self._f(node, "E711",
+                        f"comparison to {comp.value} with ==/!= "
+                        f"(use is / is not)")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self._f(node, "F541", "f-string without placeholders")
+        # No generic_visit: recursing into FormattedValue format specs
+        # re-reports the same literal.
+
+
+def check_file(path: str, findings: list[str]) -> None:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        findings.append(f"{path}:{e.lineno}: E999 {e.msg}")
+        return
+    is_init = os.path.basename(path) == "__init__.py"
+    raw: list[str] = []
+    v = _AstChecks(path, is_init, raw)
+    v.visit(tree)
+    if not is_init:  # __init__ imports ARE the re-export surface
+        for name, lineno in sorted(v.imported.items(),
+                                   key=lambda kv: kv[1]):
+            if name not in v.used and name not in v.exported:
+                raw.append(
+                    f"{path}:{lineno}: F401 {name!r} imported but unused")
+    _check_undefined(path, src, raw)
+    # Honor `# noqa` suppressions and drop duplicates (order kept).
+    lines = src.splitlines()
+    seen = set()
+    for finding in raw:
+        try:
+            lineno = int(finding.split(":", 2)[1])
+        except (IndexError, ValueError):
+            lineno = 0
+        if 0 < lineno <= len(lines) and "noqa" in lines[lineno - 1]:
+            continue
+        if finding not in seen:
+            seen.add(finding)
+            findings.append(finding)
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or [os.path.join(REPO, "ptype_tpu"),
+                     os.path.join(REPO, "tests"),
+                     os.path.join(REPO, "examples"),
+                     os.path.join(REPO, "bench.py"),
+                     os.path.join(REPO, "__graft_entry__.py"),
+                     os.path.join(REPO, "tools")]
+    findings: list[str] = []
+    n = 0
+    for path in _iter_py(paths):
+        n += 1
+        check_file(path, findings)
+    for line in findings:
+        print(line)
+    print(f"lint: {n} files, {len(findings)} findings",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
