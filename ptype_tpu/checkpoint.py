@@ -238,8 +238,35 @@ class Checkpointer:
             # barrier would then have destroyed good committed state.
             # Keep the committed copy; a caller that truly wants a
             # fresh save of the same step deletes the dir first.
-            log.info("checkpoint step already committed; keeping it",
-                     kv={"step": step, "dir": final, "process": pid})
+            # Guard against SILENT divergence: if what we were asked to
+            # save has a different parameter space than what is
+            # committed (keys/shapes/dtypes), keeping the old copy
+            # would hide a real bug — refuse loudly. Equal-structure
+            # re-saves keep the committed copy with a warning (values
+            # are not compared; that would need a full read-back).
+            mf_path = os.path.join(final, f"manifest.p{pid}.json")
+            committed = None
+            try:
+                with open(mf_path) as f:
+                    committed = json.load(f).get("leaves", {})
+            except (OSError, ValueError):
+                pass  # pre-guard layout or unreadable: keep-and-warn
+            if committed is not None:
+                mine = json.loads(json.dumps(
+                    {key: meta for key, _, meta in host}))
+                theirs = {k: {a: b for a, b in v.items()
+                              if a != "shards"}
+                          for k, v in committed.items()}
+                if mine != theirs:
+                    raise ClusterError(
+                        f"checkpoint step {step} is already committed "
+                        f"with a different parameter space — refusing "
+                        f"to silently keep the stale copy; delete "
+                        f"{final} to re-save this step")
+            log.warning(
+                "checkpoint step already committed; keeping the "
+                "committed copy (tensor values are not compared)",
+                kv={"step": step, "dir": final, "process": pid})
             return final
         # Stale-attempt debris (a previous save of this step that timed
         # out or crashed) must never satisfy the barrier: process 0

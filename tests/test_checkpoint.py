@@ -197,3 +197,12 @@ def test_multi_recommit_of_committed_step_is_kept(tmp_path):
         == path  # kept, not rewritten
     assert os.path.getmtime(marker) == mtime
     assert ckpt.latest_step() == 5
+
+    # But a re-save of the SAME step with a DIFFERENT parameter space
+    # must refuse loudly — silently keeping the stale copy would hide
+    # real divergence (a changed model saving to an old step number).
+    other = {"w": jax.device_put(jnp.ones((8,)),
+                                 named_sharding(mesh, P()))}
+    with pytest.raises(ClusterError, match="different parameter space"):
+        ckpt._write_multi(5, ckpt._snapshot(other), None, 0, 1)
+    assert os.path.getmtime(marker) == mtime  # committed copy intact
