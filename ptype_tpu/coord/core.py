@@ -311,7 +311,7 @@ class CoordState:
     def __init__(self, sweep_interval: float = 0.25,
                  data_dir: str | None = None,
                  compact_every: int = 10_000,
-                 bump_term: bool = False):
+                 bump_term: bool | int = False):
         self._lock = threading.RLock()
         self._kv: dict[str, KVItem] = {}
         self._rev = 0
@@ -366,10 +366,14 @@ class CoordState:
                 # Promotion: supersede every prior primary BEFORE the
                 # compact below persists the new term — a crash after
                 # serving even one request must not resurrect at the
-                # old term.
-                self._term += 1
+                # old term. May bump by >1: a junior standby promoting
+                # past unresponsive seniors jumps their term slots so
+                # a slow senior finishing its own promotion later can
+                # never land on the SAME term (coord/standby.py
+                # succession).
+                self._term += int(bump_term)
                 log.info("coordination term bumped (promotion)",
-                         kv={"term": self._term})
+                         kv={"term": self._term, "by": int(bump_term)})
             self._wal = open(self._wal_path(), "a", encoding="utf-8")
             # Compact-on-start: fold the recovered state into a fresh
             # snapshot + truncated WAL. Appending to the replayed file
@@ -381,7 +385,7 @@ class CoordState:
             # and bounds future replay work as a side effect.
             self._compact()
         elif bump_term:
-            self._term += 1
+            self._term += int(bump_term)
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="coord-lease-sweeper", daemon=True
         )

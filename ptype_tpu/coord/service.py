@@ -48,10 +48,12 @@ class CoordServer:
     def __init__(self, address: str = "127.0.0.1:0",
                  state: CoordState | None = None,
                  data_dir: str | None = None,
-                 bump_term: bool = False):
-        # bump_term=True marks this server a PROMOTED successor: the
-        # recovered state's fencing term is incremented so clients that
-        # adopt it refuse any superseded primary (coord/standby).
+                 bump_term: bool | int = False):
+        # bump_term marks this server a PROMOTED successor: the
+        # recovered state's fencing term is incremented (by that many
+        # slots — juniors promoting past unresponsive seniors skip
+        # their slots) so clients that adopt it refuse any superseded
+        # primary (coord/standby).
         self.state = state or CoordState(data_dir=data_dir,
                                          bump_term=bump_term)
         self._owns_state = state is None

@@ -205,7 +205,8 @@ class Standby:
                  failure_threshold: int = 3,
                  probe_timeout: float = 2.0,
                  replicate: bool = False,
-                 register: bool = True):
+                 register: bool = True,
+                 succession_grace: float = 10.0):
         self.primary_address = primary_address
         self.listen_address = listen_address
         self.data_dir = data_dir
@@ -226,6 +227,19 @@ class Standby:
         self.member_id: int | None = None
         self._member_promoted = False
         self._admin = None  # lazy RemoteCoord to the primary
+        #: Promote-eligible peer standbys [(member_id, addr), ...],
+        #: cached from the live primary's membership each probe round.
+        #: On primary death this is the succession list: the LOWEST
+        #: member id (most senior attach) promotes; juniors defer,
+        #: adopt the winner as their new primary, and keep guarding —
+        #: deterministic election without a quorum tier (the raft-
+        #: election analog; ref cluster.go:120-147).
+        self._peer_standbys: list[tuple[int, str]] = []
+        self._defer_deadline: float | None = None
+        #: Per-senior grace window (seconds) before a junior stops
+        #: waiting for an unresponsive senior and promotes itself;
+        #: floored at 2 full detection periods.
+        self.succession_grace = succession_grace
         # replicate=True: ``data_dir`` is LOCAL and a WalFollower
         # mirrors the primary's WAL into it over TCP — the cross-host
         # deployment. False: ``data_dir`` IS the primary's (shared
@@ -273,11 +287,11 @@ class Standby:
 
     # ------------------------------------------------------------ probes
 
-    def _probe(self) -> bool:
+    def _probe(self, address: str | None = None) -> bool:
         """One liveness probe: full request/response, not just a TCP
         accept — a wedged primary that accepts but never answers is
         dead for clients and must fail the probe too."""
-        host, _, port = self.primary_address.rpartition(":")
+        host, _, port = (address or self.primary_address).rpartition(":")
         try:
             sock = socket.create_connection(
                 (host, int(port)), timeout=self.probe_timeout)
@@ -360,6 +374,13 @@ class Standby:
                 self._member_promoted = True
                 log.info("standby promoted to member: mirror caught up",
                          kv={"member": self.member_id})
+            # Refresh the succession list while the primary can still
+            # tell us — it is read AFTER the primary dies.
+            self._peer_standbys = [
+                (m.id, m.peer_addr) for m in self._admin.member_list()
+                if (m.metadata or {}).get("role") == "standby"
+                and (m.metadata or {}).get("learner") is False
+                and m.peer_addr != self.listen_address]
         except CoordinationError as e:
             log.debug("standby membership sync failed; retrying",
                       kv={"err": str(e)})
@@ -374,6 +395,7 @@ class Standby:
         while not self._closed.is_set():
             if self._probe():
                 failures = 0
+                self._defer_deadline = None
                 # The primary is back after a failed/deferred promotion
                 # attempt that closed the follower: resume mirroring.
                 self._ensure_follower()
@@ -384,13 +406,103 @@ class Standby:
                           kv={"n": failures,
                               "threshold": self.failure_threshold})
                 if failures >= self.failure_threshold:
-                    if self._promote():
+                    verdict = self._defer_to_senior()
+                    if verdict == "adopted":
+                        # Fresh primary: it must fail threshold
+                        # CONSECUTIVE probes of its own before we act
+                        # on it (a single slow post-takeover probe is
+                        # not a death).
+                        failures = 0
+                    elif verdict == "defer":
+                        pass
+                    elif self._promote():
                         return
                     # Promotion refused (WAL fence held by a live
                     # primary) or failed (port busy): keep monitoring
                     # and retry — a dying monitor thread would leave
                     # the cluster with no failover coverage at all.
             self._closed.wait(self.check_interval)
+
+    # ------------------------------------------------------- succession
+
+    def _seniors(self) -> list[tuple[int, str]]:
+        """Promote-eligible peer standbys senior to us (lower member
+        id = earlier attach), in succession order. The current primary
+        is excluded defensively — a stale cache entry for it must not
+        make us "re-adopt" our own primary."""
+        peers = [(mid, a) for mid, a in self._peer_standbys
+                 if a != self.primary_address]
+        if self.member_id is None:
+            # We never registered: every known eligible peer outranks
+            # us — promoting over their heads would split the brain.
+            return sorted(peers)
+        return sorted((mid, a) for mid, a in peers
+                      if mid < self.member_id)
+
+    def _defer_to_senior(self) -> str | None:
+        """Succession arbitration for MULTIPLE standbys on one primary
+        (reachable since standbys attach dynamically): only the most
+        senior eligible standby promotes; juniors defer — and when the
+        winner starts serving, they ADOPT it as their new primary and
+        keep guarding (the self-healing chain). Returns "adopted" when
+        a promoted senior became our new primary, "defer" while inside
+        a senior's grace window, and None when this standby should
+        promote."""
+        seniors = self._seniors()
+        if not seniors:
+            self._defer_deadline = None
+            return None
+        for _, addr in seniors:
+            if self._probe(addr):
+                self._adopt_primary(addr)
+                return "adopted"
+        # No senior is serving yet. Give each of them a staggered
+        # grace window to come up before assuming they died with the
+        # primary and promoting anyway — deterministic, no
+        # coordination needed. The window floor is generous (a
+        # senior's promotion replays its whole mirror, which can take
+        # tens of seconds at scale) — and even if we DO promote while
+        # a slow senior is mid-replay, our rank-based term bump
+        # (_promote) lands us on a strictly higher term, so clients
+        # fence whichever of us is superseded rather than splitting.
+        import time as _time
+
+        if self._defer_deadline is None:
+            grace = max(
+                self.succession_grace,
+                2 * self.failure_threshold * self.check_interval)
+            self._defer_deadline = (_time.monotonic()
+                                    + len(seniors) * grace)
+            log.info("standby deferring to senior peers",
+                     kv={"seniors": [a for _, a in seniors],
+                         "window_s": round(len(seniors) * grace, 1)})
+        if _time.monotonic() < self._defer_deadline:
+            return "defer"
+        # Window expired: promote. Deliberately NOT clearing the
+        # deadline — a transiently failed promotion must retry next
+        # round, not re-arm a fresh multi-second window with nobody
+        # serving. (It clears on probe success or adoption.)
+        log.warning("senior standbys never took over; promoting",
+                    kv={"seniors": [a for _, a in seniors]})
+        return None
+
+    def _adopt_primary(self, addr: str) -> None:
+        """A senior peer has promoted: re-point at it and keep
+        guarding — the standby chain re-forms without an operator."""
+        log.info("adopting promoted peer as new primary",
+                 kv={"old": self.primary_address, "new": addr})
+        self.primary_address = addr
+        self._defer_deadline = None
+        self._close_admin()  # rebuilt against the new primary
+        # Our member record rode the WAL mirror into the winner's
+        # state, so member_id/_member_promoted stay valid.
+        if self.follower is not None and self.follower.close():
+            self.follower = None
+        # A reader thread that refused to die leaves self.follower set
+        # (closed, thread live): _ensure_follower's re-arm deferral
+        # machinery retries on later rounds rather than risking two
+        # writers on one mirror.
+        self._ensure_follower()
 
     def _promote(self) -> bool:
         if self._closed.is_set():
@@ -427,10 +539,13 @@ class Standby:
             # it, this raises instead of double-writing the WAL — probes
             # keep running and promotion retries once the primary truly
             # dies. bump_term marks this server the successor so
-            # clients refuse any stale primary (the wal-stream fence).
+            # clients refuse any stale primary (the wal-stream fence);
+            # a junior promoting past unresponsive seniors skips their
+            # term slots so a slow senior finishing its own promotion
+            # later can never land on the same term.
             self.server = CoordServer(self.listen_address,
                                       data_dir=self.data_dir,
-                                      bump_term=True)
+                                      bump_term=1 + len(self._seniors()))
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
@@ -441,6 +556,7 @@ class Standby:
             return False
         self.promoted.set()
         self._close_admin()  # it pointed at the dead primary
+        self._retire_own_member_record()
         return True
 
     # ------------------------------------------------------------- admin
@@ -508,9 +624,9 @@ class Standby:
         deadline = _time.monotonic() + timeout
         while True:
             try:
-                self.server = CoordServer(self.listen_address,
-                                          data_dir=self.data_dir,
-                                          bump_term=True)
+                self.server = CoordServer(
+                    self.listen_address, data_dir=self.data_dir,
+                    bump_term=1 + len(self._seniors()))
                 break
             except Exception as e:  # noqa: BLE001 — fence / transient
                 if _time.monotonic() > deadline:
@@ -539,9 +655,26 @@ class Standby:
                 _time.sleep(0.2)
         self.promoted.set()
         self._close_admin()  # it pointed at the superseded primary
+        self._retire_own_member_record()
         log.info("standby promoted by operator",
                  kv={"standby": self.listen_address})
         return self.server
+
+    def _retire_own_member_record(self) -> None:
+        """We are the primary now: drop our own role=standby member
+        record from OUR state (it rode the mirror in). Leaving it
+        would poison peers' succession lists with the current primary
+        posing as an eligible standby — every later failover would
+        burn a grace window probing it (or worse, 're-adopt' it)."""
+        if self.member_id is None or self.server is None:
+            return
+        try:
+            self.server.state.member_remove(self.member_id)
+        except Exception as e:  # noqa: BLE001 — cosmetic cleanup
+            log.debug("could not retire own standby member record",
+                      kv={"err": str(e)})
+        self.member_id = None
+        self._member_promoted = False
 
     def close(self) -> None:
         """Stop monitoring; shut the promoted server down if any.

@@ -777,6 +777,123 @@ def test_operator_promote_refuses_unsynced_mirror_unless_forced(
         standby.close()
 
 
+def test_two_standbys_deterministic_succession(tmp_path):
+    """Two wal-stream standbys guarding ONE primary (easy to reach now
+    that standbys attach dynamically) must not both promote on its
+    death: the senior (lowest member id) takes over, the junior defers,
+    ADOPTS the winner as its new primary, and keeps guarding — so a
+    second death fails over again with no operator action. State
+    survives both hops; at no point do two primaries serve."""
+    import socket as _socket
+
+    def _port():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    addrs = [f"127.0.0.1:{_port()}" for _ in range(3)]
+    seed = _start_seed(addrs[0], str(tmp_path / "p"))
+    sb_a = Standby(addrs[0], addrs[1], str(tmp_path / "a"),
+                   check_interval=0.2, failure_threshold=3,
+                   probe_timeout=0.5, replicate=True)
+    sb_b = None
+    coord = RemoteCoord(addrs, reconnect_timeout=30.0,
+                        request_timeout=5.0)
+    try:
+        assert sb_a.follower.synced.wait(timeout=10)
+        # A must be registered + eligible before B attaches, so the
+        # seniority order (member id) is deterministic: A < B.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and sb_a.member_id is None:
+            time.sleep(0.1)
+        assert sb_a.member_id is not None
+        sb_b = Standby(addrs[0], addrs[2], str(tmp_path / "b"),
+                       check_interval=0.2, failure_threshold=3,
+                       probe_timeout=0.5, replicate=True)
+        assert sb_b.follower.synced.wait(timeout=10)
+        coord.put("store/hop", "0")
+        # Both must know about each other (succession lists cached from
+        # the live primary) before the kill.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not (
+                sb_b._peer_standbys and sb_a._peer_standbys
+                and sb_b.member_id is not None):
+            time.sleep(0.1)
+        assert any(a == addrs[2] for _, a in sb_a._peer_standbys), (
+            f"senior never learned about the junior: "
+            f"{sb_a._peer_standbys}")
+        assert any(a == addrs[1] for _, a in sb_b._peer_standbys), (
+            f"junior never learned about the senior: "
+            f"{sb_b._peer_standbys}")
+        time.sleep(0.5)  # let the mirrors stream the last put
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+
+        assert sb_a.promoted.wait(timeout=15), "senior never promoted"
+        # The junior must NOT promote; it re-points at the winner.
+        deadline = time.monotonic() + 15
+        while (time.monotonic() < deadline
+               and sb_b.primary_address != addrs[1]):
+            assert not sb_b.promoted.is_set(), (
+                "junior promoted alongside the senior: split brain")
+            time.sleep(0.1)
+        assert sb_b.primary_address == addrs[1], (
+            "junior never adopted the promoted senior")
+        assert not sb_b.promoted.is_set()
+        # The follower object is swapped during adoption (briefly
+        # None); wait for a LIVE follower whose fresh mirror synced
+        # from the new primary.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            f = sb_b.follower
+            if f is not None and not f.closed and f.synced.is_set():
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("junior's mirror never re-synced from the "
+                        "new primary")
+
+        # Write on the new primary, then kill it: the junior (now the
+        # only standby) takes over — the chain re-formed itself.
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                coord.put("store/hop", "1")
+                break
+            except CoordinationError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        time.sleep(0.5)  # mirror the record
+        sb_a.server.close()
+        assert sb_b.promoted.wait(timeout=20), (
+            "junior never promoted after the second death")
+
+        deadline = time.monotonic() + 15
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                res = coord.range("store/hop")
+                val = res.items[0].value if res.items else None
+                if val == "1":
+                    break
+            except CoordinationError:
+                pass
+            time.sleep(0.1)
+        assert val == "1", f"state lost across the double hop: {val!r}"
+        # Fence: the second takeover is at a strictly higher term.
+        assert sb_b.server.state.term == 2, sb_b.server.state.term
+    finally:
+        coord.close()
+        sb_a.close()
+        if sb_b is not None:
+            sb_b.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
