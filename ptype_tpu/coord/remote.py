@@ -53,7 +53,13 @@ class _Pending:
 class _StaleCoordinator(CoordinationError):
     """The endpoint answered but is a SUPERSEDED primary (its fencing
     term is behind this client's). The request was refused before
-    execution, so retrying against another endpoint is always safe."""
+    execution, so retrying against another endpoint is always safe.
+    Carries the endpoint that refused, so concurrent callers bounce
+    it exactly once."""
+
+    def __init__(self, msg: str, endpoint: str | None = None):
+        super().__init__(msg)
+        self.endpoint = endpoint
 
 
 class _SendFailed(CoordinationError):
@@ -363,7 +369,7 @@ class RemoteCoord(CoordBackend):
                 return self._call_once(op, reply_timeout, kwargs)
             except _StaleCoordinator as e:
                 stale = e
-                self._bounce_endpoint()
+                self._bounce_endpoint(e.endpoint)
             except _SendFailed:
                 if stale is None:
                     raise  # ordinary failure: callers own the retry
@@ -375,10 +381,14 @@ class RemoteCoord(CoordBackend):
         raise CoordinationError(
             f"no current-term coordinator among {self.endpoints}: {stale}")
 
-    def _bounce_endpoint(self) -> None:
+    def _bounce_endpoint(self, stale_ep: str | None) -> None:
         """Abandon a superseded primary: advance the endpoint cursor so
         the reader's re-dial starts at the NEXT endpoint, then drop the
-        socket to trigger the reconnect loop."""
+        socket to trigger the reconnect loop. Concurrent callers whose
+        stale replies came from the same endpoint bounce it ONCE — a
+        double advance could skip straight past the current primary."""
+        if stale_ep is not None and self.address != stale_ep:
+            return  # another caller (or the reader) already moved on
         try:
             idx = self.endpoints.index(self.address)
         except ValueError:
@@ -449,7 +459,8 @@ class RemoteCoord(CoordBackend):
         if not p.reply.get("ok"):
             if p.reply.get("stale"):
                 raise _StaleCoordinator(
-                    p.reply.get("error", "stale coordinator"))
+                    p.reply.get("error", "stale coordinator"),
+                    endpoint=self.address)
             raise CoordinationError(p.reply.get("error", "unknown coordination error"))
         return p.reply.get("result")
 

@@ -239,3 +239,81 @@ def test_balanced_client_concurrent_round_robin():
             c.close()
         for s in servers:
             s.close()
+
+
+def test_concurrent_fence_bounces_converge(tmp_path):
+    """Many threads hitting a SUPERSEDED primary at once must bounce it
+    exactly once each round (a double endpoint-advance could skip the
+    current primary) and converge on the fenced successor — no write
+    leaks to the stale side, no thread strands."""
+    import time
+
+    from ptype_tpu.coord.remote import RemoteCoord
+    from ptype_tpu.coord.service import CoordServer
+    from ptype_tpu.errors import CoordinationError
+
+    a = CoordServer("127.0.0.1:0", data_dir=str(tmp_path / "a"))
+    addr_a = a.address
+    b = CoordServer("127.0.0.1:0", data_dir=str(tmp_path / "b"),
+                    bump_term=True)  # term 1: the current primary
+    addr_b = b.address
+    client = RemoteCoord([addr_a, addr_b], request_timeout=3.0,
+                         reconnect_timeout=20.0)
+    a2 = b2 = None
+    try:
+        # Adopt term 1: kill A, ride onto B.
+        a.close()
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                client.put("adopt", "1")
+                break
+            except CoordinationError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert client.term == 1
+
+        # The hazard window: stale A back on its address, B down.
+        a2 = CoordServer(addr_a, data_dir=str(tmp_path / "a"))
+        b.close()
+
+        def hammer(i):
+            deadline = time.monotonic() + 25
+            for n in range(5):
+                while True:
+                    try:
+                        client.put(f"race/{i}/{n}", "v")
+                        break
+                    except CoordinationError:
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.1)
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # let every thread pile into the stale primary
+        b2 = CoordServer(addr_b, data_dir=str(tmp_path / "b"))
+        errs = []
+        for t in threads:
+            t.join(timeout=40)
+            if t.is_alive():
+                errs.append("thread stranded")
+        assert not errs, errs
+
+        # Every write landed on the CURRENT primary...
+        from ptype_tpu.coord.core import RangeOptions
+
+        assert b2.state.range(
+            "race/", RangeOptions(prefix=True)).count == N_THREADS * 5
+        # ...and none leaked onto the stale one.
+        assert a2.state.range(
+            "race/", RangeOptions(prefix=True)).count == 0
+        assert client.address == addr_b
+    finally:
+        client.close()
+        for srv in (a2, b2):
+            if srv is not None:
+                srv.close()
