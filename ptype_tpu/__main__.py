@@ -197,7 +197,8 @@ def _standby() -> None:
     # STANDBY_REPLICATE=1: cross-host mode — data_dir is local and a
     # WalFollower streams the primary's WAL into it (no shared fs).
     sb = Standby(cfg.platform.coordinator_address, listen, data_dir,
-                 replicate=os.environ.get("STANDBY_REPLICATE") == "1")
+                 replicate=os.environ.get("STANDBY_REPLICATE") == "1",
+                 fsync=cfg.platform.wal_fsync)
 
     def _switchover(*_):
         # promote() raises if the primary still holds the WAL fence

@@ -190,6 +190,7 @@ def join(cfg: Config) -> Cluster:
                     coord_addr,
                     data_dir=(_os.path.join(platform.data_dir, "coord")
                               if platform.data_dir else None),
+                    fsync=platform.wal_fsync,
                 )
                 _servers[server.address] = server
                 owned_server = server

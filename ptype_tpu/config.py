@@ -59,6 +59,11 @@ class PlatformConfig:
     lease_ttl: float = 2.0
     #: Dial timeout to the coordination service (ref: 5s, registry.go:37).
     dial_timeout: float = 5.0
+    #: fsync the coordination WAL per record. Default off: flush-only
+    #: survives coordinator PROCESS death (the elastic story's failure
+    #: mode) at microsecond append cost. On = full etcd-raft-log parity
+    #: (survives host power loss) at ~ms/append on typical disks.
+    wal_fsync: bool = False
     #: host:port of the JAX distributed coordination service for
     #: multi-controller runs (``num_processes > 1``). Empty = derive
     #: from ``coordinator_address`` host with port+1. ``join`` calls
@@ -133,7 +138,7 @@ _CONFIG_FIELDS = {
 _PLATFORM_FIELDS = {
     "name", "coordinator_address", "is_coordinator", "mesh_axes",
     "num_processes", "process_id", "data_dir", "lease_ttl", "dial_timeout",
-    "jax_coordinator_address",
+    "jax_coordinator_address", "wal_fsync",
 }
 
 

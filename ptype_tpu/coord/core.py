@@ -311,7 +311,8 @@ class CoordState:
     def __init__(self, sweep_interval: float = 0.25,
                  data_dir: str | None = None,
                  compact_every: int = 10_000,
-                 bump_term: bool | int = False):
+                 bump_term: bool | int = False,
+                 fsync: bool = False):
         self._lock = threading.RLock()
         self._kv: dict[str, KVItem] = {}
         self._rev = 0
@@ -335,6 +336,10 @@ class CoordState:
         self._wal = None
         self._wal_count = 0
         self._wal_gen = 0
+        #: fsync per appended record (and through compaction). Off =
+        #: flush-only: survives process death, not host power loss —
+        #: the documented default scope. On = etcd raft-log parity.
+        self._fsync = fsync
         self._compact_every = compact_every
         self._data_dir = data_dir
         self._flock = None
@@ -415,6 +420,10 @@ class CoordState:
 
         self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._wal.flush()
+        if self._fsync:
+            import os
+
+            os.fsync(self._wal.fileno())
         self._wal_count += 1
         if self._wal_count >= self._compact_every:
             self._compact()
@@ -463,6 +472,9 @@ class CoordState:
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self._snap_path())
         # Crash here leaves the new snapshot with the OLD-generation
         # WAL — replay sees the header mismatch and skips it (those

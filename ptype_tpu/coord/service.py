@@ -48,14 +48,16 @@ class CoordServer:
     def __init__(self, address: str = "127.0.0.1:0",
                  state: CoordState | None = None,
                  data_dir: str | None = None,
-                 bump_term: bool | int = False):
+                 bump_term: bool | int = False,
+                 fsync: bool = False):
         # bump_term marks this server a PROMOTED successor: the
         # recovered state's fencing term is incremented (by that many
         # slots — juniors promoting past unresponsive seniors skip
         # their slots) so clients that adopt it refuse any superseded
         # primary (coord/standby).
         self.state = state or CoordState(data_dir=data_dir,
-                                         bump_term=bump_term)
+                                         bump_term=bump_term,
+                                         fsync=fsync)
         self._owns_state = state is None
         host, _, port = address.rpartition(":")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -206,7 +206,8 @@ class Standby:
                  probe_timeout: float = 2.0,
                  replicate: bool = False,
                  register: bool = True,
-                 succession_grace: float = 10.0):
+                 succession_grace: float = 10.0,
+                 fsync: bool = False):
         self.primary_address = primary_address
         self.listen_address = listen_address
         self.data_dir = data_dir
@@ -240,6 +241,9 @@ class Standby:
         #: waiting for an unresponsive senior and promotes itself;
         #: floored at 2 full detection periods.
         self.succession_grace = succession_grace
+        #: WAL durability mode for the server this standby starts at
+        #: promotion (match the primary's ``wal_fsync`` setting).
+        self._fsync = fsync
         # replicate=True: ``data_dir`` is LOCAL and a WalFollower
         # mirrors the primary's WAL into it over TCP — the cross-host
         # deployment. False: ``data_dir`` IS the primary's (shared
@@ -545,7 +549,8 @@ class Standby:
             # later can never land on the same term.
             self.server = CoordServer(self.listen_address,
                                       data_dir=self.data_dir,
-                                      bump_term=1 + len(self._seniors()))
+                                      bump_term=1 + len(self._seniors()),
+                                      fsync=self._fsync)
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
@@ -626,7 +631,8 @@ class Standby:
             try:
                 self.server = CoordServer(
                     self.listen_address, data_dir=self.data_dir,
-                    bump_term=1 + len(self._seniors()))
+                    bump_term=1 + len(self._seniors()),
+                    fsync=self._fsync)
                 break
             except Exception as e:  # noqa: BLE001 — fence / transient
                 if _time.monotonic() > deadline:

@@ -181,6 +181,28 @@ def test_member_promote_learner(coord):
         coord.member_promote(9999)
 
 
+def test_fsync_wal_roundtrip(tmp_path):
+    """wal_fsync=True (etcd raft-log durability parity) must behave
+    identically at the API level: appends, compaction, and recovery all
+    work with per-record fsync on."""
+    from ptype_tpu.coord.core import CoordState
+
+    d = str(tmp_path / "coord")
+    st = CoordState(data_dir=d, fsync=True, compact_every=4)
+    for i in range(10):  # crosses a compaction boundary
+        st.put(f"k{i}", str(i))
+    lease = st.grant(5.0)
+    st.put("leased", "v", lease=lease)
+    st.close()
+
+    st2 = CoordState(data_dir=d, fsync=True)
+    try:
+        assert st2.range("k7").items[0].value == "7"
+        assert st2.range("leased").items[0].lease == lease
+    finally:
+        st2.close()
+
+
 def test_member_promote_survives_restart(tmp_path):
     """The promoted status is WAL-logged: a coordinator restarted from
     its data_dir still knows which standbys are promote-eligible."""
