@@ -16,9 +16,15 @@ from ptype_tpu.coord.core import Member, RangeOptions, RangeResult, Watch
 class CoordBackend(abc.ABC):
     """KV + leases + watches + members + barrier, transport-agnostic."""
 
-    # KV
+    # KV. sync=True acks only after every WAL follower attached at the
+    # barrier mirrored the write (the raft-commit analog;
+    # coord/core.wait_replicated) — raises if replication is not
+    # acknowledged within sync_timeout (None = the shared
+    # DEFAULT_SYNC_TIMEOUT).
     @abc.abstractmethod
-    def put(self, key: str, value: str, lease: int = 0) -> int: ...
+    def put(self, key: str, value: str, lease: int = 0,
+            sync: bool = False,
+            sync_timeout: float | None = None) -> int: ...
 
     @abc.abstractmethod
     def range(self, key: str, options: RangeOptions | None = None) -> RangeResult: ...

@@ -27,6 +27,11 @@ from ptype_tpu.errors import CoordinationError
 
 log = logs.get_logger("coord")
 
+#: One default for the sync-put replication barrier everywhere (wire
+#: dispatch, LocalCoord, the backend API) — three hardcoded copies
+#: would drift.
+DEFAULT_SYNC_TIMEOUT = 5.0
+
 
 class EventType(enum.Enum):
     PUT = "put"
@@ -243,15 +248,20 @@ class ReplFeed:
         self.id = feed_id
         self._cancel_fn = cancel_fn
         self._cond = threading.Condition()
-        self._items: list[tuple[str, dict]] = []
+        self._items: list[tuple[str, dict, int]] = []
         self._closed = False
+        #: Highest replication sequence this follower has ACKNOWLEDGED
+        #: mirroring (durable on its side). A snapshot ack covers every
+        #: record folded into it. Read by CoordState.wait_replicated —
+        #: the sync-put (raft-commit-analog) barrier.
+        self.acked = 0
 
-    def _push(self, kind: str, data: dict) -> None:
+    def _push(self, kind: str, data: dict, seq: int) -> None:
         overflow = False
         with self._cond:
             if self._closed:
                 return
-            self._items.append((kind, data))
+            self._items.append((kind, data, seq))
             if len(self._items) > self.MAX_BUFFER:
                 overflow = True
             self._cond.notify_all()
@@ -261,7 +271,8 @@ class ReplFeed:
                         kv={"feed": self.id, "buffered": self.MAX_BUFFER})
             self.cancel()
 
-    def get(self, timeout: float | None = None) -> list[tuple[str, dict]]:
+    def get(self, timeout: float | None = None
+            ) -> list[tuple[str, dict, int]]:
         """Block for the next batch; [] on timeout or close."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
@@ -345,6 +356,11 @@ class CoordState:
         self._flock = None
         self._repl_feeds: list[ReplFeed] = []
         self._next_repl = 1
+        #: Monotonic replication sequence: one per feed-visible event
+        #: (mutation record or snapshot). Follower acks reference it;
+        #: wait_replicated barriers on it.
+        self._repl_seq = 0
+        self._ack_cond = threading.Condition(self._lock)
         if data_dir:
             import fcntl
             import os
@@ -409,11 +425,12 @@ class CoordState:
 
     def _append(self, rec: dict) -> None:
         """Log one mutation (called under the lock, before ack)."""
+        self._repl_seq += 1
         # Copy: an overflowing feed self-cancels INSIDE _push, which
         # removes it from this list mid-iteration — a sibling feed
         # would silently miss this record (divergent mirror).
         for feed in list(self._repl_feeds):
-            feed._push("rec", rec)
+            feed._push("rec", rec, self._repl_seq)
         if self._wal is None:
             return
         import json
@@ -467,8 +484,10 @@ class CoordState:
 
         new_gen = self._wal_gen + 1
         snap = self._snapshot_dict(wal_gen=new_gen)
+        # A snapshot folds every record through the current seq, so a
+        # follower's ack of it covers them all.
         for feed in list(self._repl_feeds):  # _push may self-cancel
-            feed._push("snap", snap)
+            feed._push("snap", snap, self._repl_seq)
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
@@ -765,7 +784,7 @@ class CoordState:
         with self._lock:
             feed = ReplFeed(self._next_repl, self._remove_repl)
             self._next_repl += 1
-            feed._push("snap", self._snapshot_dict())
+            feed._push("snap", self._snapshot_dict(), self._repl_seq)
             self._repl_feeds.append(feed)
             return feed
 
@@ -773,6 +792,46 @@ class CoordState:
         with self._lock:
             if feed in self._repl_feeds:
                 self._repl_feeds.remove(feed)
+            # A sync-put waiter blocked on this (now dead) feed must
+            # re-evaluate against the surviving membership.
+            self._ack_cond.notify_all()
+
+    def note_repl_ack(self, feed: ReplFeed, seq: int) -> None:
+        """A follower acknowledged mirroring through ``seq``."""
+        with self._lock:
+            if seq > feed.acked:
+                feed.acked = seq
+                self._ack_cond.notify_all()
+
+    def wait_replicated(self, seq: int | None = None,
+                        timeout: float | None = None) -> bool:
+        """Block until every replication follower that was attached AT
+        BARRIER START has acknowledged mirroring through ``seq``
+        (default: everything so far) — the sync-put barrier, the
+        closest 2-node analog of a raft quorum commit. With no
+        followers attached it returns True immediately (there is
+        nobody to replicate to) — but a follower that dies or
+        overflows MID-barrier without acking fails the barrier: its
+        mirror may not hold the record, and "success because the
+        witness vanished" is exactly the silent loss this feature
+        exists to prevent. False on timeout/death: the mutation IS
+        applied locally; only the replication guarantee is unmet."""
+        if timeout is None:
+            timeout = DEFAULT_SYNC_TIMEOUT
+        deadline = time.monotonic() + timeout
+        with self._ack_cond:
+            if seq is None:
+                seq = self._repl_seq
+            waiting = [f for f in self._repl_feeds if not f.closed]
+            while True:
+                if all(f.acked >= seq for f in waiting):
+                    return True
+                if any(f.closed and f.acked < seq for f in waiting):
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._ack_cond.wait(remaining)
 
     def _notify(self, events: list[Event]) -> None:
         # called under self._lock

@@ -39,8 +39,17 @@ class LocalCoord(CoordBackend):
     def __init__(self, state: CoordState | None = None):
         self.state = state or CoordState()
 
-    def put(self, key: str, value: str, lease: int = 0) -> int:
-        return self.state.put(key, value, lease)
+    def put(self, key: str, value: str, lease: int = 0,
+            sync: bool = False,
+            sync_timeout: float | None = None) -> int:
+        rev = self.state.put(key, value, lease)
+        if sync and not self.state.wait_replicated(timeout=sync_timeout):
+            from ptype_tpu.errors import CoordinationError
+
+            raise CoordinationError(
+                f"sync put {key!r}: replication not acknowledged in "
+                f"time (write IS applied on the primary)")
+        return rev
 
     def range(self, key: str, options: RangeOptions | None = None) -> RangeResult:
         return self.state.range(key, options)

@@ -466,7 +466,15 @@ class RemoteCoord(CoordBackend):
 
     # ------------------------------------------------------------------- KV
 
-    def put(self, key: str, value: str, lease: int = 0) -> int:
+    def put(self, key: str, value: str, lease: int = 0,
+            sync: bool = False,
+            sync_timeout: float | None = None) -> int:
+        if sync:
+            extra = {"sync": True}
+            if sync_timeout is not None:
+                extra["sync_timeout"] = sync_timeout
+            return self._call("put", key=key, value=value, lease=lease,
+                              **extra)
         return self._call("put", key=key, value=value, lease=lease)
 
     def range(self, key: str, options: RangeOptions | None = None) -> RangeResult:

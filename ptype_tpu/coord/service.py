@@ -126,6 +126,16 @@ class CoordServer:
                     msg = wire.recv_msg(conn)
                 except (wire.WireError, OSError):
                     return
+                if msg.get("op") == "repl_ack":
+                    # Unsolicited fire-and-forget from a WAL follower:
+                    # record the mirrored-through sequence for this
+                    # connection's feeds (wakes sync-put waiters). No
+                    # reply, no handler thread.
+                    with watches_lock:
+                        acked_feeds = list(feeds.values())
+                    for feed in acked_feeds:
+                        self.state.note_repl_ack(feed, int(msg["seq"]))
+                    continue
                 # Blocking ops (barrier, watch pumps) must not stall the
                 # reader; dispatch every request to its own thread — control
                 # plane volume is low enough that this is simpler and safer
@@ -227,7 +237,22 @@ class CoordServer:
     def _dispatch(self, conn, send_lock, watches, watches_lock, op: str, msg: dict):
         st = self.state
         if op == "put":
-            return st.put(msg["key"], msg["value"], msg.get("lease", 0))
+            rev = st.put(msg["key"], msg["value"], msg.get("lease", 0))
+            if msg.get("sync"):
+                # Synchronous replication (the raft-commit analog): ack
+                # only after every WAL follower attached at the barrier
+                # mirrored the write. Conservative: waits through the
+                # current sequence, which includes this record.
+                timeout = msg.get("sync_timeout")
+                if not st.wait_replicated(
+                        timeout=None if timeout is None
+                        else float(timeout)):
+                    raise RuntimeError(
+                        f"sync put {msg['key']!r}: replication not "
+                        f"acknowledged in time (write IS applied on "
+                        f"the primary; a failover before the mirror "
+                        f"catches up may lose it)")
+            return rev
         if op == "range":
             res = st.range(msg["key"], RangeOptions.from_wire(msg.get("options", {})))
             return {
@@ -300,7 +325,8 @@ class CoordServer:
             if not batch:
                 continue
             push = {"repl": feed.id,
-                    "items": [{"kind": k, "data": d} for k, d in batch]}
+                    "items": [{"kind": k, "data": d, "seq": s}
+                              for k, d, s in batch]}
             try:
                 wire.send_msg(conn, send_lock, push)
             except (wire.WireError, OSError):

@@ -72,11 +72,17 @@ class WalFollower:
 
     def __init__(self, primary_address: str, data_dir: str,
                  reconnect_delay: float = 0.5,
-                 connect_timeout: float = 2.0):
+                 connect_timeout: float = 2.0,
+                 fsync: bool = False):
         self.primary_address = primary_address
         self.data_dir = data_dir
         self.reconnect_delay = reconnect_delay
         self.connect_timeout = connect_timeout
+        #: fsync mirror writes before acknowledging them. Required in
+        #: wal_fsync deployments: a sync-put ack asserts the record is
+        #: DURABLE on this host, which a page-cache flush() is not
+        #: under power loss.
+        self._fsync = fsync
         self.synced = threading.Event()
         self._closed = threading.Event()
         self._sock: socket.socket | None = None
@@ -120,6 +126,7 @@ class WalFollower:
                 # the WAL underneath the new primary.
                 if self._closed.is_set():
                     return
+                last_seq = None
                 for item in msg.get("items", ()):
                     if item["kind"] == "snap":
                         wal = self._mirror_snapshot(item["data"], wal)
@@ -131,6 +138,18 @@ class WalFollower:
                         wal.write(json.dumps(
                             item["data"], separators=(",", ":")) + "\n")
                         wal.flush()
+                    if item.get("seq") is not None:
+                        last_seq = item["seq"]
+                if last_seq is not None:
+                    if self._fsync and wal is not None:
+                        # The ack asserts durability; in fsync
+                        # deployments flush-to-page-cache isn't it.
+                        os.fsync(wal.fileno())
+                    # Everything through last_seq is durable in the
+                    # mirror: acknowledge so the primary's sync-put
+                    # barrier (state.wait_replicated) can release.
+                    wire.send_msg(sock, lock,
+                                  {"op": "repl_ack", "seq": last_seq})
         finally:
             self._sock = None
             if wal is not None:
@@ -160,9 +179,14 @@ class WalFollower:
         wal.write(json.dumps({"o": "hdr", "gen": gen},
                              separators=(",", ":")) + "\n")
         wal.flush()
+        if self._fsync:
+            os.fsync(wal.fileno())
         tmp = os.path.join(self.data_dir, "coord.snap.tmp")
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.data_dir, "coord.snap"))
         return wal
 
@@ -275,7 +299,8 @@ class Standby:
             log.warning("follower re-arm deferred: old reader thread "
                         "still live")
             return
-        self.follower = WalFollower(self.primary_address, self.data_dir)
+        self.follower = WalFollower(self.primary_address, self.data_dir,
+                                    fsync=self._fsync)
 
     def _start_guarding(self) -> None:
         """(Re)arm everything a guarding standby needs: the probe

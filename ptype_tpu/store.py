@@ -134,9 +134,17 @@ class KVStore:
         opts = _resolve(options + (with_count_only(),))
         return self._coord.range(_store_key(key), opts).count
 
-    def put(self, key: str, value: str) -> None:
-        """Set the value for the given key (ref: store.go:56-62)."""
-        self._coord.put(_store_key(key), value)
+    def put(self, key: str, value: str, sync: bool = False,
+            sync_timeout: float | None = None) -> None:
+        """Set the value for the given key (ref: store.go:56-62).
+
+        ``sync=True`` acks only once every attached WAL follower has
+        mirrored the write — the raft-quorum-commit analog the
+        reference's Put had for free: an acked write then survives an
+        immediate primary death + standby takeover. Raises if not
+        acknowledged within ``sync_timeout`` (None = default 5 s)."""
+        self._coord.put(_store_key(key), value, sync=sync,
+                        sync_timeout=sync_timeout)
 
     def delete(self, key: str, *options: Option) -> None:
         """Delete key(s); raises NoKeyError when nothing was deleted

@@ -325,6 +325,92 @@ def test_discover_endpoints_merge_and_prune(coord_server):
         c.close()
 
 
+def test_sync_put_no_followers_is_immediate(coord_server):
+    """With nobody replicating there is nothing to wait for: sync put
+    degrades to a plain put (and the local backend agrees)."""
+    import time as _time
+
+    c = RemoteCoord(coord_server.address)
+    try:
+        t0 = _time.monotonic()
+        assert c.put("s", "1", sync=True) > 0
+        assert _time.monotonic() - t0 < 2.0
+    finally:
+        c.close()
+
+
+def _raw_subscriber(address):
+    """A replication follower that mirrors nothing and never acks."""
+    import socket as _socket
+
+    from ptype_tpu.coord import wire
+
+    host, _, port = address.rpartition(":")
+    sock = _socket.create_connection((host, int(port)), timeout=2.0)
+    lock = threading.Lock()
+    wire.send_msg(sock, lock, {"op": "repl_subscribe", "id": 1})
+    assert wire.recv_msg(sock)["ok"]
+    wire.recv_msg(sock)  # drain the snapshot push
+    return sock
+
+
+def test_sync_put_times_out_on_unacking_follower(coord_server):
+    """A follower that mirrors nothing (wedged) must fail the sync
+    barrier with a loud error, honoring the caller's sync_timeout —
+    while the write itself stays applied on the primary."""
+    import time as _time
+
+    sock = _raw_subscriber(coord_server.address)
+    c = RemoteCoord(coord_server.address)
+    try:
+        t0 = _time.monotonic()
+        with pytest.raises(CoordinationError,
+                           match="replication not acknowledged"):
+            c.put("s2", "v", sync=True, sync_timeout=0.5)
+        assert _time.monotonic() - t0 < 3.0  # the knob was honored
+    finally:
+        c.close()
+        sock.close()
+    # Applied locally despite the failed barrier.
+    assert coord_server.state.range("s2").items[0].value == "v"
+
+
+def test_sync_put_fails_fast_when_follower_dies_mid_barrier(
+        coord_server):
+    """A follower that DISCONNECTS while a sync put is blocked on it
+    must fail the barrier immediately — "success because the witness
+    vanished" would ack a write the mirror never got, the exact silent
+    loss sync puts exist to prevent."""
+    import time as _time
+
+    sock = _raw_subscriber(coord_server.address)
+    c = RemoteCoord(coord_server.address)
+    try:
+        result = {}
+
+        def put():
+            t0 = _time.monotonic()
+            try:
+                c.put("s3", "v", sync=True, sync_timeout=20.0)
+                result["outcome"] = "acked"
+            except CoordinationError as e:
+                result["outcome"] = str(e)
+            result["dt"] = _time.monotonic() - t0
+
+        t = threading.Thread(target=put)
+        t.start()
+        time.sleep(0.5)  # let the put reach the barrier
+        sock.close()  # the follower dies un-acked
+        t.join(timeout=10)
+        assert not t.is_alive(), "sync put never returned"
+        assert "replication not acknowledged" in result["outcome"], (
+            f"barrier passed despite the follower dying: {result}")
+        assert result["dt"] < 10.0, (
+            f"did not fail fast on follower death: {result}")
+    finally:
+        c.close()
+
+
 def test_remote_error_propagates(coord_server):
     c = RemoteCoord(coord_server.address)
     try:

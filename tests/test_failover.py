@@ -777,6 +777,50 @@ def test_operator_promote_refuses_unsynced_mirror_unless_forced(
         standby.close()
 
 
+def test_sync_put_survives_immediate_failover(tmp_path, free_port_pair):
+    """put(sync=True) acks only after the WAL follower has mirrored
+    the record (the raft-commit analog): an acked sync write followed
+    IMMEDIATELY by primary SIGKILL must appear on the promoted standby
+    — streaming lag can never lose it. (A plain async put has no such
+    guarantee; that's the documented difference.)"""
+    primary_addr, standby_addr = free_port_pair
+    seed = _start_seed(primary_addr, str(tmp_path / "p"))
+    standby = Standby(primary_addr, standby_addr, str(tmp_path / "s"),
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0, request_timeout=10.0)
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        coord.put("store/acked", "must-survive", sync=True)
+        # No settling sleep — the kill races the stream ON PURPOSE;
+        # the sync ack is the only thing standing between this write
+        # and the WAL-streaming lag.
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=10)
+
+        deadline = time.monotonic() + 15
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                res = coord.range("store/acked")
+                val = res.items[0].value if res.items else None
+                if val == "must-survive":
+                    break
+            except CoordinationError:
+                pass
+            time.sleep(0.1)
+        assert val == "must-survive", (
+            f"acked sync write lost across failover: {val!r}")
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
 def test_two_standbys_deterministic_succession(tmp_path):
     """Two wal-stream standbys guarding ONE primary (easy to reach now
     that standbys attach dynamically) must not both promote on its
