@@ -26,6 +26,11 @@ tpu-smoke:
 # generate), then the headline bench JSON line.
 tpu-validate: tpu-smoke bench
 
+# PERF.md refresh rows (headline, S=8192, decode, store-vs-gspmd) as
+# a markdown table; exit 42 when no TPU (use --smoke off-TPU).
+tpu-sweep:
+	python tools/tpu_sweep.py || test $$? -eq 42
+
 # Real static analysis (reference bar: golangci-lint, .golangci.yml):
 # ruff when available, else the stdlib-only checker in tools/lint.py
 # (unused imports, undefined names via symtable, mutable defaults,

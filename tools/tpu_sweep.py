@@ -1,0 +1,193 @@
+"""One-command TPU perf refresh — run when the tunnel is back.
+
+Measures the rows docs/PERF.md needs re-validated after an outage and
+prints them as a markdown table (plus one JSON line per row for
+machine use). Each measurement is independently fault-isolated and
+bounded, so a partial failure still yields the other rows.
+
+Usage (from the repo root; PYTHONPATH must keep the TPU plugin path):
+    PYTHONPATH=/root/repo:/root/.axon_site python tools/tpu_sweep.py
+
+Measurement gotcha this script honors: ``jax.block_until_ready`` does
+NOT drain the axon device tunnel — every timed section forces a scalar
+readback (``float(...)``) before and after the clock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROWS: list[dict] = []
+#: --smoke: tiny shapes on whatever backend is present — validates the
+#: script end to end without a TPU (rows are NOT perf numbers).
+SMOKE = "--smoke" in sys.argv
+
+
+def row(name: str, fn) -> None:
+    t0 = time.time()
+    try:
+        rec = fn()
+        rec["row"] = name
+        rec["wall_s"] = round(time.time() - t0, 1)
+        ROWS.append(rec)
+        print(json.dumps(rec), flush=True)
+    except Exception:  # noqa: BLE001 — isolate rows
+        err = traceback.format_exc(limit=3).strip().splitlines()[-1]
+        ROWS.append({"row": name, "error": err[-200:]})
+        print(json.dumps(ROWS[-1]), flush=True)
+
+
+def _train_tps(cfg, batch, seq, steps=30, warmup=3):
+    import jax
+
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.trainer import Trainer
+
+    devices = jax.devices()
+    mesh = build_mesh({"data": len(devices)}, devices=devices)
+    trainer = Trainer(cfg, mesh, sync_every=0)
+    stream = synthetic_batches(cfg.vocab_size, batch, seq)
+    for _ in range(warmup):
+        out = trainer.step(next(stream))
+    float(out["loss"])  # drain the tunnel, not just the dispatch queue
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = trainer.step(next(stream))
+    final_loss = float(out["loss"])  # forces the full queue through
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt, final_loss, len(devices)
+
+
+def headline():
+    from ptype_tpu.metrics import device_peak_tflops, mfu as mfu_of
+    from ptype_tpu.models import transformer as tfm
+    import jax
+
+    if SMOKE:
+        cfg = tfm.preset("tiny", attn_impl="xla")
+        tps, loss, n = _train_tps(cfg, batch=2 * len(jax.devices()),
+                                  seq=128, steps=3, warmup=1)
+        seq = 128
+    else:
+        cfg = tfm.preset("optimus-125m", remat=True,
+                         remat_policy="dots", attn_impl="flash")
+        tps, loss, n = _train_tps(cfg, batch=16, seq=1024)
+        seq = 1024
+    m = mfu_of(tps, tfm.flops_per_token(cfg, seq), n,
+               device_peak_tflops(jax.devices()[0]))
+    return {"tok_s_chip": round(tps / n, 1), "mfu": round(m, 4),
+            "loss": round(loss, 3)}
+
+
+def long_context():
+    from ptype_tpu.metrics import device_peak_tflops, mfu as mfu_of
+    from ptype_tpu.models import transformer as tfm
+    import jax
+
+    if SMOKE:
+        cfg = tfm.preset("tiny", attn_impl="xla", max_seq=512)
+        tps, loss, n = _train_tps(cfg, batch=len(jax.devices()),
+                                  seq=512, steps=2, warmup=1)
+        seq = 512
+    else:
+        cfg = tfm.preset("optimus-125m", remat=True,
+                         remat_policy="dots", attn_impl="flash",
+                         max_seq=8192)
+        tps, loss, n = _train_tps(cfg, batch=2, seq=8192, steps=10)
+        seq = 8192
+    m = mfu_of(tps, tfm.flops_per_token(cfg, seq), n,
+               device_peak_tflops(jax.devices()[0]))
+    return {"tok_s_chip": round(tps / n, 1), "mfu": round(m, 4),
+            "loss": round(loss, 3)}
+
+
+def decode():
+    import jax
+    import jax.numpy as jnp
+
+    from ptype_tpu.models import generate as gen
+    from ptype_tpu.models import transformer as tfm
+
+    cfg = tfm.preset("tiny" if SMOKE else "optimus-125m",
+                     attn_impl="xla")
+    params = jax.jit(lambda r: tfm.init_params(r, cfg))(
+        jax.random.PRNGKey(0))
+    B, new = (2, 8) if SMOKE else (8, 64)
+    prompts = jnp.zeros((B, 16), jnp.int32)
+    toks = gen.generate(params, cfg, prompts, max_new_tokens=new)
+    int(toks[0, -1])  # compile + drain
+    t0 = time.perf_counter()
+    toks = gen.generate(params, cfg, prompts, max_new_tokens=new)
+    int(toks[0, -1])
+    dt = time.perf_counter() - t0
+    return {"decode_tok_s": round(B * new / dt, 1), "batch": B,
+            "new_tokens": new}
+
+
+def store_vs_gspmd():
+    import jax
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import TensorStore
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer
+
+    import jax as _jax
+
+    B, S, steps = ((2 * len(_jax.devices()), 64, 2) if SMOKE
+                   else (8, 512, 10))
+    cfg = tfm.preset("tiny" if SMOKE else "optimus-125m",
+                     attn_impl="xla")
+    g_tps, _, n = _train_tps(cfg, batch=B, seq=S, steps=steps,
+                             warmup=1 if SMOKE else 3)
+
+    devices = jax.devices()
+    mesh = build_mesh({"data": len(devices)}, devices=devices)
+    st = StoreDPTrainer(cfg, TensorStore(mesh))
+    stream = synthetic_batches(cfg.vocab_size, B, S)
+    for _ in range(1 if SMOKE else 3):
+        st.step(next(stream))  # store step blocks itself (loss float)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st.step(next(stream))
+    dt = time.perf_counter() - t0
+    s_tps = B * S * steps / dt
+    return {"gspmd_tok_s": round(g_tps, 1),
+            "store_tok_s": round(s_tps, 1),
+            "ratio": round(s_tps / g_tps, 3), "n_chips": n}
+
+
+def main() -> int:
+    import jax
+
+    if jax.devices()[0].platform != "tpu" and not SMOKE:
+        print("no TPU attached; refusing to record CPU numbers as a "
+              "TPU sweep (use --smoke to validate the plumbing)",
+              file=sys.stderr)
+        return 42
+    kind = jax.devices()[0].device_kind
+    row("headline b16 S1024 flash+dots", headline)
+    row("long-context S8192", long_context)
+    row("kv-cache decode 125m", decode)
+    row("store vs gspmd (S512 b8)", store_vs_gspmd)
+
+    print(f"\n## TPU sweep ({kind}, {time.strftime('%Y-%m-%d %H:%MZ', time.gmtime())})\n")
+    print("| row | result |")
+    print("|---|---|")
+    for r in ROWS:
+        body = {k: v for k, v in r.items() if k not in ("row",)}
+        print(f"| {r['row']} | `{json.dumps(body)}` |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
