@@ -15,7 +15,9 @@ and train. Three modes:
 
 Env knobs: PRESET (optimus-125m), STEPS, BATCH, SEQ, MODE,
 LR/WARMUP/WEIGHT_DECAY/DECAY_STEPS (optimizer), METRICS_PATH (JSONL sink),
-COMPRESS (store mode: bf16|int8 gradient-push wire compression).
+COMPRESS (store mode: bf16|int8 gradient-push wire compression),
+SHARD_UPDATE=1 (gspmd mode: ZeRO-1 weight-update sharding — Adam
+moments shard over the data axis, 1/N optimizer HBM, same math).
 """
 
 from __future__ import annotations
@@ -67,7 +69,12 @@ def main() -> None:
         if mode == "gspmd":
             from ptype_tpu.train.trainer import Trainer
 
-            trainer = Trainer(model_cfg, mesh, optimizer=optimizer)
+            # SHARD_UPDATE=1: ZeRO-1 cross-replica weight-update
+            # sharding — Adam moments shard over the data axis (1/N
+            # optimizer HBM), params stay replicated, same math.
+            trainer = Trainer(
+                model_cfg, mesh, optimizer=optimizer,
+                shard_update=os.environ.get("SHARD_UPDATE") == "1")
             print(f"params: {trainer.n_params/1e6:.1f}M", flush=True)
             # CKPT_DIR enables save/resume: restart the process with the
             # same dir and training continues from the latest complete

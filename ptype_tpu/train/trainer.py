@@ -102,7 +102,24 @@ def _path_key(path) -> tuple[str, ...]:
     return tuple(out)
 
 
-def opt_state_shardings(opt_shape, params_shape, param_sh_tree, repl):
+def _shard_update_spec(spec: P, shape: tuple, axis: str,
+                       size: int) -> P:
+    """Add ``axis`` onto the first unsharded, divisible dim of an
+    optimizer-moment spec — cross-replica weight-update sharding
+    (ZeRO-1; "Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training", PAPERS.md). Annotation is the whole
+    implementation: GSPMD lowers the moment update to reduce-scatter +
+    sharded update + all-gather on its own."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (e, d) in enumerate(zip(entries, shape)):
+        if e is None and d >= size and d % size == 0:
+            entries[i] = axis
+            return P(*entries)
+    return spec
+
+
+def opt_state_shardings(opt_shape, params_shape, param_sh_tree, repl,
+                        shard_update_axis: str | None = None):
     """Sharding for every optimizer-state leaf.
 
     Optax moment trees (adam mu/nu, …) mirror the params tree inside a
@@ -111,6 +128,11 @@ def opt_state_shardings(opt_shape, params_shape, param_sh_tree, repl):
     shape check — never by shape alone, where two unrelated leaves that
     happen to share a shape would silently swap shardings. Unmatched
     leaves (step counts, schedule scalars) replicate.
+
+    ``shard_update_axis``: additionally shard each matched moment over
+    that (data-parallel) axis — 1/N optimizer memory per device while
+    the PARAMS stay replicated (the plain-DP memory win; the fsdp axis
+    already shards moments by construction).
     """
     param_map: dict[tuple[str, ...], tuple[tuple, Any]] = {}
     flat_p = jax.tree_util.tree_flatten_with_path(params_shape)[0]
@@ -124,14 +146,22 @@ def opt_state_shardings(opt_shape, params_shape, param_sh_tree, repl):
         for i in range(len(key)):
             hit = param_map.get(key[i:])
             if hit is not None and hit[0] == tuple(leaf.shape):
-                return hit[1]
+                sh = hit[1]
+                if shard_update_axis:
+                    mesh = sh.mesh
+                    size = int(mesh.shape[shard_update_axis])
+                    spec = _shard_update_spec(
+                        sh.spec, hit[0], shard_update_axis, size)
+                    if spec != sh.spec:
+                        return NamedSharding(mesh, spec)
+                return sh
         return repl
 
     return jax.tree_util.tree_map_with_path(match, opt_shape)
 
 
 def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
-                     optimizer) -> TrainState:
+                     optimizer, shard_update: bool = False) -> TrainState:
     """Sharding pytree for TrainState: optax mirrors param specs."""
     axis_sizes = {n: int(mesh.shape[n]) for n in mesh.axis_names}
     pspecs = tfm.param_specs(cfg, axis_sizes)
@@ -142,18 +172,29 @@ def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
     params_shape = jax.eval_shape(lambda: tfm.init_params(
         jax.random.PRNGKey(0), cfg))
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    upd_axis = ("data" if (shard_update and "data" in axis_sizes
+                           and axis_sizes["data"] > 1) else None)
+    if shard_update and upd_axis is None:
+        from ptype_tpu import logs
+
+        logs.get_logger("train").warning(
+            "shard_update requested but the mesh has no data axis of "
+            "size > 1 — optimizer moments stay unsharded",
+            kv={"axes": axis_sizes})
     opt_sh = opt_state_shardings(opt_shape, params_shape, param_sh,
-                                 to_ns(P()))
+                                 to_ns(P()),
+                                 shard_update_axis=upd_axis)
     return TrainState(param_sh, opt_sh, to_ns(P()))
 
 
 def init_state(rng: jax.Array, cfg: tfm.TransformerConfig, mesh: Mesh,
-               optimizer=None) -> tuple[TrainState, TrainState]:
+               optimizer=None,
+               shard_update: bool = False) -> tuple[TrainState, TrainState]:
     """Initialize a sharded TrainState ON DEVICE: init is jit'd with
     out_shardings so even 8B params never materialize unsharded.
     Returns (state, state_shardings)."""
     optimizer = optimizer or default_optimizer()
-    shardings = _state_shardings(mesh, cfg, optimizer)
+    shardings = _state_shardings(mesh, cfg, optimizer, shard_update)
     state = jax.jit(
         lambda r: _init_impl(r, cfg, optimizer),
         out_shardings=shardings,
@@ -171,7 +212,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     optimizer=None, attn_fn: Callable | None = None,
                     seq_axis: bool = False,
                     batch_keys: tuple[str, ...] = ("tokens", "targets"),
-                    grad_accum: int = 1):
+                    grad_accum: int = 1,
+                    shard_update: bool = False):
     """Compile the train step: (state, batch) → (state, metrics).
 
     State buffers are donated (in-place update, no HBM copy). Batch comes
@@ -184,7 +226,7 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     """
     optimizer = optimizer or default_optimizer()
     axis_sizes = {n: int(mesh.shape[n]) for n in mesh.axis_names}
-    state_sh = _state_shardings(mesh, cfg, optimizer)
+    state_sh = _state_shardings(mesh, cfg, optimizer, shard_update)
     batch_sh = NamedSharding(mesh, tfm.batch_spec(axis_sizes, seq_axis))
     batch_shardings = {k: batch_sh for k in batch_keys}
     repl = NamedSharding(mesh, P())
@@ -323,7 +365,8 @@ class Trainer:
     def __init__(self, cfg: tfm.TransformerConfig, mesh: Mesh,
                  optimizer=None, rng: jax.Array | None = None,
                  attn_fn=None, seq_axis: bool = False,
-                 sync_every: int = 16):
+                 sync_every: int = 16,
+                 shard_update: bool = False):
         from ptype_tpu.metrics import StepStats, device_peak_tflops
 
         self.cfg = cfg
@@ -336,8 +379,12 @@ class Trainer:
             seq_axis = True
         self._seq_axis = seq_axis
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        #: Cross-replica weight-update sharding (ZeRO-1): optimizer
+        #: moments shard over the data axis while params stay
+        #: replicated — 1/N optimizer HBM on plain-DP meshes.
+        self._shard_update = shard_update
         self.state, self.state_shardings = init_state(
-            rng, cfg, mesh, self.optimizer
+            rng, cfg, mesh, self.optimizer, shard_update=shard_update
         )
         # Compiled steps keyed by the batch's key set (tokens/targets
         # always; loss_mask when the data provides one).
@@ -362,7 +409,8 @@ class Trainer:
         if fn is None:
             fn = make_train_step(self.cfg, self.mesh, self.optimizer,
                                  self._attn_fn, self._seq_axis,
-                                 batch_keys=keys)
+                                 batch_keys=keys,
+                                 shard_update=self._shard_update)
             self._steps[keys] = fn
         return fn
 
