@@ -20,11 +20,15 @@ class CoordBackend(abc.ABC):
     # barrier mirrored the write (the raft-commit analog;
     # coord/core.wait_replicated) — raises if replication is not
     # acknowledged within sync_timeout (None = the shared
-    # DEFAULT_SYNC_TIMEOUT).
+    # DEFAULT_SYNC_TIMEOUT). sync_min_followers>0 additionally fails
+    # the put when fewer live followers are attached — otherwise a
+    # zero-follower window (mirror reconnecting) degrades to an
+    # indistinguishable unreplicated ack.
     @abc.abstractmethod
     def put(self, key: str, value: str, lease: int = 0,
             sync: bool = False,
-            sync_timeout: float | None = None) -> int: ...
+            sync_timeout: float | None = None,
+            sync_min_followers: int = 0) -> int: ...
 
     @abc.abstractmethod
     def range(self, key: str, options: RangeOptions | None = None) -> RangeResult: ...

@@ -27,6 +27,20 @@ from ptype_tpu.errors import CoordinationError
 
 log = logs.get_logger("coord")
 
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-``os.replace``d entry survives host
+    power loss — the rename lives in the directory's metadata, not in
+    the file that was renamed (etcd fsyncs the dir on snapshot rename;
+    without this the wal_fsync durability claim is overstated)."""
+    import os
+
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
 #: One default for the sync-put replication barrier everywhere (wire
 #: dispatch, LocalCoord, the backend API) — three hardcoded copies
 #: would drift.
@@ -495,6 +509,8 @@ class CoordState:
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, self._snap_path())
+        if self._fsync:
+            fsync_dir(self._data_dir)
         # Crash here leaves the new snapshot with the OLD-generation
         # WAL — replay sees the header mismatch and skips it (those
         # records are already folded into the snapshot).
@@ -804,7 +820,8 @@ class CoordState:
                 self._ack_cond.notify_all()
 
     def wait_replicated(self, seq: int | None = None,
-                        timeout: float | None = None) -> bool:
+                        timeout: float | None = None,
+                        min_followers: int = 0) -> bool:
         """Block until every replication follower that was attached AT
         BARRIER START has acknowledged mirroring through ``seq``
         (default: everything so far) — the sync-put barrier, the
@@ -815,23 +832,56 @@ class CoordState:
         mirror may not hold the record, and "success because the
         witness vanished" is exactly the silent loss this feature
         exists to prevent. False on timeout/death: the mutation IS
-        applied locally; only the replication guarantee is unmet."""
+        applied locally; only the replication guarantee is unmet.
+
+        ``min_followers``: RAISE (rather than trivially succeed) when
+        fewer than this many live followers are attached at barrier
+        start — the zero-follower windows (follower reconnect after a
+        drop, post-overflow re-sync) are exactly when a deployment
+        that RUNS a standby must not get an indistinguishable
+        unreplicated ack. The refusal is a distinct error (not the
+        timeout's False): the record is definitely unreplicated and
+        the mirror is DOWN, which an operator debugs differently from
+        a slow mirror. Degraded acks with min_followers unset are
+        logged (rate-limited) so they are at least observable."""
         if timeout is None:
             timeout = DEFAULT_SYNC_TIMEOUT
         deadline = time.monotonic() + timeout
+        degraded = False
         with self._ack_cond:
             if seq is None:
                 seq = self._repl_seq
             waiting = [f for f in self._repl_feeds if not f.closed]
+            if len(waiting) < min_followers:
+                raise CoordinationError(
+                    f"sync barrier refused: {len(waiting)} live "
+                    f"follower(s) attached, {min_followers} required "
+                    f"(record is NOT replicated; the standby is down "
+                    f"or mid-reconnect)")
+            degraded = not waiting
+            ok = False
             while True:
                 if all(f.acked >= seq for f in waiting):
-                    return True
+                    ok = True
+                    break
                 if any(f.closed and f.acked < seq for f in waiting):
-                    return False
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return False
+                    break
                 self._ack_cond.wait(remaining)
+        if degraded:
+            # Outside the lock (a stalling log sink must not serialize
+            # the whole coordinator) and rate-limited (a standby-less
+            # deployment sync-putting in a loop would emit thousands).
+            now = time.monotonic()
+            if now - getattr(self, "_degraded_log_t", 0.0) > 10.0:
+                self._degraded_log_t = now
+                log.warning(
+                    "sync put acked with ZERO followers attached "
+                    "(unreplicated; set sync_min_followers to fail "
+                    "instead)", kv={"seq": seq})
+        return ok
 
     def _notify(self, events: list[Event]) -> None:
         # called under self._lock

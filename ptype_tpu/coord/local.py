@@ -41,9 +41,15 @@ class LocalCoord(CoordBackend):
 
     def put(self, key: str, value: str, lease: int = 0,
             sync: bool = False,
-            sync_timeout: float | None = None) -> int:
+            sync_timeout: float | None = None,
+            sync_min_followers: int = 0) -> int:
+        if sync_min_followers and not sync:
+            raise ValueError(
+                "sync_min_followers requires sync=True — without the "
+                "barrier the floor would be silently ignored")
         rev = self.state.put(key, value, lease)
-        if sync and not self.state.wait_replicated(timeout=sync_timeout):
+        if sync and not self.state.wait_replicated(
+                timeout=sync_timeout, min_followers=sync_min_followers):
             from ptype_tpu.errors import CoordinationError
 
             raise CoordinationError(

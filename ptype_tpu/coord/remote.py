@@ -103,6 +103,11 @@ class RemoteCoord(CoordBackend):
         #: (discovered standbys come and go; the static list is the
         #: operator's contract).
         self._seed_endpoints = list(eps)
+        #: Guards endpoints/address against the discovery thread: a
+        #: remove() between _dial's membership check and .index(), or
+        #: between a len() and the modular index, would raise out of
+        #: the reader's reconnect path. Created before the first _dial.
+        self._endpoints_lock = threading.Lock()
         self.address = eps[0]
         self._dial_timeout = dial_timeout
         self._request_timeout = request_timeout
@@ -156,12 +161,16 @@ class RemoteCoord(CoordBackend):
 
     def _dial(self) -> socket.socket:
         """Dial the endpoint list in order, starting at the currently
-        active one; first success wins and becomes ``self.address``."""
-        start = (self.endpoints.index(self.address)
-                 if self.address in self.endpoints else 0)
+        active one; first success wins and becomes ``self.address``.
+        Works off a snapshot so concurrent discovery churn can't shift
+        indices mid-iteration."""
+        with self._endpoints_lock:
+            eps = list(self.endpoints)
+            addr = self.address
+        start = eps.index(addr) if addr in eps else 0
         last: OSError | None = None
-        for i in range(len(self.endpoints)):
-            ep = self.endpoints[(start + i) % len(self.endpoints)]
+        for i in range(len(eps)):
+            ep = eps[(start + i) % len(eps)]
             host, _, port = ep.rpartition(":")
             try:
                 sock = socket.create_connection(
@@ -179,43 +188,54 @@ class RemoteCoord(CoordBackend):
                 continue
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self.address = ep
+            # Under the lock: _bounce_endpoint's single-advance guard
+            # and discovery's keep-current-address prune both read
+            # address under it — an unlocked write here could let a
+            # stale-reply bounce shut down this fresh connection.
+            with self._endpoints_lock:
+                self.address = ep
             return sock
         raise last or OSError("no endpoints")
 
     def _read_loop(self) -> None:
-        while not self._closed.is_set():
-            try:
-                msg = wire.recv_msg(self._sock)
-            except (wire.WireError, OSError):
-                # Connection lost: fail outstanding requests (their
-                # callers retry — registry keepalive, balancer), mark
-                # every watch dis-armed, and try to reach a coordinator
-                # again (seed restarting from its WAL, or a standby
-                # taking over). Deliberate close() skips the re-dial.
-                self._connected.clear()
-                self._fail_pending()
-                with self._watches_lock:
-                    for w in self._watches.values():
-                        w._armed = False
-                if self._closed.is_set() or not self._try_reconnect():
-                    break
-                continue
-            if "watch" in msg and "id" not in msg:
-                self._dispatch_watch(msg)
-                continue
-            with self._pending_lock:
-                p = self._pending.pop(msg.get("id"), None)
-            if p is not None:
-                p.reply = msg
-                p.event.set()
-        # Giving up for good: fail everything outstanding.
-        self._closed.set()
-        self._fail_pending()
-        with self._watches_lock:
-            watches, self._watches = list(self._watches.values()), {}
-        for w in watches:
-            w.cancel()
+        try:
+            while not self._closed.is_set():
+                try:
+                    msg = wire.recv_msg(self._sock)
+                except (wire.WireError, OSError):
+                    # Connection lost: fail outstanding requests (their
+                    # callers retry — registry keepalive, balancer),
+                    # mark every watch dis-armed, and try to reach a
+                    # coordinator again (seed restarting from its WAL,
+                    # or a standby taking over). Deliberate close()
+                    # skips the re-dial.
+                    self._connected.clear()
+                    self._fail_pending()
+                    with self._watches_lock:
+                        for w in self._watches.values():
+                            w._armed = False
+                    if self._closed.is_set() or not self._try_reconnect():
+                        break
+                    continue
+                if "watch" in msg and "id" not in msg:
+                    self._dispatch_watch(msg)
+                    continue
+                with self._pending_lock:
+                    p = self._pending.pop(msg.get("id"), None)
+                if p is not None:
+                    p.reply = msg
+                    p.event.set()
+        finally:
+            # Giving up for good — including via an UNEXPECTED
+            # exception: the cleanup must still run, or the client is
+            # left half-alive (reader dead, _closed unset, every
+            # future call burning its full timeout on a dead socket).
+            self._closed.set()
+            self._fail_pending()
+            with self._watches_lock:
+                watches, self._watches = list(self._watches.values()), {}
+            for w in watches:
+                w.cancel()
 
     def _fail_pending(self, keep_sock=None) -> None:
         """Fail outstanding requests. ``keep_sock``: spare requests
@@ -387,14 +407,15 @@ class RemoteCoord(CoordBackend):
         socket to trigger the reconnect loop. Concurrent callers whose
         stale replies came from the same endpoint bounce it ONCE — a
         double advance could skip straight past the current primary."""
-        if stale_ep is not None and self.address != stale_ep:
-            return  # another caller (or the reader) already moved on
-        try:
-            idx = self.endpoints.index(self.address)
-        except ValueError:
-            idx = -1
-        stale_ep = self.address
-        self.address = self.endpoints[(idx + 1) % len(self.endpoints)]
+        with self._endpoints_lock:
+            if stale_ep is not None and self.address != stale_ep:
+                return  # another caller (or the reader) already moved on
+            try:
+                idx = self.endpoints.index(self.address)
+            except ValueError:
+                idx = -1
+            stale_ep = self.address
+            self.address = self.endpoints[(idx + 1) % len(self.endpoints)]
         self._connected.clear()
         log.info("abandoning superseded coordinator",
                  kv={"stale": stale_ep, "next": self.address,
@@ -468,11 +489,18 @@ class RemoteCoord(CoordBackend):
 
     def put(self, key: str, value: str, lease: int = 0,
             sync: bool = False,
-            sync_timeout: float | None = None) -> int:
+            sync_timeout: float | None = None,
+            sync_min_followers: int = 0) -> int:
+        if sync_min_followers and not sync:
+            raise ValueError(
+                "sync_min_followers requires sync=True — without the "
+                "barrier the floor would be silently ignored")
         if sync:
             extra = {"sync": True}
             if sync_timeout is not None:
                 extra["sync_timeout"] = sync_timeout
+            if sync_min_followers:
+                extra["sync_min_followers"] = sync_min_followers
             return self._call("put", key=key, value=value, lease=lease,
                               **extra)
         return self._call("put", key=key, value=value, lease=lease)
@@ -541,27 +569,37 @@ class RemoteCoord(CoordBackend):
         ref: learner add→promote, cluster.go:120-147). Learners are
         skipped: failing over to a standby whose mirror never caught up
         would serve stale or empty state."""
+        members = self.member_list()  # network call: outside the lock
         eligible = set()
-        for m in self.member_list():
+        added, pruned = [], []
+        for m in members:
             md = m.metadata or {}
             if (md.get("role") == "standby"
                     and md.get("learner", True) is False and m.peer_addr):
                 eligible.add(m.peer_addr)
-                if m.peer_addr not in self.endpoints:
-                    self.endpoints.append(m.peer_addr)
-                    log.info("discovered standby endpoint",
-                             kv={"addr": m.peer_addr})
-        # Reconcile removals: a decommissioned standby (Standby.close
-        # deregisters it) must not linger as a dead dial target — each
-        # stale entry can burn a full dial_timeout per reconnect cycle.
-        # Configured seeds and the endpoint currently in use are kept.
-        for addr in list(self.endpoints):
-            if (addr not in eligible and addr not in self._seed_endpoints
-                    and addr != self.address):
-                self.endpoints.remove(addr)
-                log.info("pruned decommissioned standby endpoint",
-                         kv={"addr": addr})
-        return list(self.endpoints)
+        with self._endpoints_lock:
+            for addr in eligible:
+                if addr not in self.endpoints:
+                    self.endpoints.append(addr)
+                    added.append(addr)
+            # Reconcile removals: a decommissioned standby
+            # (Standby.close deregisters it) must not linger as a dead
+            # dial target — each stale entry can burn a full
+            # dial_timeout per reconnect cycle. Configured seeds and
+            # the endpoint currently in use are kept.
+            for addr in list(self.endpoints):
+                if (addr not in eligible
+                        and addr not in self._seed_endpoints
+                        and addr != self.address):
+                    self.endpoints.remove(addr)
+                    pruned.append(addr)
+            out = list(self.endpoints)
+        for addr in added:
+            log.info("discovered standby endpoint", kv={"addr": addr})
+        for addr in pruned:
+            log.info("pruned decommissioned standby endpoint",
+                     kv={"addr": addr})
+        return out
 
     def _discovery_loop(self, interval: float) -> None:
         while not self._closed.wait(interval):

@@ -128,11 +128,20 @@ class CoordServer:
                     return
                 if msg.get("op") == "repl_ack":
                     # Unsolicited fire-and-forget from a WAL follower:
-                    # record the mirrored-through sequence for this
-                    # connection's feeds (wakes sync-put waiters). No
-                    # reply, no handler thread.
+                    # record the mirrored-through sequence (wakes
+                    # sync-put waiters). Routed by feed id — the
+                    # protocol permits several repl_subscribe feeds per
+                    # connection, and crediting them all would let one
+                    # feed's acks falsely release barriers for records
+                    # a slower sibling never mirrored. No reply, no
+                    # handler thread.
+                    fid = msg.get("feed")
                     with watches_lock:
-                        acked_feeds = list(feeds.values())
+                        if fid is not None:
+                            acked_feeds = ([feeds[fid]]
+                                           if fid in feeds else [])
+                        else:  # legacy follower: sole-feed conns only
+                            acked_feeds = list(feeds.values())
                     for feed in acked_feeds:
                         self.state.note_repl_ack(feed, int(msg["seq"]))
                     continue
@@ -246,7 +255,9 @@ class CoordServer:
                 timeout = msg.get("sync_timeout")
                 if not st.wait_replicated(
                         timeout=None if timeout is None
-                        else float(timeout)):
+                        else float(timeout),
+                        min_followers=int(
+                            msg.get("sync_min_followers", 0))):
                     raise RuntimeError(
                         f"sync put {msg['key']!r}: replication not "
                         f"acknowledged in time (write IS applied on "

@@ -50,6 +50,7 @@ import threading
 
 from ptype_tpu import logs
 from ptype_tpu.coord import wire
+from ptype_tpu.coord.core import fsync_dir
 from ptype_tpu.coord.service import CoordServer
 
 log = logs.get_logger("coord.standby")
@@ -114,6 +115,9 @@ class WalFollower:
             if not reply.get("ok"):
                 raise wire.WireError(
                     f"repl_subscribe refused: {reply.get('error')}")
+            # Our feed id: stamped on every ack so the primary credits
+            # exactly this feed (a connection may carry several).
+            feed_id = reply.get("result")
             # Stream forever; recv blocks until the primary pushes (the
             # pump batches). Timeout only guards the handshake — a
             # quiet-but-alive primary must not look dead here.
@@ -149,7 +153,8 @@ class WalFollower:
                     # mirror: acknowledge so the primary's sync-put
                     # barrier (state.wait_replicated) can release.
                     wire.send_msg(sock, lock,
-                                  {"op": "repl_ack", "seq": last_seq})
+                                  {"op": "repl_ack", "seq": last_seq,
+                                   "feed": feed_id})
         finally:
             self._sock = None
             if wal is not None:
@@ -188,6 +193,10 @@ class WalFollower:
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.data_dir, "coord.snap"))
+        if self._fsync:
+            # The rename itself lives in the directory entry; without
+            # this the mirrored snapshot can vanish on power loss.
+            fsync_dir(self.data_dir)
         return wal
 
     @property

@@ -135,16 +135,22 @@ class KVStore:
         return self._coord.range(_store_key(key), opts).count
 
     def put(self, key: str, value: str, sync: bool = False,
-            sync_timeout: float | None = None) -> None:
+            sync_timeout: float | None = None,
+            sync_min_followers: int = 0) -> None:
         """Set the value for the given key (ref: store.go:56-62).
 
         ``sync=True`` acks only once every attached WAL follower has
         mirrored the write — the raft-quorum-commit analog the
         reference's Put had for free: an acked write then survives an
         immediate primary death + standby takeover. Raises if not
-        acknowledged within ``sync_timeout`` (None = default 5 s)."""
+        acknowledged within ``sync_timeout`` (None = default 5 s).
+        ``sync_min_followers`` makes the put FAIL when fewer live
+        mirrors are attached (e.g. the standby is mid-reconnect) —
+        deployments that run a standby should set 1 so a degraded
+        unreplicated ack can't masquerade as a replicated one."""
         self._coord.put(_store_key(key), value, sync=sync,
-                        sync_timeout=sync_timeout)
+                        sync_timeout=sync_timeout,
+                        sync_min_followers=sync_min_followers)
 
     def delete(self, key: str, *options: Option) -> None:
         """Delete key(s); raises NoKeyError when nothing was deleted

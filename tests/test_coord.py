@@ -411,6 +411,69 @@ def test_sync_put_fails_fast_when_follower_dies_mid_barrier(
         c.close()
 
 
+def test_sync_put_min_followers_refuses_unmirrored_ack(coord_server):
+    """sync_min_followers=1 turns the zero-follower degradation into a
+    loud failure: during the exact windows sync exists for (mirror
+    reconnecting, post-overflow re-sync) a standby-running deployment
+    must not receive an ack indistinguishable from a replicated one."""
+    c = RemoteCoord(coord_server.address)
+    try:
+        # Distinct from the timeout error: the refusal is instant and
+        # means "no mirror attached", not "mirror slow".
+        with pytest.raises(CoordinationError, match="live follower"):
+            c.put("s4", "v", sync=True, sync_timeout=0.5,
+                  sync_min_followers=1)
+        # The floor without the barrier is a caller bug, not a no-op.
+        with pytest.raises(ValueError, match="requires sync=True"):
+            c.put("s4", "x", sync_min_followers=1)
+        # The default (0) keeps the documented degrade-to-plain-put.
+        assert c.put("s4", "v2", sync=True) > 0
+    finally:
+        c.close()
+    assert coord_server.state.range("s4").items[0].value == "v2"
+
+
+def test_repl_ack_routed_to_its_feed_only(coord_server):
+    """One connection may carry several repl_subscribe feeds; an ack
+    stamped with feed A's id must credit ONLY feed A — crediting the
+    whole connection would let a fast feed's acks release sync-put
+    barriers for records a slower sibling never mirrored."""
+    import socket as _socket
+
+    from ptype_tpu.coord import wire
+
+    host, _, port = coord_server.address.rpartition(":")
+    sock = _socket.create_connection((host, int(port)), timeout=2.0)
+    lock = threading.Lock()
+    feed_ids = []
+    for req in (1, 2):
+        wire.send_msg(sock, lock, {"op": "repl_subscribe", "id": req})
+        # Replies and snapshot pushes interleave arbitrarily; collect
+        # until this feed's subscribe reply arrives.
+        while True:
+            msg = wire.recv_msg(sock)
+            if msg.get("id") == req:
+                assert msg["ok"]
+                feed_ids.append(msg["result"])
+                break
+    state = coord_server.state
+    state.put("store/routed", "x")
+    seq = state._repl_seq
+    try:
+        # Ack ONLY the first feed through the record's sequence.
+        wire.send_msg(sock, lock,
+                      {"op": "repl_ack", "seq": seq, "feed": feed_ids[0]})
+        assert not state.wait_replicated(seq, timeout=0.7), (
+            "barrier released by one feed's ack while the sibling "
+            "feed on the same connection never mirrored the record")
+        wire.send_msg(sock, lock,
+                      {"op": "repl_ack", "seq": seq, "feed": feed_ids[1]})
+        assert state.wait_replicated(seq, timeout=5.0), (
+            "barrier not released after BOTH feeds acked")
+    finally:
+        sock.close()
+
+
 def test_remote_error_propagates(coord_server):
     c = RemoteCoord(coord_server.address)
     try:

@@ -1,0 +1,16 @@
+#!/bin/bash
+# TPU tunnel watcher. Probes backend init in a fresh process (a wedged
+# tunnel HANGS init, so the probe runs under timeout); exits 0 the
+# moment the chip answers so the caller can run `make tpu-validate`.
+# Exits 1 when the watch window closes still-down (caller restarts).
+# Budget: 2 probes x 60s + 2 x 180s sleep = 8 min < the 10-min cap the
+# caller runs us under.
+cd /root/repo || exit 2
+for i in 1 2; do
+  [ "$i" -gt 1 ] && sleep 180  # between probes only, not after the last
+  if timeout 60 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
+    echo "TPU up at $(date -u +%FT%TZ)" >> tpu_watch.log
+    exit 0
+  fi
+done
+exit 1
