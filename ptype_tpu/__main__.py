@@ -21,6 +21,10 @@ operations need. Commands:
                $STANDBY_REPLICATE=1 streams the WAL cross-host
                instead of assuming a shared data_dir).
                ``kill -USR1`` for operator switchover; ^C exits.
+- ``witness`` — quorum witness (platform ``witness_address`` /
+               ``witness_ttl``): the third vote that lets a
+               partitioned-minority primary self-fence and gates
+               standby promotion on a real majority.
 """
 
 from __future__ import annotations
@@ -198,7 +202,9 @@ def _standby() -> None:
     # WalFollower streams the primary's WAL into it (no shared fs).
     sb = Standby(cfg.platform.coordinator_address, listen, data_dir,
                  replicate=os.environ.get("STANDBY_REPLICATE") == "1",
-                 fsync=cfg.platform.wal_fsync)
+                 fsync=cfg.platform.wal_fsync,
+                 witness_addr=cfg.platform.witness_address or None,
+                 witness_ttl=cfg.platform.witness_ttl)
 
     def _switchover(*_):
         # promote() raises if the primary still holds the WAL fence
@@ -221,6 +227,31 @@ def _standby() -> None:
         sb.close()
 
 
+def _witness() -> None:
+    import os
+
+    from ptype_tpu import config_from_env
+    from ptype_tpu.coord.witness import WitnessServer
+
+    cfg = config_from_env()
+    addr = cfg.platform.witness_address
+    if not addr:
+        print("witness: platform config needs witness_address "
+              "(host:port this witness listens on)", file=sys.stderr)
+        raise SystemExit(2)
+    data_dir = (os.path.join(cfg.platform.data_dir, "witness")
+                if cfg.platform.data_dir else None)
+    srv = WitnessServer(addr, ttl=cfg.platform.witness_ttl,
+                        data_dir=data_dir)
+    print(f"witness on {srv.address} (ttl {srv.ttl}s)", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+
+
 COMMANDS = {
     "info": _info,
     "join": _join,
@@ -229,6 +260,7 @@ COMMANDS = {
     "eval": _eval,
     "bench": _bench,
     "standby": _standby,
+    "witness": _witness,
 }
 
 

@@ -191,6 +191,8 @@ def join(cfg: Config) -> Cluster:
                     data_dir=(_os.path.join(platform.data_dir, "coord")
                               if platform.data_dir else None),
                     fsync=platform.wal_fsync,
+                    witness_addr=platform.witness_address or None,
+                    witness_ttl=platform.witness_ttl,
                 )
                 _servers[server.address] = server
                 owned_server = server

@@ -64,6 +64,18 @@ class PlatformConfig:
     #: mode) at microsecond append cost. On = full etcd-raft-log parity
     #: (survives host power loss) at ~ms/append on typical disks.
     wal_fsync: bool = False
+    #: host:port of the quorum witness (coord/witness.py). Set on the
+    #: seed and every standby to get real partition tolerance: the
+    #: primary self-fences when it can reach neither the witness nor a
+    #: live WAL follower (the minority side of a partition must refuse
+    #: clients rather than serve possibly-superseded state — raft
+    #: parity, ref cluster_test.go:47-167), and a standby can only
+    #: promote by taking the witness lease. Empty = crash-failover
+    #: only (the pre-witness behavior).
+    witness_address: str = ""
+    #: Witness lease TTL seconds: failover detection floor and the
+    #: window a minority primary may serve after the partition starts.
+    witness_ttl: float = 3.0
     #: host:port of the JAX distributed coordination service for
     #: multi-controller runs (``num_processes > 1``). Empty = derive
     #: from ``coordinator_address`` host with port+1. ``join`` calls
@@ -138,7 +150,8 @@ _CONFIG_FIELDS = {
 _PLATFORM_FIELDS = {
     "name", "coordinator_address", "is_coordinator", "mesh_axes",
     "num_processes", "process_id", "data_dir", "lease_ttl", "dial_timeout",
-    "jax_coordinator_address", "wal_fsync",
+    "jax_coordinator_address", "wal_fsync", "witness_address",
+    "witness_ttl",
 }
 
 

@@ -269,6 +269,12 @@ class ReplFeed:
         #: record folded into it. Read by CoordState.wait_replicated —
         #: the sync-put (raft-commit-analog) barrier.
         self.acked = 0
+        #: Last heartbeat/ack ROUND-TRIP from this follower
+        #: (monotonic). A live round-trip within the witness TTL is
+        #: the standby's vote in the partition-tolerance quorum
+        #: (service.CoordServer._quorum_round) — a half-dead TCP
+        #: connection cannot fake it.
+        self.last_hb = time.monotonic()
 
     def _push(self, kind: str, data: dict, seq: int) -> None:
         overflow = False
@@ -375,6 +381,11 @@ class CoordState:
         #: wait_replicated barriers on it.
         self._repl_seq = 0
         self._ack_cond = threading.Condition(self._lock)
+        #: Quorum fence hook: a callable returning a refusal message
+        #: (or None) checked at every public entry point. Installed by
+        #: CoordServer when a witness is configured so in-process
+        #: callers fence like remote ones (see _check_fence).
+        self.fence = None
         if data_dir:
             import fcntl
             import os
@@ -614,7 +625,20 @@ class CoordState:
 
     # ------------------------------------------------------------------ KV
 
+    def _check_fence(self) -> None:
+        """Refuse the operation when a quorum fence is active. Set by
+        CoordServer when a witness is configured, so the seed's OWN
+        in-process callers (LocalCoord — registry, store) fence
+        exactly like remote clients do: a minority-partition primary
+        must not keep serving its co-located application either."""
+        f = self.fence
+        if f is not None:
+            msg = f()
+            if msg:
+                raise CoordinationError(msg)
+
     def put(self, key: str, value: str, lease: int = 0) -> int:
+        self._check_fence()
         if not key:
             raise CoordinationError("put: empty key")
         with self._lock:
@@ -639,6 +663,7 @@ class CoordState:
             return self._rev
 
     def range(self, key: str, options: RangeOptions | None = None) -> RangeResult:
+        self._check_fence()
         opts = options or RangeOptions()
         with self._lock:
             lo, hi = self._bounds(key, opts)
@@ -659,6 +684,7 @@ class CoordState:
             return RangeResult(items=items, count=count, revision=self._rev)
 
     def delete(self, key: str, options: RangeOptions | None = None) -> int:
+        self._check_fence()
         opts = options or RangeOptions()
         with self._lock:
             lo, hi = self._bounds(key, opts)
@@ -721,6 +747,7 @@ class CoordState:
     # --------------------------------------------------------------- leases
 
     def grant(self, ttl: float) -> int:
+        self._check_fence()
         if ttl <= 0:
             raise CoordinationError("grant: ttl must be > 0")
         with self._lock:
@@ -734,6 +761,7 @@ class CoordState:
 
     def keepalive(self, lease_id: int) -> float:
         """Refresh a lease; returns the new TTL. Raises if expired/unknown."""
+        self._check_fence()
         with self._lock:
             lease = self._leases.get(lease_id)
             if lease is None:
@@ -742,6 +770,7 @@ class CoordState:
             return lease.ttl
 
     def revoke(self, lease_id: int) -> None:
+        self._check_fence()
         with self._lock:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
@@ -775,6 +804,7 @@ class CoordState:
     # -------------------------------------------------------------- watches
 
     def watch(self, prefix: str) -> Watch:
+        self._check_fence()
         with self._lock:
             w = Watch(self._next_watch, prefix, self._remove_watch)
             self._next_watch += 1
@@ -815,9 +845,22 @@ class CoordState:
     def note_repl_ack(self, feed: ReplFeed, seq: int) -> None:
         """A follower acknowledged mirroring through ``seq``."""
         with self._lock:
+            feed.last_hb = time.monotonic()  # an ack proves liveness too
             if seq > feed.acked:
                 feed.acked = seq
                 self._ack_cond.notify_all()
+
+    def note_repl_hb(self, feed: ReplFeed) -> None:
+        """A follower answered a heartbeat (live round-trip)."""
+        feed.last_hb = time.monotonic()
+
+    def has_live_follower(self, within: float) -> bool:
+        """True when some follower completed a round-trip within
+        ``within`` seconds — the standby's quorum vote."""
+        now = time.monotonic()
+        with self._lock:
+            return any(not f.closed and now - f.last_hb <= within
+                       for f in self._repl_feeds)
 
     def wait_replicated(self, seq: int | None = None,
                         timeout: float | None = None,
@@ -893,6 +936,7 @@ class CoordState:
     # -------------------------------------------------------------- members
 
     def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member:
+        self._check_fence()
         with self._lock:
             m = Member(
                 id=self._next_member,
@@ -911,6 +955,7 @@ class CoordState:
         reference's MemberPromote in the learner add→catch-up→promote
         lifecycle (cluster.go:120-147, 183-195). Idempotent; WAL-logged
         so the promoted status survives coordinator restart."""
+        self._check_fence()
         with self._lock:
             m = self._members.get(member_id)
             if m is None:
@@ -924,6 +969,7 @@ class CoordState:
             return promoted
 
     def member_remove(self, member_id: int) -> bool:
+        self._check_fence()
         with self._lock:
             gone = self._members.pop(member_id, None) is not None
             if gone:
@@ -931,6 +977,7 @@ class CoordState:
             return gone
 
     def member_list(self) -> list[Member]:
+        self._check_fence()
         with self._lock:
             return sorted(self._members.values(), key=lambda m: m.id)
 

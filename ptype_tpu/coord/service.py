@@ -49,7 +49,9 @@ class CoordServer:
                  state: CoordState | None = None,
                  data_dir: str | None = None,
                  bump_term: bool | int = False,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 witness_addr: str | None = None,
+                 witness_ttl: float = 3.0):
         # bump_term marks this server a PROMOTED successor: the
         # recovered state's fencing term is incremented (by that many
         # slots — juniors promoting past unresponsive seniors skip
@@ -93,7 +95,87 @@ class CoordServer:
             target=self._accept_loop, name="coordd-accept", daemon=True
         )
         self._accept_thread.start()
+        # Quorum self-fencing (coord/witness.py): with a witness
+        # configured, this primary serves only while it holds a second
+        # vote of the {primary, standby, witness} majority — a witness
+        # lease renewal OR a live follower heartbeat round-trip within
+        # the TTL. The minority side of a partition therefore refuses
+        # its clients instead of serving possibly-superseded state
+        # (raft partition behavior, ref cluster_test.go:47-167).
+        self._witness_addr = witness_addr
+        self._witness_ttl = witness_ttl
+        #: Monotonic deadline until which this server may serve. One
+        #: boot-time TTL of grace so a seed can start while the
+        #: witness is briefly unreachable.
+        self._quorum_until = time.monotonic() + witness_ttl
+        #: Set when the witness actively REFUSED renewal (another
+        #: holder took the lease): permanent — a successor exists, so
+        #: this server must never serve again.
+        self._superseded = None  # (holder, term) | None
+        if witness_addr is not None:
+            # The seed's co-located application talks to this state
+            # IN-PROCESS (LocalCoord) — hook the fence into the state
+            # itself so those callers are refused exactly like remote
+            # clients when quorum is lost.
+            self.state.fence = self._fenced
+            threading.Thread(target=self._quorum_loop,
+                             name="coordd-quorum", daemon=True).start()
         log.info("coordination service listening", kv={"addr": self.address})
+
+    # ------------------------------------------------------------- quorum
+
+    def _quorum_round(self) -> None:
+        """One vote-collection round. Stamps the serving deadline
+        BEFORE the witness RPC so the self-fence always fires at or
+        before the moment the witness could hand the lease away."""
+        from ptype_tpu.coord import witness as _witness
+
+        t0 = time.monotonic()
+        votes = 0
+        try:
+            reply = _witness.renew(
+                self._witness_addr, holder=self.address,
+                term=self.state.term,
+                timeout=max(0.3, self._witness_ttl / 3))
+            if reply.get("granted"):
+                votes += 1
+            else:
+                self._superseded = (reply.get("holder"),
+                                    reply.get("term"))
+                log.warning(
+                    "witness refused lease renewal: superseded — "
+                    "hard-fencing this coordinator",
+                    kv={"holder": reply.get("holder"),
+                        "term": reply.get("term")})
+                return
+        except (wire.WireError, OSError):
+            pass  # witness unreachable: no vote, not a refusal
+        if self.state.has_live_follower(within=self._witness_ttl):
+            votes += 1
+        if votes >= 1:  # plus our own vote = majority of 3
+            self._quorum_until = t0 + self._witness_ttl
+
+    def _quorum_loop(self) -> None:
+        interval = self._witness_ttl / 3
+        while not self._closed.wait(interval):
+            self._quorum_round()
+            if self._superseded is not None:
+                return
+
+    def _fenced(self) -> str | None:
+        """Non-None (the refusal message) when this server must not
+        serve: it lost the majority vote or was outright superseded."""
+        if self._witness_addr is None:
+            return None
+        if self._superseded is not None:
+            holder, term = self._superseded
+            return (f"fenced: superseded by {holder} (term {term}); "
+                    f"this coordinator will never serve again")
+        if time.monotonic() > self._quorum_until:
+            return ("fenced: lost quorum (no witness lease and no "
+                    "live follower) — likely the minority side of a "
+                    "partition; refusing to serve possibly-stale state")
+        return None
 
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
@@ -145,6 +227,17 @@ class CoordServer:
                     for feed in acked_feeds:
                         self.state.note_repl_ack(feed, int(msg["seq"]))
                     continue
+                if msg.get("op") == "repl_pong":
+                    # Heartbeat round-trip from a follower: proof of
+                    # LIVE two-way contact (a half-dead TCP connection
+                    # can't produce one), counted as the standby's
+                    # vote in the witness quorum (_quorum_round).
+                    fid = msg.get("feed")
+                    with watches_lock:
+                        feed = feeds.get(fid)
+                    if feed is not None:
+                        self.state.note_repl_hb(feed)
+                    continue
                 # Blocking ops (barrier, watch pumps) must not stall the
                 # reader; dispatch every request to its own thread — control
                 # plane volume is low enough that this is simpler and safer
@@ -174,6 +267,21 @@ class CoordServer:
         op = msg.get("op", "")
         pump_watch: Watch | None = None
         pump_feed = None
+        # Quorum fence BEFORE anything else: a minority-partition or
+        # superseded primary must refuse every client — including ones
+        # that never saw the successor's term (the hole the term fence
+        # alone cannot close). stale=True makes clients bounce to the
+        # other endpoints where the real primary lives.
+        fence = self._fenced()
+        if fence is not None:
+            try:
+                wire.send_msg(conn, send_lock, {
+                    "id": req_id, "ok": False, "stale": True,
+                    "fenced": True, "term": self.state.term,
+                    "error": fence})
+            except (wire.WireError, OSError):
+                pass
+            return
         # Fencing check BEFORE any dispatch: a client that has seen a
         # newer primary (higher term) must get refused here — this
         # server is a superseded primary still running on stale state
@@ -334,6 +442,18 @@ class CoordServer:
             if feed.closed and not batch:
                 return
             if not batch:
+                # Idle tick: heartbeat the follower. Its repl_pong
+                # round-trip is the liveness proof the quorum loop
+                # counts as the standby's vote — a quiet cluster must
+                # not look like a partitioned one.
+                try:
+                    wire.send_msg(conn, send_lock,
+                                  {"repl_hb": feed.id})
+                except (wire.WireError, OSError):
+                    feed.cancel()
+                    with watches_lock:
+                        feeds.pop(feed.id, None)
+                    return
                 continue
             push = {"repl": feed.id,
                     "items": [{"kind": k, "data": d, "seq": s}

@@ -130,6 +130,13 @@ class WalFollower:
                 # the WAL underneath the new primary.
                 if self._closed.is_set():
                     return
+                if "repl_hb" in msg:
+                    # Liveness heartbeat: the round-trip is our vote
+                    # for the primary in the witness quorum — answer
+                    # promptly, mirror nothing.
+                    wire.send_msg(sock, lock,
+                                  {"op": "repl_pong", "feed": feed_id})
+                    continue
                 last_seq = None
                 for item in msg.get("items", ()):
                     if item["kind"] == "snap":
@@ -240,7 +247,9 @@ class Standby:
                  replicate: bool = False,
                  register: bool = True,
                  succession_grace: float = 10.0,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 witness_addr: str | None = None,
+                 witness_ttl: float = 3.0):
         self.primary_address = primary_address
         self.listen_address = listen_address
         self.data_dir = data_dir
@@ -277,6 +286,13 @@ class Standby:
         #: WAL durability mode for the server this standby starts at
         #: promotion (match the primary's ``wal_fsync`` setting).
         self._fsync = fsync
+        #: Witness (coord/witness.py): promotion additionally requires
+        #: acquiring the witness lease — the second vote of the
+        #: {primary, standby, witness} majority. Without it a standby
+        #: partitioned AWAY from a healthy primary could promote and
+        #: split the brain for clients that can reach only one side.
+        self._witness_addr = witness_addr
+        self._witness_ttl = witness_ttl
         # replicate=True: ``data_dir`` is LOCAL and a WalFollower
         # mirrors the primary's WAL into it over TCP — the cross-host
         # deployment. False: ``data_dir`` IS the primary's (shared
@@ -542,9 +558,53 @@ class Standby:
         # writers on one mirror.
         self._ensure_follower()
 
+    def _mirror_term(self) -> int:
+        """Fencing term recorded in the mirrored snapshot (the
+        primary's current term — terms only change at promotions,
+        which always write a snapshot). 0 when unreadable."""
+        try:
+            with open(os.path.join(self.data_dir, "coord.snap"),
+                      encoding="utf-8") as f:
+                return int(json.load(f).get("term", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _acquire_witness(self) -> bool:
+        """Take the witness lease for the about-to-promote server (its
+        bumped term). Grant = we are the majority side; refusal or an
+        unreachable witness = no majority, DON'T promote: a healthy
+        primary may be serving clients we cannot see."""
+        if self._witness_addr is None:
+            return True
+        from ptype_tpu.coord import witness as _witness
+
+        new_term = self._mirror_term() + 1 + len(self._seniors())
+        try:
+            reply = _witness.acquire(
+                self._witness_addr, candidate=self.listen_address,
+                term=new_term, timeout=max(1.0, self._witness_ttl))
+        except (wire.WireError, OSError) as e:
+            log.warning(
+                "standby refusing promotion: witness unreachable "
+                "(no majority)", kv={"err": str(e)})
+            return False
+        if not reply.get("granted"):
+            log.warning(
+                "standby refusing promotion: witness lease refused — "
+                "the primary (or a peer) still holds it",
+                kv={"holder": reply.get("holder"),
+                    "term": reply.get("term"),
+                    "reason": reply.get("reason")})
+            return False
+        return True
+
     def _promote(self) -> bool:
         if self._closed.is_set():
             return True
+        if not self._acquire_witness():
+            # Keep guarding; the witness grants once the primary's
+            # lease truly lapses (it is still renewing = still alive).
+            return False
         if self.follower is not None and not self.follower.synced.is_set():
             # The mirror never received a snapshot (primary died inside
             # the first connect window, or was never reachable from
@@ -584,7 +644,9 @@ class Standby:
             self.server = CoordServer(self.listen_address,
                                       data_dir=self.data_dir,
                                       bump_term=1 + len(self._seniors()),
-                                      fsync=self._fsync)
+                                      fsync=self._fsync,
+                                      witness_addr=self._witness_addr,
+                                      witness_ttl=self._witness_ttl)
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
@@ -661,12 +723,27 @@ class Standby:
                     "promoted server; retry once it exits")
             self.follower = None
         deadline = _time.monotonic() + timeout
+        # Deliberate switchover still takes the witness vote (unless
+        # forced): the lease frees one TTL after the primary was shut
+        # down, so retry within the operator's timeout.
+        if self._witness_addr is not None and not force:
+            while not self._acquire_witness():
+                if _time.monotonic() > deadline:
+                    self._start_guarding()
+                    raise RuntimeError(
+                        "promote: witness lease not acquired — the "
+                        "primary still holds it (shut it down and let "
+                        "its TTL lapse) or the witness is unreachable "
+                        "(force=True overrides)")
+                _time.sleep(min(1.0, self._witness_ttl / 2))
         while True:
             try:
                 self.server = CoordServer(
                     self.listen_address, data_dir=self.data_dir,
                     bump_term=1 + len(self._seniors()),
-                    fsync=self._fsync)
+                    fsync=self._fsync,
+                    witness_addr=self._witness_addr,
+                    witness_ttl=self._witness_ttl)
                 break
             except Exception as e:  # noqa: BLE001 — fence / transient
                 if _time.monotonic() > deadline:

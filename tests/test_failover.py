@@ -938,6 +938,233 @@ def test_two_standbys_deterministic_succession(tmp_path):
             seed.wait(timeout=10)
 
 
+# ---------------------------------------------------- partition drills
+
+
+class _TcpProxy:
+    """Point-to-point TCP forwarder standing in for ONE network path.
+    ``cut()`` severs exactly that path (refuses new dials, kills live
+    links) while every other path stays up — a real partition blocks
+    by (src, dst) pair, which a single in-process server can't express
+    any other way."""
+
+    def __init__(self, target: str):
+        import socket as _socket
+        import threading as _threading
+
+        self._target = target
+        self._lis = _socket.socket()
+        self._lis.setsockopt(_socket.SOL_SOCKET,
+                             _socket.SO_REUSEADDR, 1)
+        self._lis.bind(("127.0.0.1", 0))
+        self._lis.listen(32)
+        self.address = f"127.0.0.1:{self._lis.getsockname()[1]}"
+        self._conns: set = set()
+        self._lock = _threading.Lock()
+        self._cut = _threading.Event()
+        _threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import socket as _socket
+        import threading as _threading
+
+        host, _, port = self._target.rpartition(":")
+        while not self._cut.is_set():
+            try:
+                c, _peer = self._lis.accept()
+            except OSError:
+                return
+            try:
+                u = _socket.create_connection((host, int(port)),
+                                              timeout=2.0)
+            except OSError:
+                c.close()
+                continue
+            with self._lock:
+                self._conns.update((c, u))
+            for a, b in ((c, u), (u, c)):
+                _threading.Thread(target=self._pump, args=(a, b),
+                                  daemon=True).start()
+
+    def _pump(self, a, b):
+        import socket as _socket
+
+        try:
+            while True:
+                data = a.recv(65536)
+                if not data:
+                    break
+                b.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (a, b):
+                try:
+                    s.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def cut(self):
+        import socket as _socket
+
+        self._cut.set()
+        try:
+            self._lis.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+WITNESS_TTL = 1.0
+
+
+def _witness_cluster(tmp_path, standby_addr, *,
+                     proxy_witness: bool, proxy_primary: bool):
+    """Primary (in-process, witness-fenced) + wal-stream standby +
+    witness, with proxies on the paths a drill wants to cut."""
+    from ptype_tpu.coord.service import CoordServer
+    from ptype_tpu.coord.witness import WitnessServer
+
+    witness = WitnessServer(ttl=WITNESS_TTL)
+    wproxy = _TcpProxy(witness.address) if proxy_witness else None
+    primary = CoordServer(
+        "127.0.0.1:0", data_dir=str(tmp_path / "p"),
+        witness_addr=(wproxy.address if wproxy else witness.address),
+        witness_ttl=WITNESS_TTL)
+    pproxy = _TcpProxy(primary.address) if proxy_primary else None
+    standby = Standby(
+        pproxy.address if pproxy else primary.address,
+        standby_addr, str(tmp_path / "s"),
+        check_interval=0.2, failure_threshold=3, probe_timeout=0.5,
+        replicate=True, witness_addr=witness.address,
+        witness_ttl=WITNESS_TTL)
+    return witness, wproxy, primary, pproxy, standby
+
+
+def test_partition_minority_primary_fences_and_standby_promotes(
+        tmp_path, free_port_pair):
+    """THE raft-parity drill (ref cluster_test.go:47-167): partition
+    the primary onto the minority side (it can reach neither witness
+    nor standby) while a client can reach ONLY it. The old term fence
+    can't help — this client never sees the successor's term. The
+    quorum self-fence must refuse it anyway, while the majority side
+    (standby + witness) promotes and serves the intact state."""
+    _, standby_addr = free_port_pair
+    witness, wproxy, primary, pproxy, standby = _witness_cluster(
+        tmp_path, standby_addr, proxy_witness=True, proxy_primary=True)
+    client = RemoteCoord([primary.address], request_timeout=5.0,
+                         reconnect_timeout=5.0)
+    c2 = None
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        client.put("store/k", "v1")
+
+        # PARTITION: primary loses witness AND standby; the standby
+        # keeps the witness; the client keeps the (old) primary.
+        wproxy.cut()
+        pproxy.cut()
+
+        assert standby.promoted.wait(timeout=20), (
+            "standby (majority side) never promoted")
+        # The minority primary must refuse its clients — stalling or
+        # erroring is acceptable, serving is not.
+        with pytest.raises(CoordinationError):
+            client.put("store/k", "v2-through-stale-primary")
+        with pytest.raises(CoordinationError):
+            client.range("store/k")
+        # Majority side: data intact, term advanced.
+        c2 = RemoteCoord([standby.server.address])
+        assert c2.range("store/k").items[0].value == "v1"
+        assert standby.server.state.term >= 1
+        # And the write the fenced primary refused never happened
+        # anywhere.
+        assert c2.range("store/k").items[0].value != (
+            "v2-through-stale-primary")
+    finally:
+        if c2 is not None:
+            c2.close()
+        client.close()
+        standby.close()
+        primary.close()
+        witness.close()
+
+
+def test_partition_isolated_standby_does_not_promote(
+        tmp_path, free_port_pair):
+    """The inverse partition: only the standby⇄primary path drops;
+    primary and standby both still reach the witness. The standby's
+    probes all fail — but the witness refuses it the lease (the
+    primary keeps renewing), so it must NOT promote, and the primary
+    (majority side: self + witness) keeps serving."""
+    _, standby_addr = free_port_pair
+    witness, _, primary, pproxy, standby = _witness_cluster(
+        tmp_path, standby_addr, proxy_witness=False,
+        proxy_primary=True)
+    client = RemoteCoord([primary.address])
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        client.put("store/k", "v1")
+
+        pproxy.cut()  # standby sees a "dead" primary
+
+        # Give it several full detection + promotion-attempt cycles.
+        time.sleep(3 * WITNESS_TTL + 2.0)
+        assert not standby.promoted.is_set(), (
+            "isolated standby promoted over a healthy primary — "
+            "split brain")
+        # The healthy majority primary serves on, same term.
+        client.put("store/k", "v2")
+        assert client.range("store/k").items[0].value == "v2"
+        assert primary.state.term == 0
+    finally:
+        client.close()
+        standby.close()
+        primary.close()
+        witness.close()
+
+
+def test_witness_outage_majority_pair_keeps_serving(
+        tmp_path, free_port_pair):
+    """Witness down, primary+standby connected: the pair IS the
+    majority (2 of 3). The follower heartbeat round-trip is the
+    primary's second vote, so serving continues — the witness must
+    never be a single point of failure for a healthy pair."""
+    _, standby_addr = free_port_pair
+    witness, wproxy, primary, _, standby = _witness_cluster(
+        tmp_path, standby_addr, proxy_witness=True,
+        proxy_primary=False)
+    client = RemoteCoord([primary.address])
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        client.put("store/k", "v1")
+
+        wproxy.cut()  # witness unreachable from the primary
+
+        time.sleep(3 * WITNESS_TTL)
+        client.put("store/k", "v2")  # still served: follower vote
+        assert client.range("store/k").items[0].value == "v2"
+        assert not standby.promoted.is_set()
+    finally:
+        client.close()
+        standby.close()
+        primary.close()
+        witness.close()
+
+
 @pytest.fixture
 def free_port_pair():
     import socket

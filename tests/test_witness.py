@@ -1,0 +1,103 @@
+"""Witness vote-server semantics (ptype_tpu/coord/witness.py).
+
+The lease rules here are the safety core of partition tolerance: at
+most one side of a partition can ever hold the lease, takeovers
+require both expiry AND a term bump, and a witness restart cannot be
+tricked into handing out a second, lower-term lease. The end-to-end
+partition drills live in test_failover.py; these are the unit truths
+they stand on.
+"""
+
+import time
+
+import pytest
+
+from ptype_tpu.coord import witness as w
+
+
+@pytest.fixture
+def witness():
+    srv = w.WitnessServer(ttl=0.4)
+    yield srv
+    srv.close()
+
+
+def test_renew_vacant_lease_adopts_holder(witness):
+    r = w.renew(witness.address, holder="p1", term=0)
+    assert r["granted"]
+    st = w.status(witness.address)
+    assert st["holder"] == "p1"
+    assert st["remaining"] > 0
+
+
+def test_renew_refused_for_non_holder_while_active(witness):
+    assert w.renew(witness.address, holder="p1", term=0)["granted"]
+    r = w.renew(witness.address, holder="p2", term=0)
+    assert not r["granted"]
+    assert r["holder"] == "p1"
+
+
+def test_acquire_refused_while_lease_active(witness):
+    assert w.renew(witness.address, holder="p1", term=0)["granted"]
+    r = w.acquire(witness.address, candidate="s1", term=1)
+    assert not r["granted"]
+    assert r["reason"] == "lease active"
+
+
+def test_acquire_after_expiry_requires_term_bump(witness):
+    assert w.renew(witness.address, holder="p1", term=3)["granted"]
+    time.sleep(0.6)  # > ttl: lease expired
+    # Equal term: two racing challengers must not both win on ties.
+    r = w.acquire(witness.address, candidate="s1", term=3)
+    assert not r["granted"]
+    assert "term" in r["reason"]
+    r = w.acquire(witness.address, candidate="s1", term=4)
+    assert r["granted"]
+    assert r["term"] == 4
+
+
+def test_superseded_holder_renewal_refused_forever(witness):
+    assert w.renew(witness.address, holder="p1", term=0)["granted"]
+    time.sleep(0.6)
+    assert w.acquire(witness.address, candidate="s1", term=1)["granted"]
+    # The old primary comes back from its partition: refused, and told
+    # who superseded it.
+    r = w.renew(witness.address, holder="p1", term=0)
+    assert not r["granted"]
+    assert r["holder"] == "s1"
+    assert r["term"] == 1
+    # The successor's renewals keep working.
+    assert w.renew(witness.address, holder="s1", term=1)["granted"]
+
+
+def test_reacquire_by_holder_is_idempotent(witness):
+    assert w.acquire(witness.address, candidate="s1", term=1)["granted"]
+    assert w.acquire(witness.address, candidate="s1", term=1)["granted"]
+
+
+def test_restart_keeps_holder_and_rearms_full_ttl(tmp_path):
+    data = str(tmp_path / "w")
+    srv = w.WitnessServer(ttl=0.5, data_dir=data)
+    try:
+        assert w.acquire(srv.address, candidate="p1",
+                         term=2)["granted"]
+    finally:
+        srv.close()
+    srv = w.WitnessServer(ttl=0.5, data_dir=data)
+    try:
+        st = w.status(srv.address)
+        assert st["holder"] == "p1"
+        assert st["term"] == 2
+        # Freshly restarted: the deadline is re-armed to a FULL ttl,
+        # so a challenger cannot exploit the restart window.
+        r = w.acquire(srv.address, candidate="s1", term=3)
+        assert not r["granted"]
+        # And the incumbent's renewals resume seamlessly.
+        assert w.renew(srv.address, holder="p1", term=2)["granted"]
+    finally:
+        srv.close()
+
+
+def test_unreachable_witness_raises_not_grants():
+    with pytest.raises(OSError):
+        w.renew("127.0.0.1:1", holder="p1", term=0, timeout=0.3)
