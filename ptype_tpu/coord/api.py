@@ -46,9 +46,11 @@ class CoordBackend(abc.ABC):
     @abc.abstractmethod
     def revoke(self, lease_id: int) -> None: ...
 
-    # Watches
+    # Watches. start_rev > 0 replays retained history from that
+    # revision at arm time (etcd watch start-revision; raises when
+    # compacted).
     @abc.abstractmethod
-    def watch(self, prefix: str) -> Watch: ...
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch: ...
 
     # Membership
     @abc.abstractmethod

@@ -20,6 +20,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field, replace
 
 from ptype_tpu import logs
@@ -129,6 +130,11 @@ class RangeOptions:
     count_only: bool = False
     serializable: bool = False  # no-op here: every read is linearizable
     min_mod_rev: int = 0
+    #: Read AT this historical revision (etcd WithRev,
+    #: store_config.go:71-73): the result is the state as of revision
+    #: ``rev``, served from the bounded MVCC history. 0 = head. Raises
+    #: when the revision is compacted or in the future.
+    rev: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -142,6 +148,7 @@ class RangeOptions:
             "count_only": self.count_only,
             "serializable": self.serializable,
             "min_mod_rev": self.min_mod_rev,
+            "rev": self.rev,
         }
 
     @staticmethod
@@ -157,6 +164,7 @@ class RangeOptions:
             count_only=d.get("count_only", False),
             serializable=d.get("serializable", False),
             min_mod_rev=d.get("min_mod_rev", 0),
+            rev=d.get("rev", 0),
         )
 
 
@@ -180,11 +188,16 @@ class Watch:
     def __init__(self, watch_id: int, prefix: str, cancel_fn):
         self.id = watch_id
         self.prefix = prefix
-        #: Bumped by RemoteCoord every time the watch is re-armed after
-        #: a reconnect. Events between the loss and the re-arm are gone;
-        #: consumers that see the bump must re-list to resync (the
-        #: snapshot-then-delta contract's resync point).
+        #: Bumped by RemoteCoord when a watch re-arm could NOT replay
+        #: the missed interval (history compacted): events between the
+        #: loss and the re-arm are gone and consumers that see the bump
+        #: must re-list to resync (the snapshot-then-delta contract's
+        #: resync point). Since round 5 a reconnect that resumes from
+        #: ``last_rev`` via the MVCC event history does NOT bump.
         self.epoch = 0
+        #: Highest mod_rev delivered through this watch (or the arm-
+        #: time head revision) — the resume point for reconnect replay.
+        self.last_rev = 0
         self._cancel_fn = cancel_fn
         self._cond = threading.Condition()
         self._events: list[Event] = []
@@ -195,6 +208,8 @@ class Watch:
             if self._closed:
                 return
             self._events.extend(events)
+            if events and events[-1].mod_rev > self.last_rev:
+                self.last_rev = events[-1].mod_rev
             self._cond.notify_all()
 
     def get(self, timeout: float | None = None) -> list[Event]:
@@ -343,7 +358,8 @@ class CoordState:
                  data_dir: str | None = None,
                  compact_every: int = 10_000,
                  bump_term: bool | int = False,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 history_window: int = 10_000):
         self._lock = threading.RLock()
         self._kv: dict[str, KVItem] = {}
         self._rev = 0
@@ -386,6 +402,24 @@ class CoordState:
         #: CoordServer when a witness is configured so in-process
         #: callers fence like remote ones (see _check_fence).
         self.fence = None
+        # ---- bounded MVCC history (etcd WithRev + watch-start-rev
+        # parity, store_config.go:71-73). Two structures, one feed
+        # point (_notify):
+        #: Global event log for watch replay-from-revision, bounded at
+        #: ``history_window`` events; ``_event_floor`` = mod_rev of the
+        #: newest EVICTED event (resume below it must re-list).
+        self._event_log: deque[Event] = deque()
+        self._event_floor = 0
+        #: Per-key version chains for read-at-revision:
+        #: key -> [(mod_rev, KVItem|None)] (None = tombstone), oldest
+        #: first. Eviction keeps the newest entry at-or-below the
+        #: compaction floor as each key's base version (what etcd's
+        #: compaction keeps), so any revision in
+        #: [_compacted_rev, head] reconstructs exactly.
+        self._hist: dict[str, list] = {}
+        self._hist_log: deque = deque()  # (mod_rev, key) eviction order
+        self._compacted_rev = 0
+        self._history_window = history_window
         if data_dir:
             import fcntl
             import os
@@ -560,6 +594,13 @@ class CoordState:
                 self._members[r["id"]] = Member(
                     id=r["id"], name=r["n"], peer_addr=r["a"],
                     metadata=r["md"])
+            # History below the snapshot revision is unknowable: set
+            # the MVCC floors there and seed each key's base version,
+            # so [snap_rev, head] reconstructs exactly (WAL replay
+            # appends the rest through the normal mutation paths).
+            self._compacted_rev = self._event_floor = self._rev
+            for k, it in self._kv.items():
+                self._hist[k] = [(it.mod_rev, it)]
         self._wal_gen = snap_gen
         wal_path = os.path.join(data_dir, "coord.wal")
         if os.path.exists(wal_path):
@@ -667,10 +708,26 @@ class CoordState:
         opts = options or RangeOptions()
         with self._lock:
             lo, hi = self._bounds(key, opts)
-            items = [
-                it for k, it in self._kv.items()
-                if lo <= k and (hi is None or k < hi)
-            ]
+            if opts.rev:
+                if opts.rev > self._rev:
+                    raise CoordinationError(
+                        f"range: revision {opts.rev} is in the future "
+                        f"(head {self._rev})")
+                if opts.rev < self._compacted_rev:
+                    raise CoordinationError(
+                        f"range: revision {opts.rev} has been "
+                        f"compacted (floor {self._compacted_rev})")
+                items = []
+                for k in self._hist:
+                    if lo <= k and (hi is None or k < hi):
+                        it = self._item_at(k, opts.rev)
+                        if it is not None:
+                            items.append(it)
+            else:
+                items = [
+                    it for k, it in self._kv.items()
+                    if lo <= k and (hi is None or k < hi)
+                ]
             if opts.min_mod_rev:
                 items = [it for it in items if it.mod_rev >= opts.min_mod_rev]
             items = self._sort(items, opts)
@@ -803,11 +860,40 @@ class CoordState:
 
     # -------------------------------------------------------------- watches
 
-    def watch(self, prefix: str) -> Watch:
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        """Stream events under ``prefix``. ``start_rev`` > 0 first
+        replays every retained event with ``mod_rev >= start_rev``
+        (etcd watch start-revision semantics) atomically with the
+        arm — the reconnect-resume primitive: a client that saw
+        through revision R re-watches with ``start_rev=R+1`` and
+        misses nothing, without a snapshot re-list. Raises when the
+        requested interval has been compacted (caller falls back to
+        snapshot-then-delta)."""
         self._check_fence()
         with self._lock:
+            if start_rev and start_rev <= self._event_floor:
+                raise CoordinationError(
+                    f"watch: start revision {start_rev} has been "
+                    f"compacted (floor {self._event_floor + 1})")
+            if start_rev > self._rev + 1:
+                # The interval [head+1, start_rev) is not covered by
+                # this state's history — the client is resuming
+                # against a RESET state (fresh data_dir). Claiming
+                # continuity would silently skip the gap; report it as
+                # compacted so the client re-lists.
+                raise CoordinationError(
+                    f"watch: start revision {start_rev} is ahead of "
+                    f"head {self._rev} — uncovered interval, treat "
+                    f"as compacted")
             w = Watch(self._next_watch, prefix, self._remove_watch)
+            w.last_rev = self._rev
             self._next_watch += 1
+            if start_rev:
+                replay = [ev for ev in self._event_log
+                          if ev.mod_rev >= start_rev
+                          and ev.key.startswith(prefix)]
+                if replay:
+                    w._push(replay)
             self._watches.append(w)
             return w
 
@@ -928,10 +1014,52 @@ class CoordState:
 
     def _notify(self, events: list[Event]) -> None:
         # called under self._lock
+        for ev in events:
+            self._record_event(ev)
         for w in self._watches:
             batch = [ev for ev in events if ev.key.startswith(w.prefix)]
             if batch:
                 w._push(batch)
+
+    def _record_event(self, ev: Event) -> None:
+        """Feed the bounded MVCC history (under the lock). Every
+        mutation path funnels through _notify, so this is the single
+        point where both the watch-replay log and the per-key version
+        chains grow — and where they are compacted."""
+        self._event_log.append(ev)
+        item = self._kv.get(ev.key) if ev.type is EventType.PUT else None
+        self._hist.setdefault(ev.key, []).append((ev.mod_rev, item))
+        self._hist_log.append((ev.mod_rev, ev.key))
+        while len(self._event_log) > self._history_window:
+            self._event_floor = self._event_log.popleft().mod_rev
+        while len(self._hist_log) > self._history_window:
+            m, k = self._hist_log.popleft()
+            if m > self._compacted_rev:
+                self._compacted_rev = m
+            lst = self._hist.get(k)
+            if not lst:
+                continue
+            # Keep only the NEWEST entry at-or-below the floor as the
+            # key's base version (etcd compaction semantics) …
+            while len(lst) > 1 and lst[1][0] <= m:
+                lst.pop(0)
+            # … and a tombstone base is indistinguishable from "no
+            # history" (the key is absent either way): drop it fully.
+            if lst and lst[0][0] <= m and lst[0][1] is None:
+                lst.pop(0)
+            if not lst:
+                del self._hist[k]
+
+    def _item_at(self, key: str, rev: int) -> KVItem | None:
+        """The key's state as of ``rev`` (under the lock): the newest
+        version chained at-or-below it. None = absent (never existed
+        in the retained window, or tombstoned)."""
+        best = None
+        for r, item in self._hist.get(key, ()):
+            if r > rev:
+                break
+            best = item
+        return best
 
     # -------------------------------------------------------------- members
 

@@ -72,8 +72,8 @@ class LocalCoord(CoordBackend):
     def revoke(self, lease_id: int) -> None:
         self.state.revoke(lease_id)
 
-    def watch(self, prefix: str) -> Watch:
-        return self.state.watch(prefix)
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        return self.state.watch(prefix, start_rev=start_rev)
 
     def member_add(self, name: str, peer_addr: str, metadata: dict | None = None) -> Member:
         return self.state.member_add(name, peer_addr, metadata)

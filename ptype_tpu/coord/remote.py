@@ -132,6 +132,9 @@ class RemoteCoord(CoordBackend):
         self._pending: dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
         self._watches: dict[int, Watch] = {}
+        #: Watch pushes that arrived before their watch id was
+        #: registered (see _dispatch_watch); drained at registration.
+        self._orphan_events: dict[int, list] = {}
         self._watches_lock = threading.Lock()
         self._next_id = 1
         self._id_lock = threading.Lock()
@@ -312,19 +315,41 @@ class RemoteCoord(CoordBackend):
                             if not w.closed
                             and not getattr(w, "_armed", True)]
                 for w in todo:
+                    # Resume from the last DELIVERED revision: the
+                    # server replays the missed interval from its MVCC
+                    # event history — no events lost, no re-list. Only
+                    # when that interval has been compacted (outage
+                    # outlived the history window) fall back to a
+                    # fresh watch + epoch bump (consumers re-list:
+                    # snapshot-then-delta).
+                    replayed = True
                     try:
-                        new_id = self._call("watch", prefix=w.prefix)
+                        try:
+                            res = self._call("watch", prefix=w.prefix,
+                                             start_rev=w.last_rev + 1)
+                        except CoordinationError as e:
+                            if "compacted" not in str(e):
+                                raise
+                            replayed = False
+                            res = self._call("watch", prefix=w.prefix)
                     except CoordinationError:
                         failed = True
                         continue  # retried next round
+                    new_id = res["id"]
                     with self._watches_lock:
                         if self._watches.pop(w.id, None) is not None:
                             w.id = new_id
                             w._armed = True
-                            # Signal consumers to re-list: events between
-                            # the loss and this re-arm were missed.
-                            w.epoch += 1
+                            if not replayed:
+                                # Events in the gap are gone for good:
+                                # signal consumers to re-list.
+                                w.epoch += 1
+                                if res.get("rev", 0) > w.last_rev:
+                                    w.last_rev = res["rev"]
                             self._watches[new_id] = w
+                            for _, m in self._orphan_events.pop(
+                                    new_id, []):
+                                w._push(self._wire_events(m))
                             continue
                     # The local watch was closed concurrently: the
                     # server-side watch we just created is orphaned —
@@ -359,12 +384,9 @@ class RemoteCoord(CoordBackend):
                 if self._closed.is_set() or current():
                     self._rewatch_gate.set()
 
-    def _dispatch_watch(self, msg: dict) -> None:
-        with self._watches_lock:
-            w = self._watches.get(msg["watch"])
-        if w is None:
-            return
-        events = [
+    @staticmethod
+    def _wire_events(msg: dict) -> list[Event]:
+        return [
             Event(
                 type=EventType(ev["type"]),
                 key=ev["key"],
@@ -373,7 +395,38 @@ class RemoteCoord(CoordBackend):
             )
             for ev in msg.get("events", [])
         ]
+
+    def _dispatch_watch(self, msg: dict) -> None:
+        with self._watches_lock:
+            w = self._watches.get(msg["watch"])
+            if w is None:
+                # The server starts pumping the moment the create-reply
+                # is sent, so a push can reach this reader BEFORE the
+                # calling thread registers the new watch id — a hot
+                # race for replay-from-revision re-arms (their events
+                # are pre-queued). Stash briefly; _register_watch
+                # drains under this same lock, preserving order.
+                now = time.monotonic()
+                self._orphan_events.setdefault(
+                    msg["watch"], []).append((now, msg))
+                for wid in list(self._orphan_events):
+                    self._orphan_events[wid] = [
+                        (t, m) for t, m in self._orphan_events[wid]
+                        if now - t < 30.0]
+                    if not self._orphan_events[wid]:
+                        del self._orphan_events[wid]
+                return
+            events = self._wire_events(msg)
         w._push(events)
+
+    def _register_watch(self, w: Watch) -> None:
+        """Register a (re)armed watch id and drain any pushes that
+        outran the registration (under the watches lock, so no later
+        push can interleave ahead of the drained ones)."""
+        with self._watches_lock:
+            self._watches[w.id] = w
+            for _, msg in self._orphan_events.pop(w.id, []):
+                w._push(self._wire_events(msg))
 
     def _call(self, op: str, reply_timeout: float | None = None, **kwargs):
         """One request/response, with fence-aware endpoint cycling: a
@@ -529,11 +582,16 @@ class RemoteCoord(CoordBackend):
 
     # -------------------------------------------------------------- watches
 
-    def watch(self, prefix: str) -> Watch:
-        watch_id = self._call("watch", prefix=prefix)
-        w = Watch(watch_id, prefix, self._cancel_watch)
-        with self._watches_lock:
-            self._watches[watch_id] = w
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        res = self._call("watch", prefix=prefix, start_rev=start_rev)
+        w = Watch(res["id"], prefix, self._cancel_watch)
+        # Resume floor: for a fresh watch the server's arm-time head
+        # (nothing before it was promised); start_rev watches resume
+        # from the caller's own floor. Advances only as events are
+        # actually DELIVERED (Watch._push) — so a reconnect mid-replay
+        # can never skip undelivered events.
+        w.last_rev = (start_rev - 1) if start_rev else res.get("rev", 0)
+        self._register_watch(w)
         return w
 
     def _cancel_watch(self, w: Watch) -> None:

@@ -305,10 +305,15 @@ class CoordServer:
                 # The pump must not start until the create-reply is on the
                 # wire: the client registers the watch id only after the
                 # reply, and events sent before that would be dropped.
-                pump_watch = self.state.watch(msg["prefix"])
+                # (Replay-from-start_rev events are queued IN the Watch
+                # atomically with the arm, so they also flow after the
+                # reply, in order.)
+                pump_watch = self.state.watch(
+                    msg["prefix"], start_rev=msg.get("start_rev", 0))
                 with watches_lock:
                     watches[pump_watch.id] = pump_watch
-                result = pump_watch.id
+                result = {"id": pump_watch.id,
+                          "rev": self.state.revision}
             elif op == "repl_subscribe":
                 # Same ordering contract as watch: the snapshot that
                 # heads the feed must not hit the wire before the

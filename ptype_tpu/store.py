@@ -82,8 +82,17 @@ def with_count_only() -> Option:
 
 
 def with_min_mod_rev(rev: int) -> Option:
-    """Filter to entries modified at or after ``rev`` (WithRev analog)."""
+    """Filter to entries modified at or after ``rev``."""
     return lambda o: replace(o, min_mod_rev=rev)
+
+
+def with_rev(rev: int) -> Option:
+    """Read AT a historical revision (store_config.go:71-73): the
+    result is the store's state as of revision ``rev``, reconstructed
+    from the coordinator's bounded MVCC history. Raises once the
+    revision falls behind the retained window ("compacted", etcd
+    parity) or is ahead of the head."""
+    return lambda o: replace(o, rev=rev)
 
 
 def get_prefix_range_end(prefix: str) -> str:

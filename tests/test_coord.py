@@ -33,6 +33,105 @@ def wait_until(pred, timeout=3.0, interval=0.02):
 # ------------------------------------------------------------------- KV
 
 
+def test_read_at_revision(coord):
+    """WithRev parity (ref store_config.go:71-73): range(rev=N) serves
+    the state AS OF revision N from the bounded MVCC history — not a
+    filter, a reconstruction (create/delete included)."""
+    r1 = coord.put("a/x", "1")
+    r2 = coord.put("a/y", "2")
+    r3 = coord.put("a/x", "1b")
+    coord.delete("a/y")
+    r5 = coord.put("a/z", "3")
+
+    def at(rev):
+        res = coord.range("a/", RangeOptions(prefix=True, rev=rev))
+        return {it.key: it.value for it in res.items}
+
+    assert at(r1) == {"a/x": "1"}
+    assert at(r2) == {"a/x": "1", "a/y": "2"}
+    assert at(r3) == {"a/x": "1b", "a/y": "2"}
+    assert at(r3 + 1) == {"a/x": "1b"}  # after the delete
+    assert at(r5) == {"a/x": "1b", "a/z": "3"}
+    # The historical ITEM carries its historical metadata.
+    it = coord.range("a/x", RangeOptions(rev=r1)).items[0]
+    assert (it.value, it.version, it.mod_rev) == ("1", 1, r1)
+
+
+def test_read_at_revision_compacted_and_future():
+    """Reads outside the retained window fail loudly with etcd's
+    vocabulary: 'compacted' below the floor, 'future' above head."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+
+    state = CoordState(sweep_interval=0.05, history_window=4)
+    coord = LocalCoord(state)
+    try:
+        revs = [coord.put("k", str(i)) for i in range(10)]
+        with pytest.raises(CoordinationError, match="compacted"):
+            coord.range("k", RangeOptions(rev=revs[0]))
+        # The newest revisions stay readable.
+        assert coord.range(
+            "k", RangeOptions(rev=revs[-2])).items[0].value == "8"
+        with pytest.raises(CoordinationError, match="future"):
+            coord.range("k", RangeOptions(rev=revs[-1] + 100))
+    finally:
+        state.close()
+
+
+def test_read_at_revision_survives_restart_floor(tmp_path):
+    """Restart semantics: WAL replay REBUILDS the history it covers
+    (reads into the pre-restart window still work), while a restart
+    whose state came folded into a snapshot serves exactly
+    [snapshot_rev, head] and refuses older revisions as compacted."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+
+    d = str(tmp_path / "c")
+    state = CoordState(data_dir=d)
+    r1 = state.put("a/x", "1")
+    r2 = state.put("a/x", "2")
+    state.close()
+    # Restart #1: the mutations arrive via WAL replay → history for
+    # [r1, r2] is rebuilt and readable (compact-on-start then folds
+    # them into the snapshot for the NEXT generation).
+    state = CoordState(data_dir=d)
+    assert state.range(
+        "a/x", RangeOptions(rev=r1)).items[0].value == "1"
+    state.close()
+    # Restart #2: state now comes from the folded snapshot (rev r2);
+    # revisions below it are unknowable — compacted.
+    state = CoordState(data_dir=d)
+    coord = LocalCoord(state)
+    try:
+        r3 = coord.put("a/x", "3")
+        assert coord.range(
+            "a/x", RangeOptions(rev=r2)).items[0].value == "2"
+        assert coord.range(
+            "a/x", RangeOptions(rev=r3)).items[0].value == "3"
+        with pytest.raises(CoordinationError, match="compacted"):
+            coord.range("a/x", RangeOptions(rev=r1))
+    finally:
+        state.close()
+
+
+def test_watch_start_rev_replays_history(coord):
+    """etcd watch start-revision: arming with start_rev replays the
+    retained events from that revision atomically with the arm."""
+    coord.put("a/x", "1")
+    r2 = coord.put("a/y", "2")
+    coord.put("b/other", "x")
+    r4 = coord.put("a/x", "1b")
+    w = coord.watch("a/", start_rev=r2)
+    evs = w.get(timeout=2)
+    assert [(e.key, e.value, e.mod_rev) for e in evs] == [
+        ("a/y", "2", r2), ("a/x", "1b", r4)]
+    # And it stays live for future events.
+    r5 = coord.put("a/z", "3")
+    evs = w.get(timeout=2)
+    assert [(e.key, e.mod_rev) for e in evs] == [("a/z", r5)]
+    w.cancel()
+
+
 def test_put_get_delete(coord):
     rev1 = coord.put("a/x", "1")
     rev2 = coord.put("a/y", "2")
@@ -409,6 +508,88 @@ def test_sync_put_fails_fast_when_follower_dies_mid_barrier(
             f"did not fail fast on follower death: {result}")
     finally:
         c.close()
+
+
+def _drop_client_socket(c):
+    """Sever the client's TCP connection out from under it (simulated
+    network blip); the reader thread notices and reconnects."""
+    import socket as _socket
+
+    try:
+        c._sock.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass
+
+
+def test_remote_watch_resumes_from_revision_after_reconnect(
+        coord_server):
+    """Watch-reconnect replay (round 5): events that fire DURING a
+    connection outage are recovered from the server's MVCC event
+    history on re-arm — delivered in order, with NO epoch bump (no
+    snapshot re-list needed). Pre-MVCC the gap was lossy and every
+    reconnect forced a re-list."""
+    c = RemoteCoord(coord_server.address, reconnect_timeout=30.0)
+    try:
+        w = c.watch("svc/")
+        r1 = coord_server.state.put("svc/a", "1")
+        evs = w.get(timeout=5)
+        assert [e.mod_rev for e in evs] == [r1]
+
+        _drop_client_socket(c)
+        # These land while the client is disconnected.
+        r2 = coord_server.state.put("svc/b", "2")
+        r3 = coord_server.state.put("svc/a", "1b")
+        coord_server.state.put("other/x", "ignored")
+
+        got = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(got) < 2:
+            got.extend(w.get(timeout=1))
+        assert [(e.key, e.mod_rev) for e in got] == [
+            ("svc/b", r2), ("svc/a", r3)], (
+            "outage-window events not replayed on reconnect")
+        assert w.epoch == 0, (
+            "epoch bumped despite a successful replay resume — "
+            "consumers would re-list for nothing")
+    finally:
+        c.close()
+
+
+def test_remote_watch_relists_when_history_compacted():
+    """When the outage outlives the MVCC window the replay interval is
+    compacted: the client must fall back to a fresh watch WITH an
+    epoch bump (consumers re-list — the snapshot-then-delta contract),
+    and live events must flow again."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.service import CoordServer
+
+    server = CoordServer(
+        "127.0.0.1:0", CoordState(sweep_interval=0.05,
+                                  history_window=3))
+    c = RemoteCoord(server.address, reconnect_timeout=30.0)
+    try:
+        w = c.watch("svc/")
+        _drop_client_socket(c)
+        for i in range(8):  # > history_window: the gap compacts away
+            server.state.put("svc/k", str(i))
+        # Wait for the re-arm (epoch bump signals the fallback).
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and w.epoch == 0:
+            time.sleep(0.05)
+        assert w.epoch == 1, "no re-list signal after a compacted gap"
+        w.get(timeout=0.2)  # drain anything queued
+        rl = server.state.put("svc/live", "x")
+        got = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            got = [e for e in (got + w.get(timeout=1))
+                   if e.mod_rev == rl]
+            if got:
+                break
+        assert got, "watch dead after compacted-gap fallback"
+    finally:
+        c.close()
+        server.close()
 
 
 def test_sync_put_min_followers_refuses_unmirrored_ack(coord_server):

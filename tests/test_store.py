@@ -91,6 +91,18 @@ def test_serializable_accepted(store):
     assert store.get("k", with_serializable()) == ["v"]
 
 
+def test_with_rev_reads_history(store):
+    """WithRev (store_config.go:71-73): read the store as of an older
+    revision through the public option surface."""
+    from ptype_tpu.store import with_rev
+
+    store.put("cfg", "old")
+    rev = store.get_items("cfg")[0].mod_rev
+    store.put("cfg", "new")
+    assert store.get_one("cfg") == "new"
+    assert store.get_one("cfg", with_rev(rev)) == "old"
+
+
 def test_prefix_range_end_reexport():
     # ref: store_config.go:41-58
     assert get_prefix_range_end("store/a") == "store/b"
