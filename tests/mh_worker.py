@@ -1,0 +1,126 @@
+"""MULTIHOST.md walkthrough worker — one trainer process of the
+end-to-end drill (test_multihost_walkthrough.py).
+
+Follows the documented recipe EXACTLY (docs/MULTIHOST.md §Topology +
+§Coordinator availability): the coordination seed runs in its OWN
+process (not inside a trainer), a wal-stream standby guards it, and
+every trainer joins as a NON-coordinator with the full endpoint list
+``[seed, standby]``. The launcher SIGKILLs the seed mid-run: the data
+plane (multi-controller XLA collectives) must not miss a step, and the
+control plane (Store progress writes, registry keepalives) must ride
+the clients' reconnect loop onto the promoted standby.
+
+Usage: mh_worker.py <pid> <n_procs> <seed_addr> <standby_addr> <jax_port>
+Prints "STEP n" progress lines, then one JSON result line.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+STEPS = 6
+STEP_PACE_S = 1.0  # widen the run so the kill lands mid-training
+
+
+def main() -> None:
+    pid, n_procs = int(sys.argv[1]), int(sys.argv[2])
+    seed_addr, standby_addr, jax_port = (sys.argv[3], sys.argv[4],
+                                         sys.argv[5])
+
+    from ptype_tpu.cluster import join
+    from ptype_tpu.config import Config, PlatformConfig
+    from ptype_tpu.errors import CoordinationError
+
+    cfg = Config(
+        service_name="train", node_name=f"proc{pid}", port=22000 + pid,
+        initial_cluster_client_urls=[seed_addr, standby_addr],
+        platform=PlatformConfig(
+            name=f"proc{pid}", coordinator_address=seed_addr,
+            is_coordinator=False, lease_ttl=1.0,
+            num_processes=n_procs, process_id=pid,
+            jax_coordinator_address=f"127.0.0.1:{jax_port}",
+            mesh_axes={"data": 2 * n_procs},
+        ),
+    )
+    cluster = join(cfg)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import mesh_from_registry
+    from ptype_tpu.train import trainer as tr
+
+    deadline = time.time() + 30
+    while len(cluster.registry.services().get("train", [])) < n_procs:
+        if time.time() > deadline:
+            raise RuntimeError("peers never registered")
+        time.sleep(0.1)
+
+    mesh = mesh_from_registry(cluster.registry, "train",
+                              {"data": 2 * n_procs})
+    model_cfg = tfm.preset("tiny")
+    state, _ = tr.init_state(jax.random.PRNGKey(0), model_cfg, mesh)
+    step_fn = tr.make_train_step(model_cfg, mesh)
+    sh = NamedSharding(mesh, P("data", None))
+    rng = np.random.default_rng(42)
+    B, S = 2 * n_procs, 32
+
+    losses = []
+    outage_retries = 0
+    for i in range(STEPS):
+        tokens = rng.integers(0, model_cfg.vocab_size, (B, S),
+                              dtype=np.int32)
+        local = tokens[2 * pid:2 * (pid + 1)]
+        gtok = jax.make_array_from_process_local_data(sh, local, (B, S))
+        state, out = step_fn(state, {"tokens": gtok, "targets": gtok})
+        losses.append(float(out["loss"]))
+        # Control-plane write each step; during the failover window it
+        # raises and is retried — the documented client contract.
+        put_deadline = time.time() + 30
+        while True:
+            try:
+                cluster.store.put(f"progress/{pid}", str(i + 1))
+                break
+            except CoordinationError:
+                outage_retries += 1
+                if time.time() > put_deadline:
+                    raise
+                time.sleep(0.2)
+        print(f"STEP {i + 1}", flush=True)
+        time.sleep(STEP_PACE_S)
+
+    # Read back EVERY trainer's progress through whatever coordinator
+    # is serving now (post-failover: the promoted standby).
+    progress = {}
+    read_deadline = time.time() + 30
+    for j in range(n_procs):
+        while True:
+            try:
+                progress[str(j)] = cluster.store.get_one(f"progress/{j}")
+                break
+            except CoordinationError:
+                if time.time() > read_deadline:
+                    raise
+                time.sleep(0.2)
+
+    print(json.dumps({
+        "ready": True, "process_id": pid, "losses": losses,
+        "progress": progress, "outage_retries": outage_retries,
+        "coord_term": cluster.coord.term
+        if hasattr(cluster.coord, "term") else None,
+    }), flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

