@@ -82,15 +82,24 @@ def _serve() -> None:
     cfg = config_from_env()
     model_cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
     server = ActorServer(port=cfg.port)
-    # Dynamic batching: concurrent greedy requests coalesce into one
-    # decode round ($SERVE_WINDOW_MS/$SERVE_MAX_BATCH to tune; sampled
-    # requests run solo).
-    server.register(
-        BatchingGeneratorActor(
+    # $SERVE_MODE=continuous: slot-based continuous batching (requests
+    # join/leave the one running decode loop at step boundaries;
+    # $SERVE_SLOTS caches). Default: dynamic batching — concurrent
+    # greedy requests coalesce into one decode round
+    # ($SERVE_WINDOW_MS/$SERVE_MAX_BATCH to tune). Sampled requests
+    # run solo in both modes.
+    if os.environ.get("SERVE_MODE") == "continuous":
+        from ptype_tpu.serve import ContinuousGeneratorActor
+
+        actor = ContinuousGeneratorActor(
+            model_cfg,
+            n_slots=int(os.environ.get("SERVE_SLOTS", "8")))
+    else:
+        actor = BatchingGeneratorActor(
             model_cfg,
             window_ms=float(os.environ.get("SERVE_WINDOW_MS", "5")),
-            max_batch=int(os.environ.get("SERVE_MAX_BATCH", "32"))),
-        "Generator")
+            max_batch=int(os.environ.get("SERVE_MAX_BATCH", "32")))
+    server.register(actor, "Generator")
     server.serve()
     cfg.port = server.port
     cluster = join(cfg)

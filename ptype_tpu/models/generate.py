@@ -74,7 +74,11 @@ def _cached_attention(q, k_cache, v_cache, pos_limit, cfg,
 
     ``valid_from`` (B,), optional: per-row first valid cache slot —
     left-padded ragged prompts leave pad rows in slots
-    [0, valid_from); they stay masked for the row's whole decode."""
+    [0, valid_from); they stay masked for the row's whole decode.
+
+    ``pos_limit`` may be a scalar (uniform batch) or (B,) — per-row
+    limits are the continuous-batching case, where every slot is at
+    its own depth."""
     B, _, H, Dh = q.shape
     Kh = k_cache.shape[2]
     G = H // Kh
@@ -83,7 +87,11 @@ def _cached_attention(q, k_cache, v_cache, pos_limit, cfg,
                         k_cache).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(Dh))
     cols = jnp.arange(k_cache.shape[1])  # (Smax,)
-    mask = (cols < pos_limit)[None, :]
+    pos_limit = jnp.asarray(pos_limit)
+    if pos_limit.ndim == 1:
+        mask = cols[None, :] < pos_limit[:, None]  # (B, Smax)
+    else:
+        mask = (cols < pos_limit)[None, :]
     if valid_from is not None:
         mask = mask & (cols[None, :] >= valid_from[:, None])
     scores = jnp.where(mask[:, None, None, None, :], scores,
@@ -102,7 +110,8 @@ def _head_logits(params, x_last, cfg):
 
 def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
             cache: KVCache,
-            prompt_lens: jax.Array | None = None
+            prompt_lens: jax.Array | None = None,
+            last_index: jax.Array | None = None
             ) -> tuple[jax.Array, KVCache]:
     """Full-sequence forward, filling cache[:, :, :S]. Returns
     (last-position logits (B, V), cache). Block math is the shared
@@ -113,7 +122,13 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
     real prompt occupies columns [S - L_i, S). RoPE positions shift
     per row so every prompt starts at position 0, pad keys are masked
     out of attention, and the last column is every row's final real
-    token (which is why left-padding is the serving layout)."""
+    token (which is why left-padding is the serving layout).
+
+    ``last_index`` (B,), optional: return logits at these columns
+    instead of the last — the RIGHT-padded layout continuous batching
+    prefills slots with (each slot's prompt occupies [0, L_i), so its
+    final real token sits at column L_i - 1, and decode writes grow
+    from L_i, overwriting the never-attended pad garbage)."""
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     if prompt_lens is None:
@@ -149,7 +164,9 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
     x, (kcs, vcs) = lax.scan(body, x,
                              (params["blocks"], cache.k, cache.v))
     x = tfm.rms_norm(x, params["final_norm"])
-    return _head_logits(params, x[:, -1], cfg), KVCache(kcs, vcs)
+    x_last = (x[:, -1] if last_index is None
+              else x[jnp.arange(B), last_index])
+    return _head_logits(params, x_last, cfg), KVCache(kcs, vcs)
 
 
 def decode_step(params: dict, token: jax.Array, pos: jax.Array,
@@ -180,6 +197,35 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         o = _cached_attention(q, kc, vc, pos + 1, cfg,
                               valid_from=valid_from)
+        x = tfm.attn_residual(x, o, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = lax.scan(body, x,
+                             (params["blocks"], cache.k, cache.v))
+    x = tfm.rms_norm(x, params["final_norm"])
+    return _head_logits(params, x[:, 0], cfg), KVCache(kcs, vcs)
+
+
+def decode_step_ragged(params: dict, token: jax.Array,
+                       pos: jax.Array, cfg: tfm.TransformerConfig,
+                       cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """One decode step with PER-ROW cache depths — the continuous-
+    batching engine step (serve.ContinuousGeneratorActor): every slot
+    is mid-decode at its own position, so ``pos`` is (B,), each row
+    writes its K/V at its own slot and attends to its own prefix.
+    Slots are RIGHT-aligned (prompt at [0, L)), so cache slot and
+    token position coincide and RoPE uses ``pos`` directly."""
+    B = token.shape[0]
+    x = params["embed"][token][:, None, :].astype(cfg.dtype)
+    sin, cos = tfm.rope_tables(cfg, positions=pos[:, None])
+
+    def body(x, inputs):
+        layer, kc, vc = inputs  # kc/vc: (B, Smax, Kh, Dh)
+        q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+        kc = kc.at[jnp.arange(B), pos].set(k[:, 0])
+        vc = vc.at[jnp.arange(B), pos].set(v[:, 0])
+        o = _cached_attention(q, kc, vc, pos + 1, cfg)
         x = tfm.attn_residual(x, o, layer, cfg)
         x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
         return x, (kc, vc)

@@ -169,3 +169,159 @@ def test_batching_generator_coalesces_mixed_lengths():
         assert info["batches"] < len(prompts), info
     finally:
         actor.close()
+
+
+# -------------------------------------------------- continuous batching
+
+
+def test_continuous_engine_rows_match_solo():
+    """Continuous batching parity: concurrent mixed-length greedy
+    requests — including ones that JOIN while others are mid-decode —
+    each produce exactly their solo decode (slots are right-aligned
+    and independent; VERDICT r4 #5's 'done' bar)."""
+    import threading
+    import time
+
+    from ptype_tpu.models import generate as gen
+    from ptype_tpu.serve import ContinuousGeneratorActor
+
+    actor = ContinuousGeneratorActor(CFG, n_slots=4)
+    try:
+        rng = np.random.default_rng(3)
+        lens = (3, 7, 5, 9, 4, 6)
+        news = (6, 12, 9, 5, 10, 7)
+        prompts = [jnp.asarray(rng.integers(1, CFG.vocab_size, n),
+                               jnp.int32)[None] for n in lens]
+        outs = [None] * len(prompts)
+
+        def call(i, delay):
+            time.sleep(delay)  # staggered joins: mid-flight admission
+            outs[i] = actor.Generate(prompts[i], news[i])
+
+        threads = [threading.Thread(target=call,
+                                    args=(i, 0.05 * (i % 3)))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            want = gen.generate(actor.params, CFG, p, news[i])
+            np.testing.assert_array_equal(np.asarray(outs[i]),
+                                          np.asarray(want),
+                                          err_msg=f"req {i}")
+        info = actor.Info()
+        # 6 requests over 4 slots: the bank actually multiplexed.
+        assert info["max_live_slots"] >= 2, info
+        assert info["calls"] == 6, info
+    finally:
+        actor.close()
+
+
+def test_continuous_engine_stop_token_frees_slot_early():
+    """A stop token retires its slot mid-loop (static shapes, dynamic
+    occupancy): output matches gen.generate's stop semantics (stop
+    kept, rest padded), and the engine spent FEWER steps than max_new
+    would cost."""
+    from ptype_tpu.models import generate as gen
+    from ptype_tpu.serve import ContinuousGeneratorActor
+
+    actor = ContinuousGeneratorActor(CFG, n_slots=2)
+    try:
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        max_new = 24
+        solo = gen.generate(actor.params, CFG, prompt, max_new)
+        # Choose the 3rd emitted token as the "stop" so the run must
+        # retire early; pad token 7 to make the padding observable.
+        stop = int(np.asarray(solo)[0, 2])
+        out = actor.Generate(prompt, max_new, stop_token=stop,
+                             pad_token=7)
+        want = gen.generate(actor.params, CFG, prompt, max_new,
+                            stop_token=stop, pad_token=7)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(want))
+        assert actor.Info()["engine_steps"] < max_new, (
+            "stop token did not retire the slot early")
+    finally:
+        actor.close()
+
+
+def test_continuous_engine_multirow_and_solo_fallback():
+    """(B, S) requests split across slots and re-assemble in order;
+    sampled requests keep exact solo RNG semantics via the fallback."""
+    from ptype_tpu.models import generate as gen
+    from ptype_tpu.serve import ContinuousGeneratorActor
+
+    actor = ContinuousGeneratorActor(CFG, n_slots=4)
+    try:
+        prompt = jnp.arange(8, dtype=jnp.int32).reshape(2, 4) + 1
+        out = actor.Generate(prompt, 6)
+        want = gen.generate(actor.params, CFG, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+        s = actor.Generate(jnp.zeros((1, 4), jnp.int32), 3,
+                           temperature=0.7, seed=11)
+        want = gen.generate(actor.params, CFG,
+                            jnp.zeros((1, 4), jnp.int32), 3, 0.7,
+                            jax.random.PRNGKey(11))
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(want))
+    finally:
+        actor.close()
+
+
+def test_continuous_engine_throughput_beats_serialized():
+    """The capacity argument, measured: under concurrent mixed-length
+    greedy load the continuous engine must beat the lock-serialized
+    actor by >= 1.5x wall clock (VERDICT r4 #5's bar). Both actors are
+    warmed first so this compares steady-state serving, not compiles.
+
+    Measured on a config big enough that per-step COMPUTE dominates
+    per-step dispatch (the tiny preset is dispatch-bound on CPU, which
+    measures Python overhead, not serving capacity: a B=8 step costs
+    ~2x a B=1 step here, so sharing the loop across 8 requests wins
+    ~4x; on TPU the gap is wider still)."""
+    import threading
+    import time
+
+    from ptype_tpu.serve import ContinuousGeneratorActor
+
+    cfg_perf = tfm.preset("tiny", d_model=256, n_layers=4, d_ff=512,
+                          dtype=jnp.float32)
+    lens = (3, 7, 5, 9, 4, 6, 8, 5)
+    news = (24, 28, 24, 28, 24, 28, 24, 28)
+    rng = np.random.default_rng(5)
+    prompts = [jnp.asarray(rng.integers(1, cfg_perf.vocab_size, n),
+                           jnp.int32)[None] for n in lens]
+
+    def drive(actor):
+        outs = [None] * len(prompts)
+        # np.asarray BLOCKS: the solo path returns an async-dispatched
+        # device array, and unforced results would time dispatch
+        # instead of serving (and bleed compute into the next drive).
+        threads = [threading.Thread(
+            target=lambda i=i: outs.__setitem__(
+                i, np.asarray(actor.Generate(prompts[i], news[i]))))
+            for i in range(len(prompts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        dt = time.perf_counter() - t0
+        return dt, outs
+
+    serialized = GeneratorActor(cfg_perf)
+    continuous = ContinuousGeneratorActor(
+        cfg_perf, params=serialized.params, n_slots=8)
+    try:
+        drive(serialized)   # warm both: compile every shape involved
+        drive(continuous)
+        t_serial, outs_a = drive(serialized)
+        t_cont, outs_b = drive(continuous)
+        for a, b in zip(outs_a, outs_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        speedup = t_serial / t_cont
+        assert speedup > 1.5, (
+            f"continuous batching speedup {speedup:.2f}x "
+            f"(serialized {t_serial:.3f}s, continuous {t_cont:.3f}s)")
+    finally:
+        continuous.close()
