@@ -136,6 +136,257 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
     return y_mb.reshape(B, *y_mb.shape[2:])
 
 
+def schedule_info(n_stages: int, n_microbatches: int,
+                  schedule: str = "gpipe") -> dict:
+    """Tick/stash/bubble accounting for a schedule — the numbers the
+    1F1B-vs-GPipe tradeoff is made of.
+
+    One *tick* is one scan iteration of the compiled SPMD program.
+    GPipe runs two uniform phases (a forward scan then, via autodiff,
+    a reversed backward scan): every stage stashes ALL M microbatch
+    activations for the backward. 1F1B runs ONE combined scan whose
+    steady-state ticks each do one real forward AND one real backward
+    microbatch — the live stash is bounded by the schedule depth
+    (2S-1), NOT by M. That bound is the whole point: at a fixed
+    activation budget, 1F1B can raise M until the bubble fraction
+    (idle ticks / total ticks) is driven down, where GPipe's stash
+    grows linearly with M and caps it first.
+    """
+    S, M = n_stages, n_microbatches
+    if schedule == "gpipe":
+        return {
+            "ticks": 2 * (M + S - 1),
+            "stash_microbatches": M,
+            "bubble_fraction": (S - 1) / (M + S - 1),
+        }
+    if schedule == "1f1b":
+        ticks = M + 2 * S - 1
+        return {
+            "ticks": ticks,
+            "stash_microbatches": 2 * S - 1,
+            "bubble_fraction": (2 * S - 2) / ticks,
+        }
+    raise ClusterError(f"unknown pipeline schedule {schedule!r}")
+
+
+def _spmd_pipeline_1f1b(stage_fn, tail_fn, stage_params, wnorm, head,
+                        x_mb, tgt_mb, mask_mb, *, axis: str,
+                        n_stages: int, n_microbatches: int):
+    """Hand-scheduled 1F1B inside shard_map: one scan, each tick runs
+    one forward microbatch AND one (rematerialized-VJP) backward
+    microbatch where the schedule has work for this stage.
+
+    Schedule (0-based tick t, stage s):
+    - forward of microbatch m at  t = m + s,
+    - stage S-1 computes the tail (final-norm + LM head + loss) VJP in
+      the same tick its forward finishes, carrying the cotangent one
+      tick to its own backward,
+    - backward of microbatch m at t = m + S + (S-1-s)  — so a stage's
+      gap between fwd(m) and bwd(m) is 2S-1-2s ticks, which bounds the
+      live input stash at 2S-1 (vs GPipe's M).
+
+    Backward is recomputed from the stashed INPUT (``jax.vjp`` on the
+    stage at backward time) — the per-stage rematerialization
+    jax.checkpoint would do anyway, which is what keeps the stash to
+    inputs instead of full VJP residuals.
+
+    Returns per-stage block grads (leading singleton stage dim), the
+    psum'd tail grads (norm/head), the input cotangents (stage 0), and
+    unnormalized (nll_sum, denom) accumulators from stage S-1.
+    """
+    stage = lax.axis_index(axis)
+    S, M = n_stages, n_microbatches
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    mb_shape = x_mb.shape[1:]
+    K = 2 * S  # stash ring slots (schedule bound is 2S-1)
+    is_last = stage == S - 1
+    is_first = stage == 0
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    zeros_mb = jnp.zeros(mb_shape, x_mb.dtype)
+    carry0 = {
+        "fwd_in": zeros_mb,
+        "bwd_ct": zeros_mb,
+        "self_ct": zeros_mb,
+        "stash": jnp.zeros((K, *mb_shape), x_mb.dtype),
+        "gblocks": jax.tree.map(jnp.zeros_like, params),
+        "gnorm": jnp.zeros_like(wnorm),
+        "ghead": jnp.zeros_like(head),
+        "xct": jnp.zeros_like(x_mb),
+        "nll": jnp.float32(0.0),
+        "den": jnp.float32(0.0),
+    }
+
+    def tick(c, t):
+        # ---------------- forward op: microbatch m_f = t - stage
+        m_f = t - stage
+        fwd_valid = (m_f >= 0) & (m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(is_first, x_mb[m_f_c], c["fwd_in"])
+        y = stage_fn(params, x_in)
+        stash = jnp.where(
+            fwd_valid,
+            lax.dynamic_update_index_in_dim(c["stash"], x_in, t % K, 0),
+            c["stash"])
+        # Tail (norm+head+loss) VJP on the stage that just produced
+        # final activations; its cotangent seeds this stage's OWN
+        # backward next tick. (Masked on other stages — SPMD has no
+        # per-device control flow.)
+        (nll_m, den_m), tail_vjp = jax.vjp(
+            lambda wn, hd, yy: tail_fn(wn, hd, yy, tgt_mb[m_f_c],
+                                       mask_mb[m_f_c]),
+            wnorm, head, y)
+        dwn, dhd, dy = tail_vjp((jnp.float32(1.0), jnp.float32(0.0)))
+        tail_valid = is_last & fwd_valid
+        nll = c["nll"] + jnp.where(tail_valid, nll_m, 0.0)
+        den = c["den"] + jnp.where(tail_valid, den_m, 0.0)
+        gnorm = c["gnorm"] + jnp.where(tail_valid, dwn, 0.0)
+        ghead = c["ghead"] + jnp.where(tail_valid, dhd, 0.0)
+        self_ct = jnp.where(tail_valid, dy.astype(x_mb.dtype),
+                            zeros_mb)
+
+        # --------------- backward op: microbatch m_b = t-(2S-1)+stage
+        m_b = t - (2 * S - 1) + stage
+        bwd_valid = (m_b >= 0) & (m_b < M)
+        x_saved = c["stash"][(m_b + stage) % K]
+        ct_in = jnp.where(is_last, c["self_ct"], c["bwd_ct"])
+        _, stage_vjp = jax.vjp(stage_fn, params, x_saved)
+        dparams, dx = stage_vjp(ct_in)
+        gblocks = jax.tree.map(
+            lambda acc, g: acc + jnp.where(bwd_valid, g, 0.0),
+            c["gblocks"], dparams)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        xct = jnp.where(
+            is_first & bwd_valid,
+            lax.dynamic_update_index_in_dim(c["xct"], dx, m_b_c, 0),
+            c["xct"])
+
+        # --------------- ring communication for the NEXT tick
+        nxt = {
+            "fwd_in": lax.ppermute(y, axis, fwd_perm),
+            "bwd_ct": lax.ppermute(dx, axis, bwd_perm),
+            "self_ct": self_ct,
+            "stash": stash,
+            "gblocks": gblocks,
+            "gnorm": gnorm,
+            "ghead": ghead,
+            "xct": xct,
+            "nll": nll,
+            "den": den,
+        }
+        return nxt, None
+
+    ticks = M + 2 * S - 1
+    c, _ = lax.scan(tick, carry0, jnp.arange(ticks))
+
+    # Stage-local accumulators → the global values each out_spec wants.
+    last = is_last.astype(jnp.float32)
+    first = is_first
+    gblocks = jax.tree.map(lambda g: g[None], c["gblocks"])
+    return (
+        gblocks,
+        lax.psum(c["gnorm"] * last, axis),
+        lax.psum(c["ghead"] * last, axis),
+        lax.psum(jnp.where(first, c["xct"],
+                           jnp.zeros_like(c["xct"])), axis),
+        lax.psum(c["nll"] * last, axis),
+        lax.psum(c["den"] * last, axis),
+    )
+
+
+def pipeline_loss_and_grads_1f1b(params: dict, batch: dict, cfg,
+                                 mesh: Mesh, n_microbatches: int,
+                                 axis: str = "stage"):
+    """(loss, grads) for the transformer with the block stack pipelined
+    under the 1F1B schedule — the hand-written counterpart of
+    ``jax.value_and_grad`` over :func:`transformer_pipeline_forward`
+    (which autodiff turns into GPipe: full forward scan, then reversed
+    backward scan, stashing all M microbatch activations per stage).
+    Embedding lookup and its scatter-add gradient stay outside the
+    ring, fed by the stage-0 input cotangents."""
+    from ptype_tpu.models import transformer as tfm
+
+    if cfg.n_experts:
+        raise ClusterError(
+            "pipeline parallelism does not support MoE configs yet "
+            "(router aux loss would be dropped); use dp/fsdp/tp/ep")
+    S = int(mesh.shape[axis])
+    M = n_microbatches
+    B, T = batch["tokens"].shape
+    if B % M:
+        raise ClusterError(
+            f"pipeline: batch {B} not divisible into {M} microbatches")
+    mb = B // M
+    tokens_mb = batch["tokens"].reshape(M, mb, T)
+    tgt_mb = batch["targets"].reshape(M, mb, T)
+    # An all-ones mask is numerically identical to no mask (denom =
+    # token count) and keeps the shard_map arg tree static.
+    mask_mb = (jnp.ones((M, mb, T), jnp.float32)
+               if batch.get("loss_mask") is None
+               else batch["loss_mask"].reshape(M, mb, T))
+    x_mb = params["embed"][tokens_mb].astype(cfg.dtype)
+    sin, cos = tfm.rope_tables(cfg, T)
+    stage_blocks = split_stages(params["blocks"], S)
+    head = tfm._head_weight(params, cfg)
+    wnorm = params["final_norm"]
+
+    def stage_fn(blocks, x):
+        def body(x, layer):
+            x, _aux = tfm._block(x, layer, sin, cos, cfg,
+                                 tfm._attention)
+            return x, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, blocks)
+        return x
+
+    def tail_fn(wn, hd, y, tgt, mask):
+        x = tfm.rms_norm(y, wn)
+        logits = tfm.head_logits(x, hd, cfg)
+        return tfm.nll_terms_from_logits(
+            logits, {"targets": tgt, "loss_mask": mask})
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *(None,) * (p.ndim - 1)), stage_blocks)
+    fn = shard_map(
+        partial(_spmd_pipeline_1f1b, stage_fn, tail_fn, axis=axis,
+                n_stages=S, n_microbatches=M),
+        mesh=mesh,
+        in_specs=(param_specs, P(), P(), P(), P(), P()),
+        out_specs=(param_specs, P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+    gblocks, gnorm, ghead, xct, nll, den = fn(
+        stage_blocks, wnorm, head, x_mb, tgt_mb, mask_mb)
+
+    # Unnormalized sums accumulate in-ring; normalize ONCE here so the
+    # loss/grads are invariant to M (trainer.py's accumulation rule).
+    loss = nll / den
+    inv = (1.0 / den).astype(jnp.float32)
+
+    def scale(g):
+        return (g * inv).astype(g.dtype)
+
+    # Embedding grad: scatter-add of the stage-0 input cotangents,
+    # plus the tied head's transpose contribution.
+    xct = xct.reshape(B, T, -1).astype(jnp.float32) * inv
+    dembed = (jnp.zeros_like(params["embed"])
+              .at[batch["tokens"]].add(xct))
+    grads = {
+        "blocks": jax.tree.map(scale, merge_stages(gblocks)),
+        "final_norm": scale(gnorm),
+        "embed": dembed,
+    }
+    if cfg.tie_embeddings:
+        grads["embed"] = grads["embed"] + scale(ghead).T
+    else:
+        grads["lm_head"] = scale(ghead)
+    return loss, grads
+
+
 # ------------------------------------------------- transformer integration
 
 
@@ -212,7 +463,8 @@ def pipeline_state_shardings(params_like, mesh: Mesh, optimizer,
 
 def make_pipeline_train_step(cfg, mesh: Mesh, n_microbatches: int,
                              optimizer=None, axis: str = "stage",
-                             state_shardings=None):
+                             state_shardings=None,
+                             schedule: str = "gpipe"):
     """(state, batch) → (state, metrics) with the block stack pipelined.
 
     State layout matches train/trainer.py's TrainState, so checkpoints
@@ -221,6 +473,12 @@ def make_pipeline_train_step(cfg, mesh: Mesh, n_microbatches: int,
     each stage's layers — and their Adam moments — to that stage's
     devices; without it the state is replicated (fine for tests, wrong
     for models sized to per-stage memory).
+
+    ``schedule``: "gpipe" (autodiff: forward scan + reversed backward
+    scan, stash = M microbatch activations/stage) or "1f1b"
+    (hand-scheduled combined scan, stash bounded at 2S-1 — see
+    :func:`schedule_info` for the accounting that makes 1F1B the
+    memory-bound choice that lets M, and therefore the bubble, scale).
     """
     import optax
 
@@ -228,6 +486,8 @@ def make_pipeline_train_step(cfg, mesh: Mesh, n_microbatches: int,
     from ptype_tpu.train.trainer import TrainState, default_optimizer
 
     optimizer = optimizer or default_optimizer()
+    if schedule not in ("gpipe", "1f1b"):
+        raise ClusterError(f"unknown pipeline schedule {schedule!r}")
 
     def loss_fn(p, batch):
         logits = transformer_pipeline_forward(
@@ -236,7 +496,12 @@ def make_pipeline_train_step(cfg, mesh: Mesh, n_microbatches: int,
         return tfm.nll_from_logits(logits, batch)
 
     def step(state: TrainState, batch: dict):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if schedule == "1f1b":
+            loss, grads = pipeline_loss_and_grads_1f1b(
+                state.params, batch, cfg, mesh, n_microbatches, axis)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params,
+                                                      batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -133,3 +133,102 @@ def test_pipeline_refuses_moe():
     toks = jnp.zeros((2, 8), jnp.int32)
     with pytest.raises(ClusterError, match="MoE"):
         transformer_pipeline_forward(params, toks, cfg, mesh, 2)
+
+
+# ----------------------------------------------------------------- 1F1B
+
+
+def test_1f1b_loss_and_grads_match_gpipe():
+    """The hand-scheduled 1F1B path (rematerialized per-stage VJPs,
+    in-ring grad accumulation, tail VJP on the last stage) computes
+    the SAME loss and grads as autodiff through the GPipe pipeline —
+    at 4 stages x 8 microbatches (VERDICT r4 #7's shape)."""
+    from ptype_tpu.models import transformer as tfm  # noqa: F811
+    from ptype_tpu.parallel.pipeline import pipeline_loss_and_grads_1f1b
+
+    mesh = build_mesh({"stage": 4})
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, CFG.vocab_size, jnp.int32)
+    batch = {"tokens": toks, "targets": toks}
+
+    def gpipe_loss(p):
+        logits = transformer_pipeline_forward(p, toks, CFG, mesh, 8)
+        return tfm.nll_from_logits(logits, batch)
+
+    l_ref, g_ref = jax.value_and_grad(gpipe_loss)(params)
+    l_got, g_got = jax.jit(
+        lambda p, b: pipeline_loss_and_grads_1f1b(p, b, CFG, mesh, 8)
+    )(params, batch)
+
+    np.testing.assert_allclose(float(l_got), float(l_ref), rtol=1e-5)
+    ref_leaves = jax.tree_util.tree_leaves_with_path(g_ref)
+    got = dict(jax.tree_util.tree_leaves_with_path(g_got))
+    assert set(got) == {p for p, _ in ref_leaves}
+    for path, leaf in ref_leaves:
+        np.testing.assert_allclose(
+            np.asarray(got[path]), np.asarray(leaf),
+            rtol=2e-3, atol=2e-5, err_msg=str(path))
+
+
+def test_1f1b_train_step_parity_and_masked_loss():
+    """schedule="1f1b" drops into make_pipeline_train_step: same
+    TrainState layout, losses tracking the GPipe schedule step for
+    step; loss_mask honored identically."""
+    from ptype_tpu.parallel.pipeline import pipeline_state_shardings
+    from ptype_tpu.train.trainer import TrainState, default_optimizer
+
+    mesh = build_mesh({"stage": 4})
+    opt = default_optimizer()
+
+    def run(schedule):
+        # Fresh params per run: the jitted step donates its state.
+        params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+        state = TrainState(params, opt.init(params),
+                           jnp.zeros((), jnp.int32))
+        sh = pipeline_state_shardings(params, mesh, opt)
+        state = jax.device_put(state, sh)
+        step = make_pipeline_train_step(CFG, mesh, n_microbatches=8,
+                                        optimizer=opt,
+                                        state_shardings=sh,
+                                        schedule=schedule)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, CFG.vocab_size,
+            jnp.int32)
+        mask = (toks % 3 != 0).astype(jnp.float32)
+        losses = []
+        for _ in range(3):
+            state, out = step(state, {"tokens": toks, "targets": toks,
+                                      "loss_mask": mask})
+            losses.append(float(out["loss"]))
+        return losses
+
+    gpipe, f1b = run("gpipe"), run("1f1b")
+    np.testing.assert_allclose(f1b, gpipe, rtol=1e-4)
+    assert f1b[-1] < f1b[0]
+
+
+def test_1f1b_schedule_accounting():
+    """The tradeoff in numbers (4 stages, 8 microbatches): 1F1B bounds
+    the live activation stash at 2S-1 instead of GPipe's M — so at a
+    FIXED activation budget it runs more microbatches, and the bubble
+    fraction falls. This is the step-count accounting behind choosing
+    1F1B for deep pipelines."""
+    from ptype_tpu.parallel.pipeline import schedule_info
+
+    S, M = 4, 8
+    gp, fb = (schedule_info(S, M, "gpipe"), schedule_info(S, M, "1f1b"))
+    # Memory: the stash bound is the schedule depth, not M.
+    assert fb["stash_microbatches"] == 2 * S - 1 == 7
+    assert gp["stash_microbatches"] == M == 8
+    # At the activation budget GPipe needs for M=8, 1F1B fits M=8 too
+    # AND has ticks to spare; scale M at fixed stash and the bubble
+    # shrinks where GPipe's memory grows linearly instead.
+    budget = gp["stash_microbatches"]  # what GPipe spent at M=8
+    gp_at_budget = schedule_info(S, budget, "gpipe")
+    fb_scaled = schedule_info(S, 4 * M, "1f1b")
+    assert fb_scaled["stash_microbatches"] == 7 < 4 * M
+    assert (fb_scaled["bubble_fraction"]
+            < gp_at_budget["bubble_fraction"])
+    with pytest.raises(ClusterError):
+        schedule_info(S, M, "nope")
