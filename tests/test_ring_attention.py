@@ -216,3 +216,42 @@ def test_train_step_with_ring_attention():
     state, out = step(state, batch)
     assert np.isfinite(float(out["loss"]))
     assert int(out["step"]) == 1
+
+
+def test_ring_chunked_scores_match_dense_fwd_and_grad():
+    """Flash-in-ring (VERDICT r4 weak #6): with score_chunk forced
+    well below S_loc the fused inner loop runs MANY key chunks per
+    ring step, carrying (m, l, acc) across both loops — values AND
+    gradients must still match dense attention exactly (the online
+    softmax is associative, so chunking cannot change the math)."""
+    mesh = build_mesh({"seq": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(3), S=64)
+    # S_loc = 16 per device; chunk 4 → 4 chunks per ring step.
+    attn = make_ring_attention(mesh, score_chunk=4)
+
+    got = attn(q, k, v, CFG)
+    want = _dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(attn(q, k, v, CFG) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense(q, k, v) ** 2)
+
+    gr = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_chunk_width_picks_divisor():
+    from ptype_tpu.parallel.ring_attention import _chunk_width
+
+    assert _chunk_width(1024, 512) == 512
+    assert _chunk_width(256, 512) == 256  # chunk clamps to S_loc
+    assert _chunk_width(96, 64) == 48     # largest divisor <= 64
+    assert _chunk_width(7, 4) == 1        # prime: degrades, not errors
