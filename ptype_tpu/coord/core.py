@@ -198,6 +198,11 @@ class Watch:
         #: Highest mod_rev delivered through this watch (or the arm-
         #: time head revision) — the resume point for reconnect replay.
         self.last_rev = 0
+        #: Head revision at arm time, IMMUTABLE after arming — what a
+        #: remote client may safely adopt as its initial resume floor.
+        #: (last_rev races live pushes by the pump; reading it outside
+        #: the state lock could skip an event queued-but-undelivered.)
+        self.arm_rev = 0
         self._cancel_fn = cancel_fn
         self._cond = threading.Condition()
         self._events: list[Event] = []
@@ -886,7 +891,7 @@ class CoordState:
                     f"head {self._rev} — uncovered interval, treat "
                     f"as compacted")
             w = Watch(self._next_watch, prefix, self._remove_watch)
-            w.last_rev = self._rev
+            w.last_rev = w.arm_rev = self._rev
             self._next_watch += 1
             if start_rev:
                 replay = [ev for ev in self._event_log

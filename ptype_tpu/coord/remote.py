@@ -217,6 +217,12 @@ class RemoteCoord(CoordBackend):
                     with self._watches_lock:
                         for w in self._watches.values():
                             w._armed = False
+                        # Stashed pushes are scoped to the DEAD
+                        # connection's watch-id space: after a failover
+                        # a fresh CoordState numbers watches from
+                        # scratch, and a stale stash could drain into
+                        # an unrelated (wrong-prefix) new watch.
+                        self._orphan_events.clear()
                     if self._closed.is_set() or not self._try_reconnect():
                         break
                     continue
@@ -237,6 +243,7 @@ class RemoteCoord(CoordBackend):
             self._fail_pending()
             with self._watches_lock:
                 watches, self._watches = list(self._watches.values()), {}
+                self._orphan_events.clear()
             for w in watches:
                 w.cancel()
 

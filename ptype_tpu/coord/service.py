@@ -51,7 +51,8 @@ class CoordServer:
                  bump_term: bool | int = False,
                  fsync: bool = False,
                  witness_addr: str | None = None,
-                 witness_ttl: float = 3.0):
+                 witness_ttl: float = 3.0,
+                 witness_holder: str | None = None):
         # bump_term marks this server a PROMOTED successor: the
         # recovered state's fencing term is incremented (by that many
         # slots — juniors promoting past unresponsive seniors skip
@@ -104,6 +105,13 @@ class CoordServer:
         # (raft partition behavior, ref cluster_test.go:47-167).
         self._witness_addr = witness_addr
         self._witness_ttl = witness_ttl
+        #: The identity renewals run under. A promoted standby MUST
+        #: pass the exact string it acquired the lease with (its
+        #: configured listen address) — the getsockname-derived
+        #: self.address can differ ('0.0.0.0' binds, hostnames), and a
+        #: mismatched renewal would read as a different holder and
+        #: hard-fence the fresh primary within one TTL.
+        self._witness_holder = witness_holder or self.address
         #: Monotonic deadline until which this server may serve. One
         #: boot-time TTL of grace so a seed can start while the
         #: witness is briefly unreachable.
@@ -134,7 +142,7 @@ class CoordServer:
         votes = 0
         try:
             reply = _witness.renew(
-                self._witness_addr, holder=self.address,
+                self._witness_addr, holder=self._witness_holder,
                 term=self.state.term,
                 timeout=max(0.3, self._witness_ttl / 3))
             if reply.get("granted"):
@@ -272,7 +280,17 @@ class CoordServer:
         # that never saw the successor's term (the hole the term fence
         # alone cannot close). stale=True makes clients bounce to the
         # other endpoints where the real primary lives.
+        #
+        # Exception: repl_subscribe passes a SOFT (quorum-lost) fence —
+        # a returning follower's round-trips ARE the second vote, so
+        # refusing the subscription would make the fence permanent even
+        # with a healthy primary+standby pair (witness down + one
+        # follower blip). A hard-superseded primary still refuses: a
+        # successor exists and mirrors must re-home to it.
         fence = self._fenced()
+        if (fence is not None and op == "repl_subscribe"
+                and self._superseded is None):
+            fence = None
         if fence is not None:
             try:
                 wire.send_msg(conn, send_lock, {
@@ -312,8 +330,12 @@ class CoordServer:
                     msg["prefix"], start_rev=msg.get("start_rev", 0))
                 with watches_lock:
                     watches[pump_watch.id] = pump_watch
+                # arm_rev, NOT state.revision: a put can land between
+                # the arm and this read — its event is queued in the
+                # watch, and a floor above the arm revision would skip
+                # it on a reconnect before the pump delivers.
                 result = {"id": pump_watch.id,
-                          "rev": self.state.revision}
+                          "rev": pump_watch.arm_rev}
             elif op == "repl_subscribe":
                 # Same ordering contract as watch: the snapshot that
                 # heads the feed must not hit the wire before the

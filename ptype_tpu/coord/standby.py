@@ -646,7 +646,8 @@ class Standby:
                                       bump_term=1 + len(self._seniors()),
                                       fsync=self._fsync,
                                       witness_addr=self._witness_addr,
-                                      witness_ttl=self._witness_ttl)
+                                      witness_ttl=self._witness_ttl,
+                                      witness_holder=self.listen_address)
         except Exception as e:  # noqa: BLE001 — retried by the monitor
             log.warning("standby promotion failed; will retry",
                         kv={"err": str(e)})
@@ -743,7 +744,8 @@ class Standby:
                     bump_term=1 + len(self._seniors()),
                     fsync=self._fsync,
                     witness_addr=self._witness_addr,
-                    witness_ttl=self._witness_ttl)
+                    witness_ttl=self._witness_ttl,
+                    witness_holder=self.listen_address)
                 break
             except Exception as e:  # noqa: BLE001 — fence / transient
                 if _time.monotonic() > deadline:

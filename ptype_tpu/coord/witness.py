@@ -118,7 +118,15 @@ class WitnessServer:
         tmp = self._state_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"holder": self._holder, "term": self._term}, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._state_path())
+        # Always durable (writes happen only at holder/term changes —
+        # rare): a restart that forgot the lease would grant a second,
+        # lower-term one, exactly the split brain persistence prevents.
+        from ptype_tpu.coord.core import fsync_dir
+
+        fsync_dir(self._data_dir)
 
     # ------------------------------------------------------------- votes
 

@@ -382,6 +382,10 @@ class ContinuousGeneratorActor(GeneratorActor):
                                     pad_token, repetition_penalty)
         prompt = _norm_prompt(prompt)
         max_new = int(max_new_tokens)
+        if max_new <= 0:
+            # Nothing to generate: don't occupy a slot (and don't let
+            # the engine emit into a zero-width output).
+            return jnp.zeros((prompt.shape[0], 0), jnp.int32)
         if prompt.shape[1] + max_new > self.reach:
             raise ValueError(
                 f"prompt {prompt.shape[1]} + max_new {max_new} exceeds "
@@ -456,6 +460,29 @@ class ContinuousGeneratorActor(GeneratorActor):
         self._slot_state.pop(slot).done.set()
 
     def _engine(self) -> None:
+        """Engine thread wrapper: ANY escape from the loop — clean
+        close or an unexpected error (compile failure in a new prefill
+        bucket, device OOM) — must fail every pending row, or callers
+        blocked in ``done.wait()`` hang forever while the dead actor
+        keeps accepting requests."""
+        err: Exception | None = None
+        try:
+            self._engine_loop()
+        except Exception as e:  # noqa: BLE001 — delivered to callers
+            err = e
+            log.warning("generation engine died",
+                        kv={"err": repr(e)})
+        with self._cond:
+            self._closed = True
+            stragglers, self._queue = self._queue, []
+        for slot in list(self._slot_state):
+            stragglers.append(self._slot_state.pop(slot))
+        for r in stragglers:
+            if not r.done.is_set():
+                r.err = err or RuntimeError("generator actor closed")
+                r.done.set()
+
+    def _engine_loop(self) -> None:
         import numpy as np
 
         while True:
@@ -464,8 +491,7 @@ class ContinuousGeneratorActor(GeneratorActor):
                        and not self._closed):
                     self._cond.wait()
                 if self._closed:
-                    queue, self._queue = self._queue, []
-                    break
+                    return
                 # Admission: fill free slots at this step boundary —
                 # co-batched requests may be mid-decode right now.
                 free = [s for s in range(self.n_slots)
@@ -496,15 +522,6 @@ class ContinuousGeneratorActor(GeneratorActor):
                             and t == row.stop_token)):
                     self._retire(slot)  # leaves mid-loop: capacity
                     # freed here is reused at the NEXT step boundary.
-        for r in queue:
-            if not r.done.is_set():
-                r.err = RuntimeError("generator actor closed")
-                r.done.set()
-        for slot in list(self._slot_state):
-            row = self._slot_state.pop(slot)
-            if not row.done.is_set():
-                row.err = RuntimeError("generator actor closed")
-                row.done.set()
 
     def Info(self) -> dict:
         info = super().Info()

@@ -1176,6 +1176,53 @@ def test_witness_outage_majority_pair_keeps_serving(
         witness.close()
 
 
+def test_witness_outage_survives_follower_blip(tmp_path, free_port_pair):
+    """Regression (r5 review): with the witness down, the follower
+    heartbeat is the primary's ONLY second vote — so a follower
+    connection blip must not fence the primary PERMANENTLY. The
+    returning follower's repl_subscribe must pass the soft fence
+    (refusing it would make the fence self-sustaining forever while
+    primary+standby, 2 of the 3 voters, are healthy)."""
+    import socket as _socket
+
+    _, standby_addr = free_port_pair
+    witness, wproxy, primary, _, standby = _witness_cluster(
+        tmp_path, standby_addr, proxy_witness=True,
+        proxy_primary=False)
+    client = RemoteCoord([primary.address], reconnect_timeout=30.0)
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        client.put("store/k", "v1")
+
+        wproxy.cut()  # witness gone: follower vote is all that's left
+        time.sleep(2 * WITNESS_TTL)
+        # Blip the follower connection; its loop redials in ~0.5s.
+        sock = standby.follower._sock
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        # The primary may fence for ~a TTL; once the follower
+        # re-subscribes and heartbeats, service must resume.
+        deadline = time.monotonic() + 10 * WITNESS_TTL
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                client.put("store/k", "v2")
+                ok = True
+            except CoordinationError:
+                time.sleep(0.2)
+        assert ok, ("primary never recovered after a follower blip "
+                    "with the witness down — permanent self-fence")
+        assert not standby.promoted.is_set()
+    finally:
+        client.close()
+        standby.close()
+        primary.close()
+        witness.close()
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
