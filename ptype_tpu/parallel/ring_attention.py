@@ -182,20 +182,37 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
 # ------------------------------------------------------- Ulysses variant
 
 
-def make_ulysses_attention(mesh: Mesh, axis: str = "seq"):
+def make_ulysses_attention(mesh: Mesh, axis: str = "seq",
+                           inner_attn=None):
     """Ulysses-style sequence parallelism: ``all_to_all`` head-scatter.
 
     Instead of rotating K/V, each device trades its sequence shard for a
-    head shard (all_to_all over ``axis``), runs DENSE attention on full
-    sequence × (H/n) heads, then trades back. One collective pair per
-    attention instead of n−1 ppermutes — wins when heads ≥ ring size and
-    ICI all_to_all bandwidth is good (SURVEY.md §5 "Ulysses-style
-    head-scatter all_to_all")."""
+    head shard (all_to_all over ``axis``), runs full-sequence attention
+    on (H/n) heads, then trades back. One collective pair per attention
+    instead of n−1 ppermutes — wins when heads ≥ ring size and ICI
+    all_to_all bandwidth is good (SURVEY.md §5 "Ulysses-style
+    head-scatter all_to_all").
+
+    ``inner_attn``: the per-device attention after the head scatter —
+    ordinary full-sequence attention, so on TPU it defaults to the
+    Pallas flash kernel (materializing B·(H/n)·S² f32 scores at the
+    sequence lengths the seq axis exists for would be the exact memory
+    bill flash avoids); dense XLA elsewhere. The kernel's custom VJP
+    differentiates fine under shard_map."""
+    import jax
+
     from ptype_tpu.models.transformer import _attention
 
     n = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
     if n <= 1:
         return _attention
+    if inner_attn is None:
+        if jax.default_backend() == "tpu":
+            from ptype_tpu.ops.flash_attention import make_flash_attn_fn
+
+            inner_attn = make_flash_attn_fn()
+        else:
+            inner_attn = _attention
 
     batch_axes = tuple(
         a for a in ("data", "fsdp") if a in mesh.axis_names
@@ -213,7 +230,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "seq"):
                                   tiled=True)
 
         oq, ok, ov = exch(q), exch(k), exch(v)
-        o = _attention(oq, ok, ov, cfg)
+        o = inner_attn(oq, ok, ov, cfg)
         # inverse: scatter seq, gather heads
         return lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                               tiled=True)

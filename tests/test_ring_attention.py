@@ -255,3 +255,31 @@ def test_ring_chunk_width_picks_divisor():
     assert _chunk_width(256, 512) == 256  # chunk clamps to S_loc
     assert _chunk_width(96, 64) == 48     # largest divisor <= 64
     assert _chunk_width(7, 4) == 1        # prime: degrades, not errors
+
+
+def test_ulysses_flash_inner_matches_dense():
+    """The flash kernel as the Ulysses inner attention (the TPU
+    default after the head scatter) must match dense — values and
+    grads — validated through the interpret-mode kernel on the CPU
+    mesh, including the GQA head-scatter layout."""
+    from ptype_tpu.ops.flash_attention import make_flash_attn_fn
+
+    mesh = build_mesh({"seq": 2})
+    q, k, v = _qkv(jax.random.PRNGKey(5), S=32, H=4, K=2)
+    attn = make_ulysses_attention(mesh,
+                                  inner_attn=make_flash_attn_fn())
+    got = attn(q, k, v, CFG)
+    want = _dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    gr = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(attn(q, k, v, CFG) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(_dense(q, k, v) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name}")
