@@ -1223,6 +1223,115 @@ def test_witness_outage_survives_follower_blip(tmp_path, free_port_pair):
         witness.close()
 
 
+def test_watch_resumes_across_failover_without_relist(
+        tmp_path, free_port_pair):
+    """Round-5 composition: the wal-stream mirror replays the same
+    revision lineage, so a client watch that rode the failover can
+    RESUME from its last delivered revision on the promoted standby —
+    events flow with NO epoch bump (pre-MVCC every reconnect forced a
+    snapshot re-list)."""
+    primary_addr, standby_addr = free_port_pair
+    seed = _start_seed(primary_addr, str(tmp_path / "p"))
+    standby = Standby(primary_addr, standby_addr, str(tmp_path / "s"),
+                      check_interval=0.2, failure_threshold=3,
+                      probe_timeout=0.5, replicate=True)
+    coord = RemoteCoord([primary_addr, standby_addr],
+                        reconnect_timeout=30.0)
+    try:
+        assert standby.follower.synced.wait(timeout=10)
+        w = coord.watch("svc/")
+        coord.put("svc/a", "1", sync=True)
+        evs = w.get(timeout=5)
+        assert [e.key for e in evs] == ["svc/a"]
+
+        os.kill(seed.pid, signal.SIGKILL)
+        seed.wait(timeout=10)
+        assert standby.promoted.wait(timeout=15)
+
+        # Write on the NEW primary; the resumed watch must deliver it.
+        rev = standby.server.state.put("svc/b", "2")
+        got = []
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not got:
+            got = [e for e in w.get(timeout=1) if e.mod_rev == rev]
+        assert got, "watch never delivered post-failover event"
+        assert w.epoch == 0, (
+            "epoch bumped: the failover resume forced a re-list even "
+            "though the mirror's history covered the gap")
+    finally:
+        coord.close()
+        standby.close()
+        if seed.poll() is None:
+            seed.kill()
+            seed.wait(timeout=10)
+
+
+def test_two_standbys_with_witness_elect_single_successor(tmp_path):
+    """Succession × witness: with two standbys guarding one primary,
+    the witness lease must not deadlock the senior-promotes protocol —
+    and even if both raced, only one could hold the lease. After the
+    primary dies: the senior takes the lease and serves; the junior
+    adopts it; the witness records exactly the winner."""
+    from ptype_tpu.coord.service import CoordServer
+    from ptype_tpu.coord.witness import WitnessServer, status
+
+    witness = WitnessServer(ttl=1.0)
+    primary = CoordServer("127.0.0.1:0", data_dir=str(tmp_path / "p"),
+                          witness_addr=witness.address,
+                          witness_ttl=1.0)
+    import socket as _socket
+
+    def _free():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return f"127.0.0.1:{s.getsockname()[1]}"
+
+    addr_a, addr_b = _free(), _free()
+    kw = dict(check_interval=0.2, failure_threshold=3,
+              probe_timeout=0.5, replicate=True,
+              witness_addr=witness.address, witness_ttl=1.0,
+              succession_grace=2.0)
+    sb_a = Standby(primary.address, addr_a, str(tmp_path / "a"), **kw)
+    assert sb_a.follower.synced.wait(timeout=10)
+    sb_b = Standby(primary.address, addr_b, str(tmp_path / "b"), **kw)
+    assert sb_b.follower.synced.wait(timeout=10)
+    client = RemoteCoord([primary.address, addr_a, addr_b],
+                         reconnect_timeout=30.0)
+    try:
+        client.put("store/k", "v1", sync=True)
+        # Let both standbys learn the membership (succession list).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (
+                sb_a._member_promoted and sb_b._member_promoted):
+            time.sleep(0.1)
+
+        primary.close()  # the primary dies (in-process analog)
+
+        assert sb_a.promoted.wait(timeout=30), "senior never promoted"
+        # The junior must NOT also be serving.
+        time.sleep(2.0)
+        assert not sb_b.promoted.is_set(), (
+            "both standbys promoted — split brain despite witness")
+        st = status(witness.address)
+        assert st["holder"] == addr_a, st
+        # Clients ride onto the winner; data intact.
+        deadline = time.monotonic() + 15
+        val = None
+        while time.monotonic() < deadline and val != "v1":
+            try:
+                items = client.range("store/k").items
+                val = items[0].value if items else None
+            except CoordinationError:
+                time.sleep(0.2)
+        assert val == "v1"
+    finally:
+        client.close()
+        sb_a.close()
+        sb_b.close()
+        primary.close()
+        witness.close()
+
+
 @pytest.fixture
 def free_port_pair():
     import socket
