@@ -136,6 +136,22 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
     return y_mb.reshape(B, *y_mb.shape[2:])
 
 
+def _stage_attn(cfg):
+    """Attention for blocks INSIDE the stage ring: the resolved impl
+    (flash kernel on TPU under "auto" — a pipelined model shouldn't
+    pay dense B·H·S² scores just because its layers are staged; the
+    kernel's custom VJP differentiates under shard_map). Seq-parallel
+    impls can't nest inside the stage ring — refuse rather than
+    silently running dense."""
+    from ptype_tpu.models import transformer as tfm
+
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ClusterError(
+            f"pipeline stages cannot nest seq-parallel attention "
+            f"(attn_impl={cfg.attn_impl!r}); use auto/flash/xla")
+    return tfm.resolve_attn_fn(cfg)
+
+
 def schedule_info(n_stages: int, n_microbatches: int,
                   schedule: str = "gpipe") -> dict:
     """Tick/stash/bubble accounting for a schedule — the numbers the
@@ -332,10 +348,11 @@ def pipeline_loss_and_grads_1f1b(params: dict, batch: dict, cfg,
     head = tfm._head_weight(params, cfg)
     wnorm = params["final_norm"]
 
+    attn = _stage_attn(cfg)
+
     def stage_fn(blocks, x):
         def body(x, layer):
-            x, _aux = tfm._block(x, layer, sin, cos, cfg,
-                                 tfm._attention)
+            x, _aux = tfm._block(x, layer, sin, cos, cfg, attn)
             return x, None
 
         if cfg.remat:
@@ -415,10 +432,11 @@ def transformer_pipeline_forward(params: dict, tokens: jax.Array, cfg,
     x = params["embed"][tokens].astype(dt)
     sin, cos = tfm.rope_tables(cfg, T)
     stage_blocks = split_stages(params["blocks"], S)
+    attn = _stage_attn(cfg)
 
     def stage_fn(blocks, x_mb):
         def body(x, layer):
-            x, _aux = tfm._block(x, layer, sin, cos, cfg, tfm._attention)
+            x, _aux = tfm._block(x, layer, sin, cos, cfg, attn)
             return x, None
 
         if cfg.remat:

@@ -232,3 +232,29 @@ def test_1f1b_schedule_accounting():
             < gp_at_budget["bubble_fraction"])
     with pytest.raises(ClusterError):
         schedule_info(S, M, "nope")
+
+
+def test_pipeline_with_flash_attention_matches_dense():
+    """Pipelined stages resolve cfg.attn_impl like the dense path —
+    with the flash kernel forced (interpret on CPU) the pipelined
+    forward still matches dense; seq-parallel impls are refused
+    rather than silently downgraded."""
+    cfg = tfm.preset("tiny", n_layers=4, dtype=jnp.float32,
+                     attn_impl="flash")
+    mesh = build_mesh({"stage": 2})
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size, jnp.int32)
+    got = jax.jit(
+        lambda p, t: transformer_pipeline_forward(p, t, cfg, mesh, 2)
+    )(params, toks)
+    want = tfm.forward(params, toks,
+                       tfm.preset("tiny", n_layers=4,
+                                  dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    ring_cfg = tfm.preset("tiny", n_layers=4, attn_impl="ring")
+    with pytest.raises(ClusterError, match="nest"):
+        transformer_pipeline_forward(
+            tfm.init_params(jax.random.PRNGKey(0), ring_cfg),
+            toks, ring_cfg, mesh, 2)
