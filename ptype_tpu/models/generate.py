@@ -151,10 +151,30 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
     # would even outrank real tokens in token-priority order).
     cap = B * S if cfg.n_experts else None
 
+    # Uniform causal prefill is ordinary full-sequence attention: use
+    # the flash kernel on TPU (attn_impl auto/flash; explicit "flash"
+    # also forces the interpret-mode kernel on CPU for tests) — dense
+    # prefill pays B·H·S² f32 scores exactly where long-prompt serving
+    # hurts. Ragged (kv_mask) prompts keep the masked dense path: the
+    # kernel has no kv-mask support.
+    use_flash = (kv_mask is None and cfg.causal
+                 and (cfg.attn_impl == "flash"
+                      or (cfg.attn_impl == "auto"
+                          and jax.default_backend() == "tpu"))
+                 and S % min(1024, S) == 0)
+    if use_flash:
+        from ptype_tpu.ops.flash_attention import flash_attention
+
+        def attn(q, k, v):
+            return flash_attention(q, k, v, causal=True)
+    else:
+        def attn(q, k, v):
+            return tfm._attention(q, k, v, cfg, kv_mask=kv_mask)
+
     def body(x, inputs):
         layer, kc, vc = inputs
         q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
-        o = tfm._attention(q, k, v, cfg, kv_mask=kv_mask)
+        o = attn(q, k, v)
         x = tfm.attn_residual(x, o, layer, cfg)
         x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=cap)
         kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
