@@ -119,6 +119,39 @@ def main() -> int:
     assert mtoks.shape == (2, 4), f"moe-generate: bad {mtoks.shape}"
     print("tpu-smoke moe-zero-drop-generate: OK")
 
+    # Round-5 Mosaic-visible additions, never yet run on hardware:
+    # (a) flash-kernel PREFILL (uniform causal path picks the kernel on
+    # TPU) feeding the decode cache — compare against the dense-forced
+    # config so a kernel/tiling regression shows as divergence;
+    import numpy as np
+
+    fcfg = tfm.preset("tiny")  # attn_impl auto → flash on TPU
+    dcfg = tfm.preset("tiny", attn_impl="xla")
+    fparams = jax.jit(lambda r: tfm.init_params(r, fcfg))(
+        jax.random.PRNGKey(2))
+    prompt = jnp.zeros((2, 16), jnp.int32).at[:, 8:].set(3)
+    ftoks = gen.generate(fparams, fcfg, prompt, max_new_tokens=4)
+    dtoks = gen.generate(fparams, dcfg, prompt, max_new_tokens=4)
+    assert bool(jnp.all(ftoks == dtoks)), (
+        "flash-prefill generation diverges from dense on TPU")
+    print("tpu-smoke flash-prefill-generate: OK")
+
+    # (b) continuous-batching engine: per-row-depth ragged decode
+    # (decode_step_ragged scatter writes + per-row position masks) and
+    # the slot prefill must produce each row's solo decode on TPU.
+    from ptype_tpu.serve import ContinuousGeneratorActor
+
+    actor = ContinuousGeneratorActor(dcfg, params=fparams, n_slots=2)
+    try:
+        p0 = jnp.zeros((1, 5), jnp.int32).at[0, 2:].set(4)
+        out = actor.Generate(p0, 4)
+        solo = gen.generate(fparams, dcfg, p0, 4)
+        assert bool(jnp.all(jnp.asarray(np.asarray(out)) == solo)), (
+            "continuous engine diverges from solo decode on TPU")
+    finally:
+        actor.close()
+    print("tpu-smoke continuous-engine: OK")
+
     print(f"tpu-smoke OK: flash fwd+bwd on {jax.devices()[0].device_kind}")
     return 0
 
