@@ -119,22 +119,28 @@ def main() -> int:
     assert mtoks.shape == (2, 4), f"moe-generate: bad {mtoks.shape}"
     print("tpu-smoke moe-zero-drop-generate: OK")
 
-    # Round-5 Mosaic-visible additions, never yet run on hardware:
-    # (a) flash-kernel PREFILL (uniform causal path picks the kernel on
-    # TPU) feeding the decode cache — compare against the dense-forced
-    # config so a kernel/tiling regression shows as divergence;
+    # Round-5 Mosaic-visible additions, never yet run on hardware.
+    # f32 configs on purpose: these are PARITY assertions, and bf16
+    # kernel-vs-dense rounding could flip a greedy argmax on random
+    # params — that would smoke-fail a healthy kernel.
     import numpy as np
 
-    fcfg = tfm.preset("tiny")  # attn_impl auto → flash on TPU
-    dcfg = tfm.preset("tiny", attn_impl="xla")
+    # (a) flash-kernel PREFILL (forced) vs dense prefill: logits and
+    # cache K/V must agree within f32 kernel tolerance — a Mosaic
+    # tiling/indexing regression shows up as divergence here.
+    fcfg = tfm.preset("tiny", dtype=jnp.float32, attn_impl="flash")
+    dcfg = tfm.preset("tiny", dtype=jnp.float32, attn_impl="xla")
     fparams = jax.jit(lambda r: tfm.init_params(r, fcfg))(
         jax.random.PRNGKey(2))
     prompt = jnp.zeros((2, 16), jnp.int32).at[:, 8:].set(3)
-    ftoks = gen.generate(fparams, fcfg, prompt, max_new_tokens=4)
-    dtoks = gen.generate(fparams, dcfg, prompt, max_new_tokens=4)
-    assert bool(jnp.all(ftoks == dtoks)), (
-        "flash-prefill generation diverges from dense on TPU")
-    print("tpu-smoke flash-prefill-generate: OK")
+    lf, cf = gen.prefill(fparams, prompt, fcfg,
+                         gen.init_cache(fcfg, 2, max_seq=32))
+    ld, cd = gen.prefill(fparams, prompt, dcfg,
+                         gen.init_cache(dcfg, 2, max_seq=32))
+    assert np.allclose(np.asarray(lf), np.asarray(ld),
+                       rtol=2e-4, atol=2e-4), (
+        "flash prefill logits diverge from dense on TPU")
+    print("tpu-smoke flash-prefill: OK")
 
     # (b) continuous-batching engine: per-row-depth ragged decode
     # (decode_step_ragged scatter writes + per-row position masks) and
