@@ -152,16 +152,20 @@ def prefill(params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig,
     cap = B * S if cfg.n_experts else None
 
     # Uniform causal prefill is ordinary full-sequence attention: use
-    # the flash kernel on TPU (attn_impl auto/flash; explicit "flash"
-    # also forces the interpret-mode kernel on CPU for tests) — dense
-    # prefill pays B·H·S² f32 scores exactly where long-prompt serving
-    # hurts. Ragged (kv_mask) prompts keep the masked dense path: the
-    # kernel has no kv-mask support.
-    use_flash = (kv_mask is None and cfg.causal
-                 and (cfg.attn_impl == "flash"
-                      or (cfg.attn_impl == "auto"
-                          and jax.default_backend() == "tpu"))
-                 and S % min(1024, S) == 0)
+    # the flash kernel when the resolved impl says so (auto → flash on
+    # TPU; explicit "flash" also forces the interpret-mode kernel on
+    # CPU for tests) — dense prefill pays B·H·S² f32 scores exactly
+    # where long-prompt serving hurts. Ragged (kv_mask) prompts keep
+    # the masked dense path (the kernel has no kv-mask support), and
+    # so do UNALIGNED lengths: S must be lane-aligned (128) or Mosaic
+    # rejects the block at compile time (the round-2 hardware failure
+    # class — serving buckets are pow2, so real callers qualify), and
+    # divide the clamped block size.
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = tfm.default_attn_impl()
+    use_flash = (kv_mask is None and cfg.causal and impl == "flash"
+                 and S % 128 == 0 and S % min(1024, S) == 0)
     if use_flash:
         from ptype_tpu.ops.flash_attention import flash_attention
 

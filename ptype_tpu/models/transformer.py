@@ -286,6 +286,13 @@ def _attention(q, k, v, cfg: TransformerConfig, kv_mask=None):
     return o.reshape(B, S, H, Dh)
 
 
+def default_attn_impl() -> str:
+    """THE 'auto' policy, in one place (resolve_attn_fn, the Ulysses
+    inner default, and prefill's gate all consult it — hand-copied
+    backend checks drift): flash kernel on TPU, XLA dense elsewhere."""
+    return "flash" if jax.default_backend() == "tpu" else "xla"
+
+
 def resolve_attn_fn(cfg: TransformerConfig, mesh=None):
     """Resolve ``cfg.attn_impl`` to a concrete ``attn_fn(q, k, v, cfg)``.
 
@@ -298,7 +305,7 @@ def resolve_attn_fn(cfg: TransformerConfig, mesh=None):
     """
     impl = cfg.attn_impl
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        impl = default_attn_impl()
     if impl == "xla":
         return _attention
     if impl == "flash":

@@ -199,15 +199,15 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "seq",
     sequence lengths the seq axis exists for would be the exact memory
     bill flash avoids); dense XLA elsewhere. The kernel's custom VJP
     differentiates fine under shard_map."""
-    import jax
-
     from ptype_tpu.models.transformer import _attention
 
     n = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
     if n <= 1:
         return _attention
     if inner_attn is None:
-        if jax.default_backend() == "tpu":
+        from ptype_tpu.models.transformer import default_attn_impl
+
+        if default_attn_impl() == "flash":
             from ptype_tpu.ops.flash_attention import make_flash_attn_fn
 
             inner_attn = make_flash_attn_fn()

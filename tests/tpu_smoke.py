@@ -132,11 +132,12 @@ def main() -> int:
     dcfg = tfm.preset("tiny", dtype=jnp.float32, attn_impl="xla")
     fparams = jax.jit(lambda r: tfm.init_params(r, fcfg))(
         jax.random.PRNGKey(2))
-    prompt = jnp.zeros((2, 16), jnp.int32).at[:, 8:].set(3)
+    # S=128: lane-aligned, so the gate actually routes to the kernel.
+    prompt = jnp.zeros((2, 128), jnp.int32).at[:, 64:].set(3)
     lf, cf = gen.prefill(fparams, prompt, fcfg,
-                         gen.init_cache(fcfg, 2, max_seq=32))
+                         gen.init_cache(fcfg, 2, max_seq=128))
     ld, cd = gen.prefill(fparams, prompt, dcfg,
-                         gen.init_cache(dcfg, 2, max_seq=32))
+                         gen.init_cache(dcfg, 2, max_seq=128))
     assert np.allclose(np.asarray(lf), np.asarray(ld),
                        rtol=2e-4, atol=2e-4), (
         "flash prefill logits diverge from dense on TPU")
