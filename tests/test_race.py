@@ -317,3 +317,80 @@ def test_concurrent_fence_bounces_converge(tmp_path):
         for srv in (a2, b2):
             if srv is not None:
                 srv.close()
+
+
+def test_witness_concurrent_acquire_exactly_one_grant():
+    """N challengers race vote_acquire on a vacant witness: the lock
+    must grant EXACTLY one lease (a double grant here is a split
+    brain by construction)."""
+    from ptype_tpu.coord import witness as w
+
+    srv = w.WitnessServer(ttl=10.0)
+    grants = []
+    lock = threading.Lock()
+    try:
+        barrier = threading.Barrier(N_THREADS)
+
+        def race(i):
+            barrier.wait()
+            r = w.acquire(srv.address, candidate=f"cand{i}", term=1)
+            if r.get("granted"):
+                with lock:
+                    grants.append(i)
+
+        _hammer(race)
+        assert len(grants) == 1, f"grants: {grants}"
+        st = w.status(srv.address)
+        assert st["holder"] == f"cand{grants[0]}"
+    finally:
+        srv.close()
+
+
+def test_mvcc_watch_replay_contiguous_under_concurrent_writers():
+    """Watches armed at arbitrary revisions MID-hammer must observe a
+    gap-free, strictly-ordered event stream (replay + live seam
+    included): every revision from start_rev through at least the
+    arm-time head arrives exactly once. A lost or duplicated event at
+    the replay/live boundary is the race this guards."""
+    import time
+
+    from ptype_tpu.coord.core import CoordState
+
+    state = CoordState(sweep_interval=5.0, history_window=100_000)
+    stop = threading.Event()
+    errs = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set() and n < 400:
+            state.put(f"w/k{i}", str(n))
+            n += 1
+
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in writers:
+        t.start()
+    try:
+        time.sleep(0.05)  # some history exists
+        for _ in range(6):
+            head = state.revision
+            start = max(1, head - 25)
+            watch = state.watch("w/", start_rev=start)
+            got = []
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and (not got or got[-1] < head)):
+                got.extend(ev.mod_rev for ev in watch.get(timeout=1))
+            watch.cancel()
+            # All writes are under the watched prefix, so revisions
+            # are contiguous integers: the received stream must be
+            # exactly start..>=head with no gap or duplicate.
+            want = list(range(start, got[-1] + 1)) if got else []
+            if got != want:
+                errs.append((start, head, got[:5], len(got)))
+    finally:
+        stop.set()
+        for t in writers:
+            t.join()
+        state.close()
+    assert not errs, errs[:2]
