@@ -167,6 +167,29 @@ def store_vs_gspmd():
 
 
 def main() -> int:
+    # A WEDGED tunnel hangs backend init (no exception — the bench.py
+    # probe lesson): --smoke pins CPU before any backend initializes
+    # so plumbing validation works under an outage, and the real sweep
+    # probes in a bounded subprocess instead of hanging forever.
+    if SMOKE:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import subprocess
+
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=60, env=dict(os.environ))
+            ok = p.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            print("backend probe hung/failed (wedged tunnel?); "
+                  "re-run when hardware answers (use --smoke to "
+                  "validate the plumbing off-TPU)", file=sys.stderr)
+            return 42
     import jax
 
     if jax.devices()[0].platform != "tpu" and not SMOKE:
