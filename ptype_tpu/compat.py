@@ -1,0 +1,56 @@
+"""JAX version compatibility shims.
+
+The repo targets the modern ``jax.shard_map`` surface (``check_vma``);
+older jax (< 1.0, e.g. the 0.4.x line baked into some images) ships the
+same primitive as ``jax.experimental.shard_map.shard_map`` — and some
+intermediate releases export top-level ``jax.shard_map`` while still
+spelling the replication check ``check_rep``. Selection is therefore by
+FEATURE (does the signature accept ``check_vma``), not by import
+success. Everything that shard_maps imports from here so the whole mesh
+data plane runs on all of them.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+
+def _resolve_shard_map():
+    legacy = None
+    try:
+        from jax import shard_map as sm  # type: ignore[attr-defined]
+
+        try:
+            if "check_vma" in inspect.signature(sm).parameters:
+                return sm  # modern surface, pass through untouched
+        except (TypeError, ValueError):
+            pass  # unintrospectable wrapper: treat as legacy
+        legacy = sm  # top-level export but pre-check_vma (check_rep era)
+    except ImportError:
+        pass
+    if legacy is None:
+        from jax.experimental.shard_map import shard_map as legacy
+
+    def shim(f, *, mesh=None, in_specs=None, out_specs=None,
+             check_vma: bool | None = None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return legacy(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+    return shim
+
+
+shard_map = _resolve_shard_map()
+
+try:  # modern surface
+    from jax.lax import axis_size  # type: ignore[attr-defined]
+except ImportError:
+    from jax import lax as _lax
+
+    def axis_size(axis_name) -> int:
+        # psum of a concrete constant over a named axis folds statically
+        # to the axis size — the long-standing pre-axis_size idiom.
+        return _lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "axis_size"]

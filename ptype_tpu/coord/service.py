@@ -42,6 +42,18 @@ def _member_wire(m) -> dict:
     }
 
 
+def _repl_idle_tick(witness_ttl: float) -> float:
+    """Idle-heartbeat period for the repl pump, derived from the
+    configured TTL. The follower's repl_pong round-trip is the liveness
+    proof the quorum loop counts as the standby's vote — with the old
+    fixed 1.0 s tick, any ``witness_ttl`` ≲ 1 s starved a quiet
+    cluster's follower of heartbeats within the TTL window and flapped
+    its vote. Three ticks per TTL matches the quorum loop's own cadence
+    (``_quorum_loop``); 1.0 s stays the ceiling so big TTLs don't slow
+    feed-close detection."""
+    return min(1.0, witness_ttl / 3)
+
+
 class CoordServer:
     """Serves a CoordState over TCP. One instance per cluster seed."""
 
@@ -463,9 +475,12 @@ class CoordServer:
                    feed) -> None:
         """Stream a ReplFeed to a WAL follower. A follower that stops
         draining eventually backs TCP up; a send failure cancels the
-        feed (it re-syncs from a fresh snapshot on reconnect)."""
+        feed (it re-syncs from a fresh snapshot on reconnect). The idle
+        tick is TTL-derived (:func:`_repl_idle_tick`) so small
+        ``witness_ttl`` configs don't flap the follower vote."""
+        tick = _repl_idle_tick(self._witness_ttl)
         while True:
-            batch = feed.get(timeout=1.0)
+            batch = feed.get(timeout=tick)
             if feed.closed and not batch:
                 return
             if not batch:

@@ -66,6 +66,10 @@ class Timing:
     name: str
     total: float = 0.0
     count: int = 0
+    #: Most recent observation — what a bench tail or debugger wants
+    #: from a warm path (the mean is polluted by the compile-pass
+    #: first observation).
+    last: float = 0.0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -73,6 +77,7 @@ class Timing:
         with self._lock:
             self.total += seconds
             self.count += 1
+            self.last = seconds
 
     @property
     def mean(self) -> float:
@@ -117,7 +122,8 @@ class MetricsRegistry:
             return {
                 "counters": {n: c.value for n, c in self._counters.items()},
                 "timings": {
-                    n: {"mean_s": t.mean, "count": t.count}
+                    n: {"mean_s": t.mean, "count": t.count,
+                        "last_s": t.last}
                     for n, t in self._timings.items()
                 },
             }

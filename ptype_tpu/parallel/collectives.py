@@ -14,13 +14,15 @@ per-worker values in a multi-controller program.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ptype_tpu.compat import axis_size, shard_map
 
 _REDUCERS = ("sum", "mean", "max", "min")
 
@@ -103,7 +105,7 @@ def _reduce_scatter_fn(mesh: Mesh, axis: str, ndim: int, op: str):
 
     def f(local):
         x = jnp.squeeze(local, axis=0)
-        n = lax.axis_size(axis)
+        n = axis_size(axis)
         red = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
         if op == "mean":
             red = red / n
@@ -141,7 +143,7 @@ def _ring_shift_fn(mesh: Mesh, axis: str, ndim: int, shift: int):
     spec = P(axis, *_rest(ndim))
 
     def f(local):
-        n = lax.axis_size(axis)
+        n = axis_size(axis)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(local, axis, perm)
 
@@ -205,7 +207,7 @@ def _int8_phase1(x, axis: str, op: str):
     into n chunks, quantize each with one absmax scale, all_to_all so
     device j collects everyone's chunk j, dequantize and reduce.
     Returns this device's reduced f32 chunk ``(rest[0]/n, *tail)``."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     c = x.shape[0] // n
     chunks = x.reshape((n, c) + x.shape[1:])
     q, scale = _q_int8_chunks(chunks)
@@ -221,24 +223,29 @@ def _int8_phase1(x, axis: str, op: str):
     return red
 
 
+def _int8_all_reduce_body(x, axis: str, op: str):
+    """Both wire legs of the int8 allreduce on one device's
+    contribution ``x`` (shape ``rest`` with ``rest[0] % n == 0``):
+    phase 1 (:func:`_int8_phase1`), then the all_gather leg —
+    re-quantize my reduced chunk with one scale, gather, dequantize —
+    so every device reassembles the full f32 reduction. Shared by the
+    per-leaf quantized allreduce and the bucketed tree path."""
+    n = axis_size(axis)
+    red = _int8_phase1(x, axis, op)
+    q2, s2 = _q_int8_chunks(red[None])  # one chunk → one scale
+    qg = lax.all_gather(jnp.squeeze(q2, 0), axis)   # (n, c, *tail)
+    sg = lax.all_gather(s2[0], axis)                # (n,)
+    out = qg.astype(jnp.float32) * sg.reshape((n,) + (1,) * x.ndim)
+    return out.reshape(x.shape)
+
+
 @functools.lru_cache(maxsize=256)
 def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
     in_spec = P(axis, *_rest(ndim))
     out_spec = P(*_rest(ndim))
 
     def f(local):
-        x = jnp.squeeze(local, axis=0)  # my contribution, shape `rest`
-        n = lax.axis_size(axis)
-        red = _int8_phase1(x, axis, op)
-        # Phase 2 (all_gather leg): re-quantize my reduced chunk with
-        # one scale, gather, dequantize — every device reassembles the
-        # full reduced tensor.
-        q2, s2 = _q_int8_chunks(red[None])  # one chunk → one scale
-        qg = lax.all_gather(jnp.squeeze(q2, 0), axis)   # (n, c, *tail)
-        sg = lax.all_gather(s2[0], axis)                # (n,)
-        out = qg.astype(jnp.float32) * sg.reshape(
-            (n,) + (1,) * x.ndim)
-        return out.reshape(x.shape)
+        return _int8_all_reduce_body(jnp.squeeze(local, axis=0), axis, op)
 
     return jax.jit(
         shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
@@ -318,6 +325,336 @@ def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,
 def broadcast(value: jax.Array, mesh: Mesh) -> jax.Array:
     """Replicate a host/single-device value across the whole mesh."""
     return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+# ------------------------------------------------- bucketed tree collectives
+#
+# A pytree pushed leaf-by-leaf costs one XLA launch per leaf — ~100
+# eager collectives for optimus-125M, which is why BENCH_r05's
+# store_allreduce_gbps (one big fused buffer) is unreachable from the
+# per-leaf push_tree path. The bucketing layer packs same-dtype leaves
+# into large flat buckets (EQuARX: quantized collectives only pay off
+# on large fused buffers; T3: overlap the reduction instead of
+# serializing per-leaf round trips) and runs ONE fused collective per
+# bucket inside a single jit'd shard_map program. Buckets dispatch
+# asynchronously — the host races ahead and issues every bucket before
+# the first finishes, so reduction overlaps host work and later compute.
+
+#: Default per-device payload target per bucket. Big enough that launch
+#: overhead and per-collective latency amortize; small enough that the
+#: first bucket's reduction overlaps the packing of the rest.
+DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
+
+#: Buckets below this per-device payload ride the EXACT allreduce even
+#: under compress="int8": at small sizes the quantize/dequantize math
+#: and the second collective leg cost more than the wire bytes saved.
+INT8_MIN_BUCKET_BYTES = 64 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafSlot:
+    """One leaf's location inside a bucket's flat per-device payload."""
+
+    index: int            # position in the caller's flat leaf list
+    offset: int           # element offset into the bucket payload
+    size: int             # payload elements (per device)
+    shape: tuple          # per-device payload shape (``rest``)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """A dtype-homogeneous pack of leaves reduced as one flat buffer."""
+
+    dtype: str            # numpy dtype name — the grouping key
+    slots: tuple          # tuple[LeafSlot, ...], ascending offsets
+    pad: int              # zero elements appended so elems % n == 0
+
+    @property
+    def elems(self) -> int:
+        last = self.slots[-1]
+        return last.offset + last.size + self.pad
+
+    @property
+    def payload_bytes(self) -> int:
+        return (self.elems - self.pad) * jnp.dtype(self.dtype).itemsize
+
+
+def plan_buckets(leaves, n: int,
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> list[Bucket]:
+    """Greedy same-dtype packing of stacked ``(n, *rest)`` leaves.
+
+    Leaves keep their original order within a dtype group; a group's
+    open bucket closes when the next leaf would push its per-device
+    payload past ``bucket_bytes`` (so a single oversize leaf gets its
+    own bucket, and a leaf that would straddle the target starts the
+    next bucket instead of splitting). Every bucket's payload is
+    zero-padded to a multiple of ``n`` so the scatter and int8 paths
+    are always shape-eligible — the per-leaf eligibility lottery
+    (``rest[0] % n``) disappears at the bucket level.
+    """
+    out: list[Bucket] = []
+    open_slots: dict[str, list[LeafSlot]] = {}
+    open_bytes: dict[str, int] = {}
+
+    def close(dt: str) -> None:
+        slots = open_slots.pop(dt, [])
+        if slots:
+            total = slots[-1].offset + slots[-1].size
+            out.append(Bucket(dt, tuple(slots), (-total) % n))
+        open_bytes.pop(dt, None)
+
+    for i, leaf in enumerate(leaves):
+        shape = tuple(leaf.shape)
+        if not shape or shape[0] != n:
+            raise ValueError(
+                f"plan_buckets: leaf {i} shape {shape} must lead with "
+                f"the contribution axis (size {n})")
+        dt = jnp.dtype(leaf.dtype).name
+        size = 1
+        for d in shape[1:]:
+            size *= int(d)
+        nbytes = size * jnp.dtype(dt).itemsize
+        if dt in open_slots and open_bytes[dt] + nbytes > bucket_bytes:
+            close(dt)
+        slots = open_slots.setdefault(dt, [])
+        off = (slots[-1].offset + slots[-1].size) if slots else 0
+        slots.append(LeafSlot(i, off, size, shape[1:]))
+        open_bytes[dt] = open_bytes.get(dt, 0) + nbytes
+    for dt in list(open_slots):
+        close(dt)
+    return out
+
+
+def _bucket_wire(bucket: Bucket, op: str, compress: str | None,
+                 int8_min_bytes: int) -> str | None:
+    """Resolve a bucket's wire format. Non-float buckets always ride
+    exact (step counters must not round-trip through bf16/int8 — the
+    caller opted into float loss only); int8 additionally needs a
+    sum/mean op and enough payload to amortize the quantize legs."""
+    if compress is None:
+        return None
+    if not jnp.issubdtype(jnp.dtype(bucket.dtype), jnp.floating):
+        return None
+    if compress == "bf16":
+        return "bf16"
+    if op in ("sum", "mean") and bucket.payload_bytes >= int8_min_bytes:
+        return "int8"
+    return None
+
+
+def _unpack(red, slots):
+    """Slice a reduced flat buffer back into leaf views (static offsets
+    — XLA fuses these with the collective's output)."""
+    return tuple(red[s.offset:s.offset + s.size].reshape(s.shape)
+                 for s in slots)
+
+
+@functools.lru_cache(maxsize=512)
+def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
+                          dtype: str, pad: int, wire: str | None,
+                          restore: bool):
+    """One fused program: pack → (quantize?) → allreduce → unpack.
+
+    ``shapes``: per-device payload shapes of the bucket's leaves, in
+    slot order. The whole thing is a single jit'd shard_map, so the
+    bucket costs ONE collective launch (two wire legs under int8)
+    regardless of leaf count.
+    """
+    in_specs = tuple(P(axis, *(None,) * len(s)) for s in shapes)
+    out_specs = tuple(P(*(None,) * len(s)) for s in shapes)
+    offs = []
+    off = 0
+    for s in shapes:
+        size = 1
+        for d in s:
+            size *= int(d)
+        offs.append(LeafSlot(0, off, size, s))
+        off += size
+
+    def f(*locals_):
+        parts = [jnp.squeeze(x, axis=0).reshape(-1) for x in locals_]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        if wire == "int8":
+            red = _int8_all_reduce_body(flat, axis, op)
+        else:
+            w = flat.astype(jnp.bfloat16) if wire == "bf16" else flat
+            if op == "sum":
+                red = lax.psum(w, axis)
+            elif op == "mean":
+                red = lax.pmean(w, axis)
+            elif op == "max":
+                red = lax.pmax(w, axis)
+            else:
+                red = lax.pmin(w, axis)
+        # Restore the leaf dtype only when a wire compression was
+        # REQUESTED (per-leaf push semantics): the exact path returns
+        # whatever the lax op produces (pmean promotes ints to float).
+        if restore:
+            red = red.astype(jnp.dtype(dtype))
+        return _unpack(red, offs)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
+
+
+@functools.lru_cache(maxsize=512)
+def _bucket_reduce_scatter_fn(mesh: Mesh, axis: str, op: str,
+                              shapes: tuple, dtype: str, pad: int,
+                              wire: str | None, restore: bool):
+    """Pack → (quantize?) → reduce-scatter; each device keeps one flat
+    ``elems/n`` shard of the bucket (half the allreduce's ICI bytes)."""
+    in_specs = tuple(P(axis, *(None,) * len(s)) for s in shapes)
+
+    def f(*locals_):
+        parts = [jnp.squeeze(x, axis=0).reshape(-1) for x in locals_]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        if wire == "int8":
+            shard = _int8_phase1(flat, axis, op)
+        else:
+            w = flat.astype(jnp.bfloat16) if wire == "bf16" else flat
+            shard = lax.psum_scatter(w, axis, scatter_dimension=0,
+                                     tiled=True)
+            if op == "mean":
+                shard = shard / axis_size(axis)
+        return shard.astype(jnp.dtype(dtype)) if restore else shard
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(axis), check_vma=False))
+
+
+def _count_launch(n: int = 1) -> None:
+    from ptype_tpu.metrics import metrics
+
+    metrics.counter("collectives.bucket_launches").add(n)
+
+
+def _place_stacked(leaves, mesh: Mesh, axis: str):
+    """One batched device_put onto the stacked layout (transfers for
+    every leaf dispatch together; a no-op for already-placed grads)."""
+    shardings = [NamedSharding(mesh, P(axis, *_rest(x.ndim)))
+                 for x in leaves]
+    return jax.device_put(leaves, shardings)
+
+
+def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
+                        op: str = "sum", *,
+                        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                        compress: str | None = None,
+                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES) -> list:
+    """Allreduce a flat list of stacked leaves through dtype buckets.
+
+    Numerically identical to per-leaf :func:`all_reduce` on the exact
+    path (same psum, different operand fusion); under ``compress`` the
+    wire format resolves per bucket (:func:`_bucket_wire`). Buckets
+    dispatch without any intervening sync, so every bucket's collective
+    is in flight before the first result is consumed. Returns reduced
+    leaves (shape ``rest``) in input order.
+    """
+    if op not in _REDUCERS:
+        raise ValueError(f"bucketed_all_reduce: op must be one of "
+                         f"{_REDUCERS}")
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(
+            f"bucketed_all_reduce: unknown compression {compress!r}")
+    leaves = [jnp.asarray(x) for x in leaves]
+    n = int(mesh.shape[axis])
+    buckets = plan_buckets(leaves, n, bucket_bytes)
+    placed = _place_stacked(leaves, mesh, axis)
+    out: list = [None] * len(leaves)
+    for b in buckets:
+        fn = _bucket_all_reduce_fn(
+            mesh, axis, op, tuple(s.shape for s in b.slots), b.dtype,
+            b.pad, _bucket_wire(b, op, compress, int8_min_bytes),
+            compress is not None)
+        reduced = fn(*[placed[s.index] for s in b.slots])
+        _count_launch()
+        for s, r in zip(b.slots, reduced):
+            out[s.index] = r
+    return out
+
+
+def tree_all_reduce(stacked_tree, mesh: Mesh, axis: str = "data",
+                    op: str = "sum", *,
+                    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                    compress: str | None = None,
+                    int8_min_bytes: int = INT8_MIN_BUCKET_BYTES):
+    """Bucketed allreduce over a whole pytree of stacked contributions
+    — the fused lowering of "push every leaf" (one collective per
+    bucket, not per leaf). Returns the tree of reduced leaves."""
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+    reduced = bucketed_all_reduce(
+        leaves, mesh, axis, op, bucket_bytes=bucket_bytes,
+        compress=compress, int8_min_bytes=int8_min_bytes)
+    return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+@dataclasses.dataclass
+class ScatteredTree:
+    """Result of :func:`tree_reduce_scatter`: per-bucket flat shards.
+
+    Each bucket's reduction lives as a flat ``(elems,)`` array sharded
+    over ``axis`` — each device owns ``elems/n`` contiguous elements
+    (the ZeRO/FSDP resident form). :meth:`gather` reassembles the full
+    tree via one allgather-reshard per bucket.
+    """
+
+    treedef: object
+    buckets: list          # [(Bucket, flat jax.Array sharded P(axis))]
+    mesh: Mesh
+    axis: str
+    n_leaves: int
+
+    def gather(self):
+        """Allgather every bucket and unpack back to the pytree —
+        together with the scatter this is the bandwidth-optimal
+        allreduce decomposition."""
+        flats = jax.device_put(
+            [a for _, a in self.buckets],
+            [NamedSharding(self.mesh, P())] * len(self.buckets))
+        leaves: list = [None] * self.n_leaves
+        for (b, _), flat in zip(self.buckets, flats):
+            for s, r in zip(b.slots, _unpack(flat, b.slots)):
+                leaves[s.index] = r
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def tree_reduce_scatter(stacked_tree, mesh: Mesh, axis: str = "data",
+                        op: str = "sum", *,
+                        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                        compress: str | None = None,
+                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES
+                        ) -> ScatteredTree:
+    """Bucketed reduce-scatter over a pytree: half the allreduce's ICI
+    bytes, each device left holding one flat shard per bucket. Pad to
+    a multiple of the axis size makes every bucket eligible — no
+    per-leaf ``rest[0] % n`` lottery."""
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"tree_reduce_scatter: op must be 'sum' or 'mean', got "
+            f"{op!r}")
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(
+            f"tree_reduce_scatter: unknown compression {compress!r}")
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+    leaves = [jnp.asarray(x) for x in leaves]
+    n = int(mesh.shape[axis])
+    buckets = plan_buckets(leaves, n, bucket_bytes)
+    placed = _place_stacked(leaves, mesh, axis)
+    shards = []
+    for b in buckets:
+        fn = _bucket_reduce_scatter_fn(
+            mesh, axis, op, tuple(s.shape for s in b.slots), b.dtype,
+            b.pad, _bucket_wire(b, op, compress, int8_min_bytes),
+            compress is not None)
+        shards.append((b, fn(*[placed[s.index] for s in b.slots])))
+        _count_launch()
+    return ScatteredTree(treedef, shards, mesh, axis, len(leaves))
 
 
 def measure_allreduce_gbps(mesh: Mesh, axis: str = "data",

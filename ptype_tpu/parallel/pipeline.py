@@ -27,8 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from ptype_tpu.compat import shard_map
 from ptype_tpu.errors import ClusterError
 
 
@@ -248,20 +248,34 @@ def _spmd_pipeline_1f1b(stage_fn, tail_fn, stage_params, wnorm, head,
             c["stash"])
         # Tail (norm+head+loss) VJP on the stage that just produced
         # final activations; its cotangent seeds this stage's OWN
-        # backward next tick. (Masked on other stages — SPMD has no
-        # per-device control flow.)
-        (nll_m, den_m), tail_vjp = jax.vjp(
-            lambda wn, hd, yy: tail_fn(wn, hd, yy, tgt_mb[m_f_c],
-                                       mask_mb[m_f_c]),
-            wnorm, head, y)
-        dwn, dhd, dy = tail_vjp((jnp.float32(1.0), jnp.float32(0.0)))
+        # backward next tick. Guarded by lax.cond — XLA's conditional
+        # IS per-device control flow under manual shard_map, so only
+        # stage S-1 pays the vocab matmul; a masked-but-computed tail
+        # would burn (S-1)/S of the head FLOPs on results it discards
+        # (advisor round-5 finding).
         tail_valid = is_last & fwd_valid
-        nll = c["nll"] + jnp.where(tail_valid, nll_m, 0.0)
-        den = c["den"] + jnp.where(tail_valid, den_m, 0.0)
-        gnorm = c["gnorm"] + jnp.where(tail_valid, dwn, 0.0)
-        ghead = c["ghead"] + jnp.where(tail_valid, dhd, 0.0)
-        self_ct = jnp.where(tail_valid, dy.astype(x_mb.dtype),
-                            zeros_mb)
+
+        def run_tail(y_in):
+            (nll_m, den_m), tail_vjp = jax.vjp(
+                lambda wn, hd, yy: tail_fn(wn, hd, yy, tgt_mb[m_f_c],
+                                           mask_mb[m_f_c]),
+                wnorm, head, y_in)
+            dwn, dhd, dy = tail_vjp((jnp.float32(1.0), jnp.float32(0.0)))
+            return (nll_m.astype(jnp.float32), den_m.astype(jnp.float32),
+                    dwn, dhd, dy.astype(x_mb.dtype))
+
+        def skip_tail(y_in):
+            del y_in
+            return (jnp.float32(0.0), jnp.float32(0.0),
+                    jnp.zeros_like(wnorm), jnp.zeros_like(head),
+                    zeros_mb)
+
+        nll_m, den_m, dwn, dhd, self_ct = lax.cond(
+            tail_valid, run_tail, skip_tail, y)
+        nll = c["nll"] + nll_m
+        den = c["den"] + den_m
+        gnorm = c["gnorm"] + dwn
+        ghead = c["ghead"] + dhd
 
         # --------------- backward op: microbatch m_b = t-(2S-1)+stage
         m_b = t - (2 * S - 1) + stage

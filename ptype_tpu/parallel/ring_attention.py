@@ -27,7 +27,8 @@ from functools import partial
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ptype_tpu.compat import shard_map
 
 NEG_INF = jnp.float32(-1e30)
 

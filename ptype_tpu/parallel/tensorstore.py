@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from dataclasses import dataclass, field
 
 import jax
@@ -237,25 +238,104 @@ class TensorStore:
     # -------------------------------------------------------------- tree
 
     def put_tree(self, prefix: str, tree) -> None:
-        for key, leaf in _flatten(prefix, tree):
-            self.put(key, leaf)
+        """Place every leaf under its path-derived key (no collective).
 
-    def push_tree(self, prefix: str, stacked_tree, op: str | None = None):
-        """Push every leaf of a pytree of stacked contributions."""
-        return {
-            key: self.push(key, leaf, op)
-            for key, leaf in _flatten(prefix, stacked_tree)
-        }
+        All host→device transfers dispatch through ONE batched
+        device_put instead of a per-leaf loop, then each key commits
+        with the same epoch-0/binding/manifest semantics as
+        :meth:`put`."""
+        pairs = _flatten(prefix, tree)
+        bindings = [self.binding(key) for key, _ in pairs]
+        arrs = jax.device_put(
+            [jnp.asarray(leaf) for _, leaf in pairs],
+            [NamedSharding(self.mesh, b.spec) for b in bindings])
+        with self._lock:
+            for (key, _), b, arr in zip(pairs, bindings, arrs):
+                self._entries[key] = _Entry(arr, 0, b)
+        for key, _ in pairs:
+            self._publish(key)
 
-    def get_tree(self, prefix: str) -> dict[str, jax.Array]:
-        """All keys under ``prefix/`` as a flat dict."""
+    def push_tree(self, prefix: str, stacked_tree, op: str | None = None,
+                  *, bucketed: bool = True,
+                  bucket_bytes: int | None = None) -> dict[str, jax.Array]:
+        """Push every leaf of a pytree of stacked contributions.
+
+        Bucketed (the default): leaves are grouped by reduce op, packed
+        into large same-dtype flat buckets, and reduced with ONE fused
+        collective per bucket (``collectives.bucketed_all_reduce``) —
+        the whole optimus-125M tree costs ceil(bytes/bucket) launches
+        per dtype group instead of one per leaf, and every bucket is in
+        flight before the first result commits. The store's compression
+        policy applies per bucket (int8 finally meets its
+        size-eligibility threshold there). Per-key semantics are
+        unchanged: each key commits its unpacked view — epoch bump,
+        binding spec, manifest publish — exactly like a per-leaf
+        :meth:`push`.
+
+        ``bucketed=False`` is the legacy per-leaf path, kept as the
+        parity baseline and escape hatch. Returns ``{key: reduced}``.
+        """
+        from ptype_tpu.metrics import annotate, metrics
+
+        pairs = _flatten(prefix, stacked_tree)
+        if not bucketed:
+            return {key: self.push(key, leaf, op) for key, leaf in pairs}
+
+        t0 = _time.perf_counter()
+        # Group by resolved reduce op (dtype grouping happens inside
+        # the bucket planner); op=None honors each key's binding.
+        groups: dict[str, list[tuple[str, jax.Array]]] = {}
+        for key, leaf in pairs:
+            resolved = op or self.binding(key).reduce_op
+            groups.setdefault(resolved, []).append(
+                (key, jnp.asarray(leaf)))
+        reduced: dict[str, jax.Array] = {}
+        with annotate(f"store.push_tree/{prefix}"):
+            for group_op, items in groups.items():
+                outs = collectives.bucketed_all_reduce(
+                    [leaf for _, leaf in items], self.mesh, self.axis,
+                    group_op,
+                    bucket_bytes=(bucket_bytes
+                                  or collectives.DEFAULT_BUCKET_BYTES),
+                    compress=self.compress)
+                for (key, _), out in zip(items, outs):
+                    reduced[key] = out
+        # Commit the unpacked views: reshard keys with non-replicated
+        # bindings in one batched device_put, then bump epoch + publish
+        # manifest per key (the per-key Store contract).
+        sharded = [k for k in reduced if self.binding(k).spec != P()]
+        if sharded:
+            arrs = jax.device_put(
+                [reduced[k] for k in sharded],
+                [NamedSharding(self.mesh, self.binding(k).spec)
+                 for k in sharded])
+            reduced.update(zip(sharded, arrs))
+        out = {key: self._commit(key, reduced[key], self.binding(key))
+               for key, _ in pairs}
+        metrics.timing("store.push_tree").observe(
+            _time.perf_counter() - t0)
+        metrics.counter("store.push_tree.leaves").add(len(pairs))
+        return out
+
+    def get_tree(self, prefix: str,
+                 gather: bool = False) -> dict[str, jax.Array]:
+        """All keys under ``prefix/`` as a flat dict. ``gather=True``
+        returns fully-replicated views (the allgather lowering of a
+        linearizable read), resharded through one batched device_put."""
         sep = prefix + "/"
         with self._lock:
             hits = {k: e.value for k, e in self._entries.items()
                     if k.startswith(sep)}
         if not hits:
             raise NoKeyError(prefix)
-        return dict(sorted(hits.items()))
+        hits = dict(sorted(hits.items()))
+        if gather:
+            keys = list(hits)
+            arrs = jax.device_put(
+                [hits[k] for k in keys],
+                [NamedSharding(self.mesh, P())] * len(keys))
+            hits = dict(zip(keys, arrs))
+        return hits
 
     # ---------------------------------------------------------- manifest
 
@@ -341,3 +421,68 @@ def _path_part(p) -> str:
     if hasattr(p, "idx"):
         return str(p.idx)
     return str(p)
+
+
+# ---------------------------------------------------------------- benching
+
+
+def measure_push_tree(mesh: Mesh, axis: str = "data",
+                      preset: str = "tiny", iters: int = 3,
+                      compress: str | None = None,
+                      bucket_bytes: int | None = None) -> dict:
+    """Wall-clock a full param-tree gradient push, bucketed vs
+    per-leaf — the BENCH ``store_push_tree_ms`` metric.
+
+    Builds the ``preset`` transformer's parameter tree, fakes stacked
+    per-worker grads (each device holding one contribution), and times
+    ``push_tree`` both ways after a warm/compile pass. The scalar
+    readback per drain is deliberate: ``block_until_ready`` does not
+    drain the axon device tunnel (docs/PERF.md measurement gotcha).
+    """
+    from ptype_tpu.models import transformer as tfm
+
+    cfg = tfm.preset(preset)
+    params = jax.jit(lambda r: tfm.init_params(r, cfg))(
+        jax.random.PRNGKey(0))
+    n = int(mesh.shape[axis])
+    stacked = jax.tree_util.tree_map(
+        lambda p: jax.device_put(
+            jnp.broadcast_to(p[None], (n, *p.shape)),
+            NamedSharding(mesh, P(axis, *(None,) * p.ndim))),
+        params)
+    store = TensorStore(mesh, axis, compress=compress)
+    leaves = jax.tree_util.tree_leaves(params)
+    nbytes = sum(v.size * v.dtype.itemsize for v in leaves)
+
+    def drain(out: dict) -> None:
+        for v in out.values():
+            v.block_until_ready()
+        float(jnp.sum(next(iter(out.values()))))
+
+    def timed(bucketed: bool) -> float:
+        drain(store.push_tree("g", stacked, op="mean",
+                              bucketed=bucketed,
+                              bucket_bytes=bucket_bytes))  # compile+warm
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = store.push_tree("g", stacked, op="mean",
+                                  bucketed=bucketed,
+                                  bucket_bytes=bucket_bytes)
+        drain(out)
+        return (_time.perf_counter() - t0) / iters
+
+    per_leaf = timed(False)
+    bucketed = timed(True)
+    plan = collectives.plan_buckets(
+        jax.tree_util.tree_leaves(stacked), n,
+        bucket_bytes or collectives.DEFAULT_BUCKET_BYTES)
+    return {
+        "bucketed_ms": round(bucketed * 1e3, 2),
+        "per_leaf_ms": round(per_leaf * 1e3, 2),
+        "speedup": round(per_leaf / bucketed, 2) if bucketed else None,
+        "n_leaves": len(leaves),
+        "n_buckets": len(plan),
+        "payload_mb": round(nbytes / 2**20, 2),
+        # Ring allreduce moves 2*(n-1)/n of the buffer per device.
+        "gbps": round(2 * (n - 1) / n * nbytes / bucketed / 1e9, 2),
+    }

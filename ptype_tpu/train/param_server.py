@@ -102,7 +102,10 @@ class ParamServer:
 
     def Sync(self) -> dict:
         """Publish current params into the TensorStore namespace (for
-        checkpointers / late joiners reading the manifest)."""
+        checkpointers / late joiners reading the manifest). Rides the
+        bucketed tree path: put_tree dispatches every leaf's placement
+        through one batched device_put, so a Sync under the push lock
+        stalls concurrent workers for one dispatch, not one per leaf."""
         with self._lock:
             self.store.put_tree("params", self._params)
             return {"version": self._version}

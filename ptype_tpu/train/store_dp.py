@@ -93,9 +93,12 @@ class StoreDPTrainer:
         params = self.params()
         losses, grads = self._grads_fn(params, stacked)
 
-        # The gather: Store push == pmean allreduce over the data axis.
-        self.store.push_tree("grads", grads, op="mean")
-        reduced_flat = self.store.get_tree("grads")
+        # The gather: Store push == pmean allreduce over the data axis,
+        # bucketed — the whole grad tree reduces in ceil(bytes/bucket)
+        # fused launches per dtype group, all in flight before the
+        # optimizer consumes the first leaf. push_tree returns the
+        # committed views, so no second get_tree round trip.
+        reduced_flat = self.store.push_tree("grads", grads, op="mean")
         reduced = jax.tree_util.tree_unflatten(
             self._treedef,
             [reduced_flat[k.replace("params/", "grads/", 1)]

@@ -1,0 +1,68 @@
+"""Unit tier for this round's tooling satellites: the PT001 per-leaf
+collective lint rule and the TTL-derived repl pump idle tick."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import lint  # noqa: E402  (tools/ is not a package)
+
+
+def _check(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    findings = []
+    lint.check_file(str(p), findings)
+    return findings
+
+
+LOOPED_PUSH = (
+    "def f(store, leaves):\n"
+    "    for leaf in leaves:\n"
+    "        store.push('k', leaf)\n"
+)
+
+
+def test_pt001_flags_per_leaf_loop_in_train(tmp_path):
+    findings = _check(tmp_path, "train/bad.py", LOOPED_PUSH)
+    assert any("PT001" in f for f in findings), findings
+
+
+def test_pt001_flags_comprehensions(tmp_path):
+    src = ("def f(store, leaves):\n"
+           "    return [store.all_reduce(x) for x in leaves]\n")
+    findings = _check(tmp_path, "train/comp.py", src)
+    assert any("PT001" in f for f in findings), findings
+
+
+def test_pt001_silent_outside_train(tmp_path):
+    findings = _check(tmp_path, "parallel/ok.py", LOOPED_PUSH)
+    assert not any("PT001" in f for f in findings), findings
+
+
+def test_pt001_honors_noqa(tmp_path):
+    src = ("def f(store, leaves):\n"
+           "    for leaf in leaves:\n"
+           "        store.push('k', leaf)  # noqa: intentional\n")
+    findings = _check(tmp_path, "train/sup.py", src)
+    assert not any("PT001" in f for f in findings), findings
+
+
+def test_pt001_ignores_unlooped_calls(tmp_path):
+    src = ("def f(store, stacked):\n"
+           "    return store.push('k', stacked)\n")
+    findings = _check(tmp_path, "train/fine.py", src)
+    assert not any("PT001" in f for f in findings), findings
+
+
+def test_repl_idle_tick_derives_from_ttl():
+    import pytest
+
+    from ptype_tpu.coord.service import _repl_idle_tick
+
+    assert _repl_idle_tick(3.0) == 1.0       # default TTL: old behavior
+    # small TTL: 3 ticks per TTL so a quiet follower's vote can't flap
+    assert _repl_idle_tick(0.6) == pytest.approx(0.2)
+    assert _repl_idle_tick(30.0) == 1.0      # big TTL: 1 s ceiling holds

@@ -33,7 +33,7 @@ def _batch(cfg, batch, seq, seed=0):
                     reason="jax.Array.is_ready unavailable")
 def test_store_push_dispatched_before_backward_completes(monkeypatch):
     # Heavy enough that the backward outlives the host's dispatch of
-    # the push loop; small enough to compile fast on the CPU mesh.
+    # the bucketed push; small enough to compile fast on the CPU mesh.
     cfg = tfm.preset("tiny", d_model=256, n_layers=4, d_ff=1024,
                      max_seq=256)
     mesh = build_mesh({"data": 8})
@@ -43,27 +43,31 @@ def test_store_push_dispatched_before_backward_completes(monkeypatch):
 
     trainer.step(batch)  # compile everything; assert on steady state
 
-    events: list[tuple[str, bool]] = []
-    orig_push = TensorStore.push
+    # The trainer's gradient exchange is the BUCKETED push: spy at the
+    # bucket dispatch point (push_tree → bucketed_all_reduce) and
+    # record whether the stacked gradient leaves were still being
+    # computed when the collective was enqueued.
+    events: list[bool] = []
+    from ptype_tpu.parallel import collectives as C
 
-    def spy_push(self, key, stacked, op=None):
-        ready = bool(stacked.is_ready()) if isinstance(
-            stacked, jax.Array) else True
-        events.append((key, ready))
-        return orig_push(self, key, stacked, op)
+    orig_bucketed = C.bucketed_all_reduce
 
-    monkeypatch.setattr(TensorStore, "push", spy_push)
+    def spy_bucketed(leaves, *a, **kw):
+        events.append(any(
+            isinstance(x, jax.Array) and not x.is_ready()
+            for x in leaves))
+        return orig_bucketed(leaves, *a, **kw)
+
+    monkeypatch.setattr(C, "bucketed_all_reduce", spy_bucketed)
     trainer.step(_batch(cfg, batch=16, seq=256, seed=1))
 
-    assert events, "no pushes recorded"
-    grad_events = [e for e in events if e[0].startswith("grads/")]
-    assert grad_events, f"no gradient pushes: {events}"
-    # At least one gradient push was enqueued while its input was still
-    # being computed — the push overlaps the backward. (The tail of the
-    # leaf list may already be ready; the head dispatches first.)
-    assert any(not ready for _, ready in grad_events), (
-        "every push waited for its gradient: dispatch does not overlap "
-        f"the backward ({len(grad_events)} pushes, all inputs ready)")
+    assert events, "no bucketed pushes recorded"
+    # At least one bucket was enqueued while its input gradients were
+    # still being computed — the reduction overlaps the backward.
+    assert any(events), (
+        "every bucket waited for its gradients: dispatch does not "
+        f"overlap the backward ({len(events)} buckets, all inputs "
+        "ready)")
 
 
 def test_store_step_blocks_only_after_update_dispatch(monkeypatch):
@@ -80,19 +84,21 @@ def test_store_step_blocks_only_after_update_dispatch(monkeypatch):
 
     order: list[str] = []
 
-    orig_push = TensorStore.push
-    orig_put = TensorStore.put
+    orig_push_tree = TensorStore.push_tree
+    orig_put_tree = TensorStore.put_tree
     orig_apply = trainer._apply_fn
     orig_float = jnp.mean
 
     monkeypatch.setattr(
-        TensorStore, "push",
-        lambda self, key, stacked, op=None: (
-            order.append("push"), orig_push(self, key, stacked, op))[1])
+        TensorStore, "push_tree",
+        lambda self, prefix, tree, op=None, **kw: (
+            order.append("push"),
+            orig_push_tree(self, prefix, tree, op, **kw))[1])
     monkeypatch.setattr(
-        TensorStore, "put",
-        lambda self, key, value, spec=None: (
-            order.append("put"), orig_put(self, key, value, spec))[1])
+        TensorStore, "put_tree",
+        lambda self, prefix, tree: (
+            order.append("put"),
+            orig_put_tree(self, prefix, tree))[1])
     trainer._apply_fn = lambda *a: (order.append("apply"),
                                     orig_apply(*a))[1]
     monkeypatch.setattr(
@@ -102,9 +108,10 @@ def test_store_step_blocks_only_after_update_dispatch(monkeypatch):
 
     trainer.step(_batch(cfg, batch=8, seq=64, seed=2))
 
-    assert "apply" in order and "loss-sync" in order
-    # Every push and the optimizer-update dispatch precede the one
-    # host sync; nothing blocks between the collective and the update.
+    assert "push" in order and "apply" in order and "loss-sync" in order
+    # The bucketed push AND the optimizer-update dispatch precede the
+    # one host sync; nothing blocks between the collective and the
+    # update (the params put-back rides the same async queue).
     sync_at = order.index("loss-sync")
     assert order.index("apply") < sync_at
     assert all(i < sync_at for i, ev in enumerate(order)

@@ -1,0 +1,250 @@
+"""Bucketed tree collectives: numerics parity vs the per-leaf path,
+bucket planning, per-bucket compression eligibility, and the per-key
+Store semantics the bucketing must not change (epoch/manifest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ptype_tpu.parallel import collectives as C
+from ptype_tpu.parallel import mesh as M
+from ptype_tpu.parallel.tensorstore import TensorStore
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return M.build_mesh({"data": 8})
+
+
+def _grad_tree(seed=0):
+    """Mixed-dtype tree whose f32 leaves straddle small bucket
+    targets: 13+15 elems pack into one 200 B bucket, the 100-elem leaf
+    overflows into its own."""
+    rng = np.random.default_rng(seed)
+    return {
+        "blk": {"w": rng.normal(size=(8, 13)).astype(np.float32),
+                "b": rng.normal(size=(8, 3, 5)).astype(np.float32)},
+        "big": (rng.normal(size=(8, 100)) * 3).astype(np.float32),
+        "bf": rng.normal(size=(8, 7)).astype(jnp.bfloat16),
+        "step": rng.integers(0, 9, size=(8, 4)).astype(np.int32),
+        "scalar": rng.normal(size=(8,)).astype(np.float32),
+    }
+
+
+class TestPlanBuckets:
+    def test_groups_by_dtype_and_fills_to_target(self, mesh8):
+        leaves = jax.tree_util.tree_leaves(_grad_tree())
+        plan = C.plan_buckets(leaves, 8, bucket_bytes=200)
+        # f32 leaves: 3+1 (big overflows + scalar rides with the pack),
+        # one bf16, one i32 bucket — every dtype group separate.
+        dtypes = [b.dtype for b in plan]
+        assert set(dtypes) == {"float32", "bfloat16", "int32"}
+        for b in plan:
+            assert b.elems % 8 == 0, "buckets must pad to axis multiple"
+
+    def test_launches_bounded_by_ceil_bytes_over_bucket(self, mesh8):
+        """Acceptance bound: ≤ ceil(group_bytes/bucket) + 1 launches
+        per dtype group (the +1 is the greedy packer's open bucket —
+        a leaf that would straddle the boundary starts a new one)."""
+        leaves = jax.tree_util.tree_leaves(_grad_tree())
+        for target in (200, 4096, C.DEFAULT_BUCKET_BYTES):
+            plan = C.plan_buckets(leaves, 8, bucket_bytes=target)
+            groups = {}
+            for leaf in leaves:
+                dt = jnp.dtype(leaf.dtype).name
+                per_dev = leaf.size // leaf.shape[0] * leaf.dtype.itemsize
+                groups[dt] = groups.get(dt, 0) + per_dev
+            for dt, nbytes in groups.items():
+                n_buckets = sum(1 for b in plan if b.dtype == dt)
+                assert n_buckets <= -(-nbytes // target) + 1, (
+                    dt, target, n_buckets)
+
+    def test_default_target_packs_everything_per_dtype(self):
+        leaves = jax.tree_util.tree_leaves(_grad_tree())
+        plan = C.plan_buckets(leaves, 8)
+        assert len(plan) == 3  # one bucket per dtype at 32 MiB target
+
+    def test_oversize_leaf_gets_own_bucket(self):
+        leaves = [np.ones((8, 4), np.float32),
+                  np.ones((8, 4096), np.float32),
+                  np.ones((8, 4), np.float32)]
+        plan = C.plan_buckets(leaves, 8, bucket_bytes=64)
+        assert [len(b.slots) for b in plan] == [1, 1, 1]
+
+    def test_rejects_unstacked_leaf(self):
+        with pytest.raises(ValueError, match="contribution axis"):
+            C.plan_buckets([np.ones((4, 2), np.float32)], 8)
+
+
+class TestTreeAllReduce:
+    def test_parity_vs_per_leaf_exact(self, mesh8):
+        """Bit-exact vs per-leaf all_reduce for sum/mean across mixed
+        dtypes, with leaves straddling bucket boundaries."""
+        tree = _grad_tree()
+        for op in ("sum", "mean"):
+            red = C.tree_all_reduce(tree, mesh8, op=op, bucket_bytes=200)
+            flat_red = jax.tree_util.tree_leaves(red)
+            flat_in = jax.tree_util.tree_leaves(tree)
+            for got, x in zip(flat_red, flat_in):
+                ref = C.all_reduce(jnp.asarray(x), mesh8, "data", op)
+                np.testing.assert_array_equal(
+                    np.asarray(got), np.asarray(ref))
+                assert got.dtype == ref.dtype
+
+    def test_results_replicated(self, mesh8):
+        red = C.tree_all_reduce({"w": jnp.ones((8, 6))}, mesh8)
+        assert red["w"].sharding.is_fully_replicated
+
+    def test_launch_count_is_bucket_count(self, mesh8):
+        from ptype_tpu.metrics import metrics
+
+        tree = _grad_tree()
+        leaves = jax.tree_util.tree_leaves(tree)
+        plan = C.plan_buckets(leaves, 8, bucket_bytes=200)
+        ctr = metrics.counter("collectives.bucket_launches")
+        before = ctr.value
+        C.tree_all_reduce(tree, mesh8, op="sum", bucket_bytes=200)
+        assert ctr.value - before == len(plan) < len(leaves)
+
+    def test_int8_bucket_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(3)
+        tree = {"a": rng.normal(size=(8, 64)).astype(np.float32),
+                "b": rng.normal(size=(8, 33)).astype(np.float32)}
+        red = C.tree_all_reduce(tree, mesh8, op="mean", compress="int8",
+                                int8_min_bytes=0)
+        amax = max(np.abs(tree["a"]).max(), np.abs(tree["b"]).max())
+        tol = 2.5 * amax / 127.0  # two round-to-nearest quantizations
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(red[k]), np.asarray(tree[k]).mean(0), atol=tol)
+
+    def test_int8_ineligible_buckets_ride_exact(self, mesh8):
+        """Int buckets and below-threshold buckets must be bit-exact
+        under compress='int8' — the caller opted into float loss only."""
+        tree = {"step": np.full((8, 4), 3, np.int32),
+                "tiny": np.full((8, 5), 1.001, np.float32)}
+        red = C.tree_all_reduce(tree, mesh8, op="sum", compress="int8",
+                                int8_min_bytes=10**6)
+        np.testing.assert_array_equal(np.asarray(red["step"]),
+                                      np.full(4, 24, np.int32))
+        np.testing.assert_allclose(np.asarray(red["tiny"]),
+                                   np.full(5, 8.008), rtol=1e-6)
+
+    def test_bf16_wire_skips_int_leaves(self, mesh8):
+        tree = {"f": np.full((8, 4), 0.5, np.float32),
+                "i": np.full((8, 4), 1 << 20, np.int32)}
+        red = C.tree_all_reduce(tree, mesh8, op="sum", compress="bf16")
+        # 8 << 20 overflows bf16's 8-bit mantissa granularity at that
+        # magnitude only slightly — but ints must be EXACT regardless.
+        np.testing.assert_array_equal(np.asarray(red["i"]),
+                                      np.full(4, 8 << 20, np.int32))
+        np.testing.assert_allclose(np.asarray(red["f"]), np.full(4, 4.0),
+                                   rtol=1e-2)
+
+    def test_max_min_ops(self, mesh8):
+        x = np.random.default_rng(5).normal(size=(8, 9)).astype(np.float32)
+        for op, ref in (("max", x.max(0)), ("min", x.min(0))):
+            red = C.tree_all_reduce({"x": x}, mesh8, op=op)
+            np.testing.assert_allclose(np.asarray(red["x"]), ref,
+                                       rtol=1e-6)
+
+
+class TestTreeReduceScatter:
+    def test_gather_matches_allreduce(self, mesh8):
+        rng = np.random.default_rng(6)
+        tree = {"a": rng.normal(size=(8, 13)).astype(np.float32),
+                "b": rng.normal(size=(8, 3, 5)).astype(np.float32)}
+        st = C.tree_reduce_scatter(tree, mesh8, op="sum",
+                                   bucket_bytes=200)
+        assert all(not a.sharding.is_fully_replicated
+                   for _, a in st.buckets)
+        g = st.gather()
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(tree[k]).sum(0), rtol=2e-5)
+
+    def test_int8_scatter_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(7)
+        tree = {"a": rng.normal(size=(8, 64)).astype(np.float32)}
+        st = C.tree_reduce_scatter(tree, mesh8, op="sum",
+                                   compress="int8", int8_min_bytes=0)
+        g = st.gather()
+        tol = 1.5 * np.abs(tree["a"]).max() / 127.0 * 8
+        np.testing.assert_allclose(np.asarray(g["a"]),
+                                   np.asarray(tree["a"]).sum(0), atol=tol)
+
+    def test_rejects_unsupported_op(self, mesh8):
+        with pytest.raises(ValueError, match="sum.*mean"):
+            C.tree_reduce_scatter({"x": jnp.ones((8, 4))}, mesh8,
+                                  op="max")
+
+
+class TestBucketedPushTree:
+    def test_parity_vs_per_leaf_push(self, mesh8):
+        ts = TensorStore(mesh8)
+        tree = _grad_tree(2)
+        bucketed = ts.push_tree("b", tree, op="sum", bucket_bytes=200)
+        per_leaf = ts.push_tree("p", tree, op="sum", bucketed=False)
+        assert set(k.split("/", 1)[1] for k in bucketed) == \
+               set(k.split("/", 1)[1] for k in per_leaf)
+        for k, v in bucketed.items():
+            ref = per_leaf["p/" + k.split("/", 1)[1]]
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(ref),
+                                          err_msg=k)
+            assert v.dtype == ref.dtype
+
+    def test_epoch_and_manifest_semantics_per_key(self, mesh8, coord):
+        from ptype_tpu.store import KVStore
+        import json
+
+        kv = KVStore(coord)
+        ts = TensorStore(mesh8, kv=kv, namespace="bt")
+        tree = {"w": jnp.ones((8, 16)), "b": jnp.ones((8, 4))}
+        ts.push_tree("g", tree, op="sum")
+        assert ts.epoch("g/w") == 1 and ts.epoch("g/b") == 1
+        ts.push_tree("g", tree, op="sum")
+        assert ts.epoch("g/w") == 2 and ts.epoch("g/b") == 2
+        meta = json.loads(kv.get_one("tensors/bt/g/w"))
+        assert meta["shape"] == [16] and meta["epoch"] == 2
+
+    def test_push_tree_respects_binding_spec_and_op(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.bind("g/w", P("data"), reduce_op="sum")
+        out = ts.push_tree("g", {"w": jnp.ones((8, 16)),
+                                 "b": jnp.ones((8, 4))})
+        # w: bound op=sum, sharded; b: unbound default mean, replicated
+        np.testing.assert_allclose(np.asarray(out["g/w"]),
+                                   np.full(16, 8.0))
+        assert not out["g/w"].sharding.is_fully_replicated
+        np.testing.assert_allclose(np.asarray(out["g/b"]), np.ones(4))
+        assert out["g/b"].sharding.is_fully_replicated
+
+    def test_int8_store_compression_bucketed(self, mesh8):
+        ts = TensorStore(mesh8, compress="int8")
+        rng = np.random.default_rng(8)
+        # 17-wide leaf: per-leaf int8 was INELIGIBLE (17 % 8 != 0);
+        # the bucket pads to a multiple of 8, so it quantizes now.
+        tree = {"a": rng.normal(size=(8, 17)).astype(np.float32)}
+        out = ts.push_tree("g", tree, op="mean",
+                           bucket_bytes=C.DEFAULT_BUCKET_BYTES)
+        tol = 2.5 * np.abs(tree["a"]).max() / 127.0
+        np.testing.assert_allclose(np.asarray(out["g/a"]),
+                                   np.asarray(tree["a"]).mean(0),
+                                   atol=tol)
+
+    def test_put_tree_batched_semantics(self, mesh8):
+        ts = TensorStore(mesh8)
+        params = {"l0": {"w": jnp.ones((4, 4))}, "l1": jnp.zeros(3)}
+        ts.put_tree("params", params)
+        assert ts.epoch("params/l0/w") == 0
+        got = ts.get_tree("params")
+        assert set(got) == {"params/l0/w", "params/l1"}
+
+    def test_get_tree_gather_replicates(self, mesh8):
+        ts = TensorStore(mesh8)
+        ts.bind("g/w", P("data"), reduce_op="sum")
+        ts.push_tree("g", {"w": jnp.ones((8, 16))})
+        got = ts.get_tree("g", gather=True)
+        assert got["g/w"].sharding.is_fully_replicated

@@ -17,6 +17,9 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
 - bare ``except:`` clauses
 - ``== / !=`` comparisons against None / True / False
 - f-strings with no placeholders
+- PT001 (train/ only): an eager collective called inside a Python
+  loop/comprehension — the per-leaf launch pattern the bucketed tree
+  collectives exist to kill (parallel/collectives.tree_all_reduce)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -181,6 +184,51 @@ class _AstChecks(ast.NodeVisitor):
         # re-reports the same literal.
 
 
+#: Method/function names that dispatch one eager collective per call.
+#: Calling any of these per pytree leaf inside a Python loop issues one
+#: XLA launch per leaf — the anti-pattern the bucketed tree collectives
+#: replace (one fused launch per dtype bucket).
+_EAGER_COLLECTIVES = frozenset({
+    "push", "push_scatter", "all_reduce", "all_gather",
+    "reduce_scatter", "quantized_all_reduce",
+    "quantized_reduce_scatter", "all_to_all", "ring_shift",
+})
+
+
+class _PerLeafCollectiveCheck(ast.NodeVisitor):
+    """PT001: eager collective in a loop body (train/ files only —
+    hot-path trainers must ride TensorStore.push_tree /
+    collectives.tree_all_reduce, which bucket leaves into fused
+    launches)."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        self.loop_depth = 0
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+    visit_ListComp = visit_SetComp = _loop
+    visit_DictComp = visit_GeneratorExp = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = None
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        if self.loop_depth and name in _EAGER_COLLECTIVES:
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT001 eager collective "
+                f"{name!r} called in a per-leaf loop; bucket it "
+                f"(TensorStore.push_tree / collectives.tree_all_reduce)")
+        self.generic_visit(node)
+
+
 def check_file(path: str, findings: list[str]) -> None:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -193,6 +241,8 @@ def check_file(path: str, findings: list[str]) -> None:
     raw: list[str] = []
     v = _AstChecks(path, is_init, raw)
     v.visit(tree)
+    if "train" in os.path.normpath(path).split(os.sep):
+        _PerLeafCollectiveCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
