@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench lint native tpu-smoke tpu-validate
+.PHONY: test test-all bench lint native tpu-smoke tpu-validate chaos
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -14,6 +14,15 @@ test-all:
 
 bench:
 	python bench.py
+
+# Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
+# fault schedule against the in-process trainer + registry +
+# coordinator stack every run. On failure the harness prints the
+# FaultPlan JSON; replay the exact schedule with
+# PTYPE_CHAOS_SOAK_SEED=<seed> make chaos.
+chaos:
+	PTYPE_CHAOS_SOAK_SEED=$${PTYPE_CHAOS_SOAK_SEED:-$$(date +%s)} \
+		python -m pytest tests/test_chaos_soak.py -q
 
 # Compile + run the Pallas flash kernel fwd/bwd on an attached TPU —
 # the only tier that sees Mosaic tiling checks (exit 42 = no TPU,

@@ -56,6 +56,11 @@ class ActorServer:
         self.host, self.port = self._sock.getsockname()[:2]
         self._closed = threading.Event()
         self._thread: threading.Thread | None = None
+        #: Live accepted connections, so close() can shut them down —
+        #: a reader parked in recv(2) is not woken by close() alone and
+        #: would otherwise outlive the server as a wedged thread.
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     # ------------------------------------------------------------ handlers
 
@@ -118,6 +123,8 @@ class ActorServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
                 name=f"actor-conn-{peer[1]}", daemon=True
@@ -144,6 +151,8 @@ class ActorServer:
                     daemon=True,
                 ).start()
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -191,7 +200,26 @@ class ActorServer:
         with _local_lock:
             for key in [k for k, v in _local_servers.items() if v is self]:
                 del _local_servers[key]
+        # shutdown() before close(): threads parked in accept(2)/recv(2)
+        # are not woken by close() alone — without this, every conn
+        # reader (and the accept loop) outlives the server as a wedged
+        # daemon thread.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -44,13 +44,14 @@ import os
 import shutil
 import threading
 import time
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
-from ptype_tpu import logs
-from ptype_tpu.errors import ClusterError
+from ptype_tpu import chaos, logs, retry
+from ptype_tpu.errors import CheckpointError, ClusterError
 
 log = logs.get_logger("checkpoint")
 
@@ -212,6 +213,16 @@ class Checkpointer:
         for fname, text in (extras or {}).items():
             with open(os.path.join(tmp, fname), "w") as f:
                 f.write(text)
+        f = chaos.hit("checkpoint.commit", str(step))
+        if f is not None and f.action == "crash":
+            # Crash between shard write and the commit rename: every
+            # shard and the manifest are on disk in the tmp dir, but
+            # the step never becomes visible — exactly the state a
+            # process death here leaves behind. restore() must fall
+            # back to the previous complete step.
+            raise CheckpointError(
+                f"chaos: crashed before committing step {step} "
+                f"(uncommitted shards left in {tmp})")
         with open(os.path.join(tmp, _COMPLETE), "w") as f:
             f.write("ok\n")
         if os.path.exists(final):
@@ -219,6 +230,7 @@ class Checkpointer:
         os.replace(tmp, final)
         self._gc()
         log.info("checkpoint saved", kv={"step": step, "dir": final})
+        chaos.note_ok("checkpoint.save", final)
         return final
 
     def _write_multi(self, step: int, host: list,
@@ -299,6 +311,7 @@ class Checkpointer:
             # character class that matches nothing — that presents as
             # a spurious barrier timeout only on multi-host runs.
             pat = os.path.join(_glob.escape(final), "manifest.p*.json")
+            barrier_bo = retry.Backoff(base=0.05, cap=0.25)
             while len(_glob.glob(pat)) < nproc:
                 if time.monotonic() > deadline:
                     # Leave the dir clearly incomplete for the next
@@ -310,7 +323,14 @@ class Checkpointer:
                         f"manifests arrived within {self.barrier_timeout}s"
                         " — not committing"
                     )
-                time.sleep(0.05)
+                barrier_bo.sleep()
+            f = chaos.hit("checkpoint.commit", str(step))
+            if f is not None and f.action == "crash":
+                # Crash after every shard landed but before the commit
+                # marker: the step must stay invisible to restore().
+                raise CheckpointError(
+                    f"chaos: crashed before committing step {step} "
+                    f"(no {_COMPLETE} marker written)")
             for fname, text in (extras or {}).items():
                 _atomic_write(final, fname, text)
             _atomic_write(final, _COMPLETE, "ok\n")
@@ -323,6 +343,7 @@ class Checkpointer:
             # delay instead of a spurious barrier timeout.
             marker = os.path.join(final, _COMPLETE)
             mf_path = os.path.join(final, mf_name)
+            commit_bo = retry.Backoff(base=0.2, cap=0.5)
             while not os.path.exists(marker):
                 if time.monotonic() > deadline:
                     raise ClusterError(
@@ -330,9 +351,10 @@ class Checkpointer:
                         f"commit within {self.barrier_timeout}s")
                 if not os.path.exists(mf_path):
                     _atomic_write(final, mf_name, mf_json)
-                time.sleep(0.2)
+                commit_bo.sleep()
         log.info("checkpoint shards saved",
                  kv={"step": step, "dir": final, "process": pid})
+        chaos.note_ok("checkpoint.save", final)
         return final
 
     # ---------------------------------------------------------- restore
@@ -406,6 +428,7 @@ class Checkpointer:
                 jax.numpy.asarray(full)
             )
             out.append(arr)
+        chaos.note_ok("checkpoint.restore", str(step))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # ----------------------------------------------------------- intern
@@ -422,7 +445,9 @@ class Checkpointer:
 def _save_shard(dirpath: str, fname: str, start: list,
                 data: np.ndarray) -> dict:
     """Write one shard file (tmp+rename — shared multi-writer dirs must
-    never expose partial files) and return its manifest record."""
+    never expose partial files) and return its manifest record, which
+    carries a crc32 of the logical bytes so restore can tell disk
+    corruption from a clean load."""
     raw = data.dtype.kind == "V"
     tmp = os.path.join(dirpath, f".tmp.{fname}.{os.getpid()}")
     with open(tmp, "wb") as f:
@@ -432,12 +457,33 @@ def _save_shard(dirpath: str, fname: str, start: list,
             # cannot assign them back. Persist the raw bytes; the
             # manifest keeps the logical dtype and restore views them
             # back through it.
-            np.save(f, np.frombuffer(data.tobytes(), np.uint8))
+            payload = data.tobytes()
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            np.save(f, np.frombuffer(payload, np.uint8))
         else:
+            # crc32 over the array's own buffer — no tobytes() copy
+            # (a multi-GB shard must not transiently double in memory).
+            data = np.ascontiguousarray(data)
+            crc = zlib.crc32(data) & 0xFFFFFFFF
             np.save(f, data)
     os.replace(tmp, os.path.join(dirpath, fname))
+    cf = chaos.hit("checkpoint.shard", fname)
+    if cf is not None and cf.action == "corrupt":
+        _corrupt_file(os.path.join(dirpath, fname))
     return {"file": fname, "start": start,
-            "shape": list(data.shape), "raw": raw}
+            "shape": list(data.shape), "raw": raw, "crc32": crc}
+
+
+def _corrupt_file(path: str) -> None:
+    """Chaos ``checkpoint.shard``/``corrupt``: flip one byte in the
+    middle of the file AFTER the manifest checksum was computed — the
+    bit-rot restore must catch, never silently load."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1) or b"\x00"
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
 
 
 def _atomic_write(dirpath: str, fname: str, text: str) -> None:
@@ -527,10 +573,27 @@ def _resolve_dtype(name: str) -> np.dtype:
 
 
 def _load_shard(sdir: str, rec: dict, dtype: np.dtype) -> np.ndarray:
-    data = np.load(os.path.join(sdir, rec["file"]))
+    try:
+        loaded = np.load(os.path.join(sdir, rec["file"]))
+    except (OSError, ValueError) as e:
+        # Unreadable/garbled npy (corruption can land in the header):
+        # same contract as a checksum mismatch — name the shard.
+        raise CheckpointError(
+            f"restore: shard {rec['file']!r} is corrupt "
+            f"(unreadable: {e})") from e
+    want = rec.get("crc32")
+    if want is not None:
+        # Checksum the loaded buffer in place (raw shards: the uint8
+        # payload BEFORE the extension-dtype view, matching what save
+        # hashed) — no tobytes() copy of a possibly multi-GB shard.
+        got = zlib.crc32(np.ascontiguousarray(loaded)) & 0xFFFFFFFF
+        if got != want:
+            raise CheckpointError(
+                f"restore: shard {rec['file']!r} is corrupt: crc32 "
+                f"{got:#010x} != manifest {want:#010x}")
     if rec.get("raw"):
-        data = data.view(dtype).reshape(rec["shape"])
-    return np.asarray(data)
+        loaded = loaded.view(dtype).reshape(rec["shape"])
+    return np.asarray(loaded)
 
 
 def _check_tiling(key: str, shards: list[dict], shape: list[int]) -> None:

@@ -208,10 +208,13 @@ def join(cfg: Config) -> Cluster:
         # seed and joiners at the same instant.
         import time as _time
 
+        from ptype_tpu import retry as _retry
+
         endpoints = cfg.initial_cluster_client_urls or [coord_addr]
         deadline = _time.monotonic() + platform.dial_timeout
         last: Exception | None = None
         coord = None  # type: ignore[assignment]
+        join_bo = _retry.Backoff(base=0.2, cap=1.0)
         while coord is None:
             per_dial = max(0.5, deadline - _time.monotonic())
             try:
@@ -229,7 +232,7 @@ def join(cfg: Config) -> Cluster:
                         f"failed to reach coordination service via "
                         f"{endpoints}: {last}"
                     ) from e
-                _time.sleep(0.2)
+                join_bo.sleep()
 
     if platform.num_processes > 1:
         _init_jax_distributed(platform)

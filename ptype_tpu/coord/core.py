@@ -23,7 +23,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field, replace
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs
 from ptype_tpu.errors import CoordinationError
 
 log = logs.get_logger("coord")
@@ -489,6 +489,17 @@ class CoordState:
 
     def _append(self, rec: dict) -> None:
         """Log one mutation (called under the lock, before ack)."""
+        # Key is "<kind>:<kv-key>" (e.g. "p:services/x") so plans can
+        # target one record precisely — bare kind codes collide as
+        # substrings ("p" is inside "mp").
+        f = chaos.hit("coord.wal_append",
+                      f"{rec.get('o', '')}:{rec.get('k', '')}")
+        if f is not None and f.action == "delay":
+            # Deliberately sleeps UNDER the state lock: every op —
+            # including probe-serving member_list — wedges for the
+            # duration, which is how a drill makes a standby's probes
+            # time out and promote while this primary is alive-but-hung.
+            f.sleep()
         self._repl_seq += 1
         # Copy: an overflowing feed self-cancels INSIDE _push, which
         # removes it from this list mid-iteration — a sibling feed
@@ -824,6 +835,16 @@ class CoordState:
     def keepalive(self, lease_id: int) -> float:
         """Refresh a lease; returns the new TTL. Raises if expired/unknown."""
         self._check_fence()
+        f = chaos.hit("coord.keepalive", str(lease_id))
+        if f is not None and f.action == "revoke":
+            # Lease-revoke a member the SIGKILL way: the lease dies
+            # server-side and this keepalive fails exactly like one for
+            # an expired lease ("not found" routes the registration to
+            # its re-register path).
+            self.revoke(lease_id)
+            raise CoordinationError(
+                f"chaos: keepalive: lease {lease_id} not found "
+                f"(revoked by fault injection)")
         with self._lock:
             lease = self._leases.get(lease_id)
             if lease is None:

@@ -8,7 +8,7 @@ import threading
 import time
 import weakref
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs, retry
 from ptype_tpu.coord import wire
 from ptype_tpu.coord.api import CoordBackend
 from ptype_tpu.coord.core import (
@@ -264,20 +264,21 @@ class RemoteCoord(CoordBackend):
         if not self._reconnect_timeout:
             return False
         deadline = time.monotonic() + self._reconnect_timeout
-        delay = 0.2
+        bo = retry.Backoff(base=0.2, cap=2.0)
         while not self._closed.is_set():
             try:
                 self._sock = self._dial()
             except OSError:
+                delay = bo.next_delay()
                 if time.monotonic() + delay > deadline:
                     log.warning("coordination reconnect gave up",
                                 kv={"addr": self.address})
                     return False
-                time.sleep(delay)
-                delay = min(delay * 2, 2.0)
+                bo.sleep(delay)
                 continue
             log.info("coordination connection re-established",
                      kv={"addr": self.address})
+            chaos.note_ok("coord.reconnect", self.address)
             # Reap requests that were sent while we were re-dialing:
             # they went into the OLD socket (its first post-FIN write
             # "succeeds" locally) after the loss-path _fail_pending had
@@ -314,6 +315,7 @@ class RemoteCoord(CoordBackend):
         def current() -> bool:
             return gen == getattr(self, "_rewatch_gen", gen)
 
+        bo = retry.Backoff(base=0.5, cap=1.0)
         try:
             while not self._closed.is_set() and current():
                 failed = False
@@ -341,7 +343,7 @@ class RemoteCoord(CoordBackend):
                             res = self._call("watch", prefix=w.prefix)
                     except CoordinationError:
                         failed = True
-                        continue  # retried next round
+                        continue  # retried next round (backoff below)
                     new_id = res["id"]
                     with self._watches_lock:
                         if self._watches.pop(w.id, None) is not None:
@@ -381,7 +383,7 @@ class RemoteCoord(CoordBackend):
                                and not getattr(w, "_armed", True)
                                for w in self._watches.values()):
                         return
-                time.sleep(0.5)
+                bo.sleep()
         finally:
             # A superseded generation must NOT open the gate — its
             # successor cleared it and is still re-arming; opening it
@@ -441,6 +443,7 @@ class RemoteCoord(CoordBackend):
         executed) bounces to the next endpoint and retries until the
         current primary is found or the endpoint list is exhausted."""
         stale: _StaleCoordinator | None = None
+        bo = retry.Backoff(base=0.3, cap=1.0)
         for _ in range(2 * len(self.endpoints) + 2):
             if stale is not None:
                 # Wait for the reader's re-dial after the bounce.
@@ -453,7 +456,7 @@ class RemoteCoord(CoordBackend):
             except _SendFailed:
                 if stale is None:
                     raise  # ordinary failure: callers own the retry
-                time.sleep(0.3)  # mid-re-dial; let the reader land
+                bo.sleep()  # mid-re-dial; let the reader land
             # Any other CoordinationError (timeout, lost mid-request)
             # propagates even after a bounce: the op may have EXECUTED
             # on the current primary, and re-sending a non-idempotent
@@ -497,6 +500,16 @@ class RemoteCoord(CoordBackend):
     def _call_once(self, op: str, reply_timeout: float | None, kwargs):
         if self._closed.is_set():
             raise CoordinationError(f"coordination connection to {self.address} closed")
+        if (not self._connected.is_set()
+                and threading.current_thread() is not self._rewatch_thread):
+            # The reader is mid-re-dial: a send into the dead socket
+            # can "succeed" locally and then park this op until the
+            # whole reconnect window lapses. Fail fast instead — the
+            # op never left this client, so callers retry safely
+            # (exactly the outage contract the registry keepalive and
+            # failover tests already code against).
+            raise _SendFailed(
+                f"connection to {self.address} down (reconnect in flight)")
         if (not self._rewatch_gate.is_set()
                 and threading.current_thread() is not self._rewatch_thread):
             # A reconnect is re-arming watches; hold ordinary traffic so
@@ -543,6 +556,7 @@ class RemoteCoord(CoordBackend):
                     p.reply.get("error", "stale coordinator"),
                     endpoint=self.address)
             raise CoordinationError(p.reply.get("error", "unknown coordination error"))
+        chaos.note_ok("coord.op", op)
         return p.reply.get("result")
 
     # ------------------------------------------------------------------- KV
@@ -705,6 +719,12 @@ class RemoteCoord(CoordBackend):
         if self._closed.is_set():
             return
         self._closed.set()
+        try:
+            # shutdown() wakes the reader parked in recv(2); close()
+            # alone leaves it wedged until process exit.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

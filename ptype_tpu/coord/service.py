@@ -15,7 +15,7 @@ import socket
 import threading
 import time
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs, retry
 from ptype_tpu.coord import wire
 from ptype_tpu.coord.core import CoordState, RangeOptions, Watch
 
@@ -83,6 +83,7 @@ class CoordServer:
             # free) port can TCP-self-connect and squat it as the
             # dialer's ephemeral port for an instant. SO_REUSEADDR
             # doesn't cover an ACTIVE squatter; a short retry does.
+            bind_bo = retry.Backoff(base=0.1, cap=0.2)
             for attempt in range(50):
                 try:
                     self._sock.bind((host or "127.0.0.1", int(port)))
@@ -90,7 +91,7 @@ class CoordServer:
                 except OSError:
                     if attempt == 49:
                         raise
-                    time.sleep(0.1)
+                    bind_bo.sleep()
             self._sock.listen(128)
         except OSError:
             # A leaked CoordState would hold the WAL-dir flock forever
@@ -393,6 +394,18 @@ class CoordServer:
     def _dispatch(self, conn, send_lock, watches, watches_lock, op: str, msg: dict):
         st = self.state
         if op == "put":
+            f = chaos.hit("coord.put", msg.get("key", ""))
+            if f is not None and f.action == "kill_primary":
+                # Die mid-write: the put IS applied (WAL flushed before
+                # ack — same durability a SIGKILL after fs flush gives)
+                # but no ack ever leaves and the whole server goes down
+                # with it. Clients see a dead primary; a standby's
+                # probes start failing from this instant.
+                st.put(msg["key"], msg["value"], msg.get("lease", 0))
+                threading.Thread(target=self.close,
+                                 name="chaos-kill-primary",
+                                 daemon=True).start()
+                raise OSError("chaos: primary killed mid-write")
             rev = st.put(msg["key"], msg["value"], msg.get("lease", 0))
             if msg.get("sync"):
                 # Synchronous replication (the raft-commit analog): ack
@@ -512,6 +525,13 @@ class CoordServer:
         if self._closed.is_set():
             return
         self._closed.set()
+        # shutdown() before close() throughout: accept/recv-parked
+        # threads are not woken by close() alone and would linger as
+        # wedged daemons (the chaos soak's thread-hygiene invariant).
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -519,6 +539,10 @@ class CoordServer:
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:

@@ -48,7 +48,7 @@ import os
 import socket
 import threading
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs, retry
 from ptype_tpu.coord import wire
 from ptype_tpu.coord.core import fsync_dir
 from ptype_tpu.coord.service import CoordServer
@@ -657,6 +657,7 @@ class Standby:
             self._ensure_follower()
             return False
         self.promoted.set()
+        chaos.note_ok("coord.failover", self.listen_address)
         self._close_admin()  # it pointed at the dead primary
         self._retire_own_member_record()
         return True
@@ -728,6 +729,8 @@ class Standby:
         # forced): the lease frees one TTL after the primary was shut
         # down, so retry within the operator's timeout.
         if self._witness_addr is not None and not force:
+            witness_bo = retry.Backoff(
+                base=min(1.0, self._witness_ttl / 2), cap=1.0)
             while not self._acquire_witness():
                 if _time.monotonic() > deadline:
                     self._start_guarding()
@@ -736,7 +739,8 @@ class Standby:
                         "primary still holds it (shut it down and let "
                         "its TTL lapse) or the witness is unreachable "
                         "(force=True overrides)")
-                _time.sleep(min(1.0, self._witness_ttl / 2))
+                witness_bo.sleep()
+        start_bo = retry.Backoff(base=0.2, cap=1.0)
         while True:
             try:
                 self.server = CoordServer(
@@ -771,8 +775,9 @@ class Standby:
                         f"after {timeout}s — shut it down first "
                         f"(last error: {e})"
                     ) from e
-                _time.sleep(0.2)
+                start_bo.sleep()
         self.promoted.set()
+        chaos.note_ok("coord.failover", self.listen_address)
         self._close_admin()  # it pointed at the superseded primary
         self._retire_own_member_record()
         log.info("standby promoted by operator",

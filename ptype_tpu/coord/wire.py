@@ -14,6 +14,8 @@ import socket
 import struct
 import threading
 
+from ptype_tpu import chaos
+
 MAX_FRAME = 64 * 1024 * 1024
 
 _LEN = struct.Struct(">I")
@@ -23,15 +25,52 @@ class WireError(ConnectionError):
     pass
 
 
+def _chaos_kill(sock: socket.socket) -> None:
+    """Sever a connection the chaos way: shutdown() first so a reader
+    parked in recv(2) on the same socket wakes immediately (close()
+    alone does not — same reason as RemoteCoord._bounce_endpoint)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def send_msg(sock: socket.socket, lock: threading.Lock, msg: dict) -> None:
     payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     if len(payload) > MAX_FRAME:
         raise WireError(f"frame too large: {len(payload)} bytes")
+    f = chaos.hit("coord.wire_send", str(msg.get("op", "")))
+    if f is not None:
+        if f.action == "delay":
+            f.sleep()
+        elif f.action == "drop":
+            _chaos_kill(sock)
+            raise WireError("chaos: connection dropped before send")
+        elif f.action == "truncate":
+            with lock:
+                try:
+                    sock.sendall(_LEN.pack(len(payload))
+                                 + payload[: len(payload) // 2])
+                except OSError:
+                    pass
+            _chaos_kill(sock)
+            raise WireError("chaos: frame truncated mid-send")
     with lock:
         sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def recv_msg(sock: socket.socket) -> dict:
+    f = chaos.hit("coord.wire_recv")
+    if f is not None:
+        if f.action == "delay":
+            f.sleep()
+        elif f.action == "drop":
+            _chaos_kill(sock)
+            raise WireError("chaos: connection dropped before recv")
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:

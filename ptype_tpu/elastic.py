@@ -30,7 +30,7 @@ from typing import Callable
 
 import jax
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs
 from ptype_tpu.errors import ClusterError
 
 log = logs.get_logger("elastic")
@@ -113,9 +113,17 @@ class FailureDetector:
         with self._lock:
             return bool(self._lost or self._joined)
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop watching and JOIN the watch thread (bounded): a test
+        tearing a detector down must not leak a thread that wakes
+        later against a dead registry."""
         self._closed.set()
         self._watch.cancel()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            log.warning("failure detector thread did not exit in time",
+                        kv={"service": self.service_name,
+                            "timeout": timeout})
 
 
 def inject_loss(registration) -> None:
@@ -206,12 +214,28 @@ class ElasticTrainer:
         The state in memory is still valid (single-controller: the
         controller survived; what died is worker capacity), so we save
         it, rebuild the mesh over the survivors, and restore into the
-        new shardings."""
+        new shardings.
+
+        Churn does not stop arriving just because a recover is in
+        flight: a second ``MembershipChanged``'s worth of events
+        landing mid-rebuild re-runs the drain-and-rebuild loop over
+        the LATEST survivor set instead of crashing out of (or
+        resuming onto) a half-current mesh."""
         saved = self.checkpoint()
         old = self.mesh.devices.size
-        self._build(fresh=False)
-        self.state = self.ckpt.restore(
-            self.state, step=saved, shardings=self.state_shardings)
+        for _ in range(5):
+            self.detector.drain_changes()
+            self._build(fresh=False)
+            self.state = self.ckpt.restore(
+                self.state, step=saved, shardings=self.state_shardings)
+            if not self.detector.changed:
+                break
+            log.info("membership changed again mid-recover; rebuilding",
+                     kv={"step": saved})
+        # Still churning after the bounded drain: return with the
+        # latest consistent build — the next step() raises
+        # MembershipChanged and the caller recovers again.
+        chaos.note_ok("elastic.recover", str(saved))
         log.info("elastic recovery complete",
                  kv={"step": saved, "old_devices": old,
                      "new_devices": self.mesh.devices.size})

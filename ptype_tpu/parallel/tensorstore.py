@@ -42,14 +42,27 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ptype_tpu import logs
-from ptype_tpu.errors import CoordinationError, NoKeyError
+from ptype_tpu import chaos, logs
+from ptype_tpu.errors import ClusterError, CoordinationError, NoKeyError
 from ptype_tpu.parallel import collectives
 from ptype_tpu.store import KVStore
 
 log = logs.get_logger("tensorstore")
 
 TENSOR_PREFIX = "tensors"
+
+
+def _store_fault(site: str, key: str) -> None:
+    """Apply an armed store fault: ``delay`` (a straggler bucket —
+    the collective completes late) sleeps; ``timeout`` raises before
+    any state changes, so the caller's retry re-runs a clean push."""
+    f = chaos.hit(site, key)
+    if f is None:
+        return
+    if f.action == "delay":
+        f.sleep()
+    elif f.action == "timeout":
+        raise ClusterError(f"chaos: {site} timed out for {key!r}")
 
 
 def spec_to_json(spec: P) -> str:
@@ -142,9 +155,11 @@ class TensorStore:
     def pull(self, key: str, gather: bool = False) -> jax.Array:
         """Get; with ``gather=True``, return a fully-replicated view
         (allgather lowering of a linearizable read)."""
+        _store_fault("store.pull", key)
         value = self.get(key)
         if gather:
             value = jax.device_put(value, NamedSharding(self.mesh, P()))
+        chaos.note_ok("store.pull", key)
         return value
 
     def delete(self, key: str) -> None:
@@ -178,6 +193,7 @@ class TensorStore:
         stored under the key's binding and returned."""
         from ptype_tpu.metrics import annotate
 
+        _store_fault("store.push", key)
         b = self.binding(key)
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
@@ -208,6 +224,7 @@ class TensorStore:
         reduced tensor (binding forced to shard dim 0 over the push axis).
         Pull with ``gather=True`` to reassemble — together they form the
         bandwidth-optimal allreduce decomposition."""
+        _store_fault("store.push", key)
         b = Binding(P(self.axis), op or self.binding(key).reduce_op)
         stacked = jnp.asarray(stacked)
         n = int(self.mesh.shape[self.axis])
@@ -233,6 +250,7 @@ class TensorStore:
             epoch = (prev.epoch + 1) if prev else 1
             self._entries[key] = _Entry(value, epoch, b)
         self._publish(key)
+        chaos.note_ok("store.push", key)
         return value
 
     # -------------------------------------------------------------- tree
@@ -281,6 +299,7 @@ class TensorStore:
         if not bucketed:
             return {key: self.push(key, leaf, op) for key, leaf in pairs}
 
+        _store_fault("store.push", prefix)
         t0 = _time.perf_counter()
         # Group by resolved reduce op (dtype grouping happens inside
         # the bucket planner); op=None honors each key's binding.
@@ -315,6 +334,7 @@ class TensorStore:
         metrics.timing("store.push_tree").observe(
             _time.perf_counter() - t0)
         metrics.counter("store.push_tree.leaves").add(len(pairs))
+        chaos.note_ok("store.push", prefix)
         return out
 
     def get_tree(self, prefix: str,
@@ -322,6 +342,7 @@ class TensorStore:
         """All keys under ``prefix/`` as a flat dict. ``gather=True``
         returns fully-replicated views (the allgather lowering of a
         linearizable read), resharded through one batched device_put."""
+        _store_fault("store.pull", prefix)
         sep = prefix + "/"
         with self._lock:
             hits = {k: e.value for k, e in self._entries.items()
@@ -335,6 +356,7 @@ class TensorStore:
                 [hits[k] for k in keys],
                 [NamedSharding(self.mesh, P())] * len(keys))
             hits = dict(zip(keys, arrs))
+        chaos.note_ok("store.pull", prefix)
         return hits
 
     # ---------------------------------------------------------- manifest

@@ -23,7 +23,7 @@ import time
 import weakref
 from dataclasses import dataclass, field
 
-from ptype_tpu import logs
+from ptype_tpu import chaos, logs, retry
 from ptype_tpu.coord.api import CoordBackend
 from ptype_tpu.coord.core import RangeOptions
 from ptype_tpu.errors import CoordinationError
@@ -239,6 +239,8 @@ class Registration:
                 lease=lease_id,
             )
             self.lease_id = lease_id
+            chaos.note_ok("coord.lease",
+                          f"{self.service}/{self.node}")
             log.info("re-registered after lease loss",
                      kv={"service": self.service, "node": self.node,
                          "lease": lease_id})
@@ -356,6 +358,7 @@ class CoordRegistry(Registry):
             # the coord watch is deliberately closed.
             need_list = True
             epoch = getattr(coord_watch, "epoch", 0)
+            bo = retry.Backoff(base=0.3, cap=1.0)
             try:
                 while not nw.closed and not coord_watch.closed:
                     if need_list:
@@ -371,9 +374,10 @@ class CoordRegistry(Registry):
                                 "service watch re-list failed; retrying",
                                 kv={"service": service_name,
                                     "err": str(e)})
-                            time.sleep(0.3)
+                            bo.sleep()
                             continue
                         need_list = False
+                        bo.reset()
                     if coord_watch.get(timeout=0.5):
                         need_list = True
                     # A re-armed watch (reconnect) missed the outage's

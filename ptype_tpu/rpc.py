@@ -29,7 +29,7 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 
 from ptype_tpu import actor as actor_mod
-from ptype_tpu import codec, logs
+from ptype_tpu import chaos, codec, logs, retry
 from ptype_tpu.coord import wire
 from ptype_tpu.errors import NoClientAvailableError, RemoteError, RPCError
 from ptype_tpu.registry import Node, NodeWatch, Registry
@@ -55,6 +55,14 @@ class ConnConfig:
     #: Per-attempt call timeout (the reference relied on TCP semantics;
     #: an explicit bound is strictly safer). None = no timeout.
     call_timeout: float | None = 60.0
+    #: TCP connect timeout per dial (was hard-coded in ``_Conn``).
+    dial_timeout: float = 5.0
+    #: Jittered exponential backoff between retry attempts: an
+    #: immediate re-fire lands the whole retry budget inside the same
+    #: dying node set before the balancer can notice. First retry
+    #: waits ~``retry_backoff_base``, growing to ``retry_backoff_cap``.
+    retry_backoff_base: float = 0.05
+    retry_backoff_cap: float = 1.0
 
 
 DEFAULT_CONN_CONFIG = ConnConfig()
@@ -109,6 +117,9 @@ class _Conn:
                     blob = wire._recv_exact(self._sock, msg["result_len"])
             except (wire.WireError, OSError):
                 break
+            f = chaos.hit("rpc.recv")
+            if f is not None and f.action == "delay":
+                f.sleep()  # slow reply: the caller's timeout clock runs
             with self._pending_lock:
                 fut = self._pending.pop(msg.get("id"), None)
             if fut is None:
@@ -131,6 +142,11 @@ class _Conn:
             fut.set_exception(RPCError(f"connection to {self.node.address}:"
                                        f"{self.node.port} closed"))
             return fut
+        f = chaos.hit("rpc.send", method)
+        if f is not None:
+            injected = self._inject_send_fault(f)
+            if injected is not None:
+                return injected
         parts = codec.encode_parts(args)
         args_len = sum(len(p) for p in parts)
         with self._id_lock:
@@ -161,6 +177,31 @@ class _Conn:
             fut.set_exception(RPCError(f"send failed: {e}"))
         return fut
 
+    def _inject_send_fault(self, f) -> Future | None:
+        """Apply an armed ``rpc.send`` fault. ``delay`` returns None
+        (the real send proceeds afterwards); ``drop`` and ``truncate``
+        kill the connection and return a failed Future — the retry
+        path's next attempt lands on another node."""
+        if f.action == "delay":
+            f.sleep()
+            return None
+        if f.action == "truncate":
+            # A length header promising more bytes than ever arrive:
+            # the server reader blocks on the remainder until the close
+            # lands, then surfaces the standard truncated-frame
+            # WireError — the same failure a mid-send crash produces.
+            try:
+                with self._send_lock:
+                    self._sock.sendall(_LEN.pack(1 << 20) + b"chaos")
+            except OSError:
+                pass
+        self.close()
+        fut: Future = Future()
+        fut.set_exception(RPCError(
+            f"chaos: {f.action} on send to "
+            f"{self.node.address}:{self.node.port}"))
+        return fut
+
     def forget(self, fut: Future) -> None:
         """Drop a timed-out call's pending entry so abandoned futures are
         not resolved by late replies and _pending cannot grow unboundedly."""
@@ -173,6 +214,14 @@ class _Conn:
         if self._closed.is_set():
             return
         self._closed.set()
+        import socket
+
+        try:
+            # shutdown() wakes the read loop parked in recv(2); close()
+            # alone leaves it wedged until process exit.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -222,11 +271,18 @@ class _LocalConn:
         pass
 
 
-def _dial(node: Node):
+def _dial(node: Node, dial_timeout: float = 5.0):
+    f = chaos.hit("rpc.dial", f"{node.address}:{node.port}")
+    if f is not None:
+        if f.action == "delay":
+            f.sleep()
+        elif f.action in ("drop", "timeout"):
+            raise OSError(
+                f"chaos: dial {f.action} to {node.address}:{node.port}")
     local = actor_mod.lookup_local(node.address, node.port)
     if local is not None:
         return _LocalConn(node, local)
-    return _Conn(node)
+    return _Conn(node, dial_timeout)
 
 
 # ---------------------------------------------------------------- balancer
@@ -245,6 +301,12 @@ class _ConnectionBalancer:
         self._seq_lock = threading.Lock()
         self._lock = threading.RLock()
         self._conns: list = []
+        #: Latest node snapshot, kept so ``get()`` can kick a redial of
+        #: dead connections without waiting for membership churn (a
+        #: single-node service whose one connection drops would
+        #: otherwise stay dead until the next watch event).
+        self._last_nodes: list[Node] = []
+        self._redialing = threading.Event()
         self._closed = threading.Event()
         self.err_queue: "queue.Queue[Exception]" = queue.Queue(maxsize=1024)
         self.conns_updated = threading.Event()
@@ -300,27 +362,43 @@ class _ConnectionBalancer:
     def _handle_new_nodes(self, nodes: list[Node]) -> None:
         selected = self._select_nodes(nodes) if nodes else []
         with self._lock:
+            self._last_nodes = list(nodes)
             existing = {
                 (c.node.address, c.node.port): c
                 for c in self._conns
             }
-            new_conns = []
-            for node in selected:
-                key = (node.address, node.port)
-                cur = existing.pop(key, None)
-                if cur is not None and cur.healthy:
-                    new_conns.append(cur)  # reuse, don't re-dial (§2 fix)
-                    continue
-                if cur is not None:
-                    cur.close()
-                try:
-                    new_conns.append(_dial(node))
-                except OSError as e:
-                    self._report(RPCError(
-                        f"dial {node.address}:{node.port} failed: {e}"
-                    ))
-            for dropped in existing.values():
-                dropped.close()
+        # Dial OUTSIDE the lock: a blackholed peer costs a full
+        # dial_timeout, and holding the balancer lock across it would
+        # stall every concurrent get() even though healthy connections
+        # exist.
+        new_conns = []
+        dialed = []
+        for node in selected:
+            key = (node.address, node.port)
+            cur = existing.get(key)
+            if cur is not None and cur.healthy:
+                new_conns.append(cur)  # reuse, don't re-dial (§2 fix)
+                continue
+            try:
+                conn = _dial(node, self.cfg.dial_timeout)
+            except OSError as e:
+                self._report(RPCError(
+                    f"dial {node.address}:{node.port} failed: {e}"
+                ))
+                continue
+            dialed.append(conn)
+            new_conns.append(conn)
+        with self._lock:
+            if self._closed.is_set():
+                # close() raced the dials: never install into a closed
+                # balancer (leaked sockets + reader threads).
+                for c in dialed:
+                    c.close()
+                return
+            keep = {id(c) for c in new_conns}
+            for c in self._conns:
+                if id(c) not in keep:
+                    c.close()
             self._conns = new_conns
         self.conns_updated.set()
         log.debug("rebalanced connections",
@@ -358,9 +436,36 @@ class _ConnectionBalancer:
             self._seq = (self._seq + 1) & 0xFFFFFFFFFFFFFFFF
         with self._lock:
             conns = [c for c in self._conns if c.healthy]
+            if len(conns) < len(self._conns) or not conns:
+                # Dead connections with no membership churn to evict
+                # them: kick a background re-dial of the last snapshot
+                # so the client heals instead of waiting for a watch
+                # event that may never come.
+                self._kick_redial()
             if not conns:
                 return None
             return conns[seq % len(conns)]
+
+    def _kick_redial(self) -> None:
+        # No extra cooldown: _redialing already serializes bursts (an
+        # unreachable peer holds it for its whole dial_timeout), and a
+        # fixed cooldown would race the retry backoff — a caller's last
+        # attempt must not find the redial still embargoed.
+        if self._closed.is_set() or self._redialing.is_set():
+            return
+        self._redialing.set()
+
+        def run():
+            try:
+                with self._lock:
+                    nodes = list(self._last_nodes)
+                if nodes and not self._closed.is_set():
+                    self._handle_new_nodes(nodes)
+            finally:
+                self._redialing.clear()
+
+        threading.Thread(target=run, name=f"redial-{self.service_name}",
+                         daemon=True).start()
 
     def _report(self, err: Exception) -> None:
         try:
@@ -423,14 +528,23 @@ class Client:
     def _with_retry(self, method: str, args):
         attempts = self.cfg.retries + 1
         last_err: Exception | None = None
-        for _ in range(attempts):
+        bo = retry.Backoff(base=self.cfg.retry_backoff_base,
+                           cap=self.cfg.retry_backoff_cap)
+        for attempt in range(attempts):
+            if attempt:
+                # Jittered exponential backoff between attempts: give
+                # the balancer (and the peer) a beat to recover instead
+                # of re-firing immediately into the same dying node set.
+                bo.sleep()
             conn = self._conns.get()
             if conn is None:
                 last_err = NoClientAvailableError("no client nodes available")
                 continue
             fut = conn.call_async(method, args)
             try:
-                return fut.result(timeout=self.cfg.call_timeout)
+                result = fut.result(timeout=self.cfg.call_timeout)
+                chaos.note_ok("rpc.call", method)
+                return result
             except FuturesTimeoutError:
                 conn.forget(fut)
                 last_err = RPCError(

@@ -32,7 +32,8 @@ import pytest  # noqa: E402
 #: (its suite WAS control-plane only; the ML surface is this repo's
 #: addition and pays real XLA compiles).
 SLOW_FILES = {
-    "test_actor_pipeline.py", "test_checkpoint.py", "test_data.py",
+    "test_actor_pipeline.py", "test_chaos_soak.py", "test_checkpoint.py",
+    "test_data.py",
     "test_elastic.py", "test_elastic_mp.py", "test_examples.py",
     "test_failover.py",
     "test_flash_attention.py", "test_fsdp_8b.py", "test_generate.py",
@@ -58,6 +59,16 @@ def _reset_local_coords():
     from ptype_tpu.coord.local import reset_local_coords
 
     reset_local_coords()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    """A test that armed a fault plan must never leak it into the next
+    test's seams."""
+    yield
+    from ptype_tpu import chaos
+
+    chaos.disarm()
 
 
 @pytest.fixture

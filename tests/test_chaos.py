@@ -1,0 +1,397 @@
+"""Chaos layer unit tier: each fault class fires exactly per plan,
+deterministically, and the subsystem under fault RECOVERS — the
+injection+recovery contract per class (rpc / coord / store /
+checkpoint) that the soak harness (test_chaos_soak.py) composes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ptype_tpu import chaos
+from ptype_tpu.chaos import FaultPlan, FaultSpec
+
+
+# ----------------------------------------------------------- plan mechanics
+
+
+def test_random_plan_deterministic_for_seed():
+    menu = [
+        {"site": "rpc.send", "action": "drop", "after": (0, 5)},
+        {"site": "store.push", "action": "delay", "after": (0, 9),
+         "delay_s": (0.01, 0.2)},
+    ]
+    a = FaultPlan.random(7, menu, n_faults=6)
+    b = FaultPlan.random(7, menu, n_faults=6)
+    assert a.specs == b.specs
+    c = FaultPlan.random(8, menu, n_faults=6)
+    assert a.specs != c.specs
+
+
+def test_plan_json_round_trip():
+    plan = FaultPlan([FaultSpec("rpc.send", "drop", match="Echo",
+                                after=2, times=3, delay_s=0.5)],
+                     seed=42, name="rt")
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.specs == plan.specs
+    assert back.seed == 42 and back.name == "rt"
+
+
+def test_fires_exactly_per_schedule_and_trace_is_deterministic():
+    def drive():
+        plan = FaultPlan([
+            FaultSpec("x.a", "drop", after=2, times=2),
+            FaultSpec("x.a", "delay", match="special", after=0, times=1),
+        ])
+        with chaos.armed(plan):
+            results = [chaos.hit("x.a", f"k{i}") for i in range(8)]
+            special = chaos.hit("x.a", "special-key")
+        fired = [(i, r.action) for i, r in enumerate(results)
+                 if r is not None]
+        return plan, fired, special
+
+    plan1, fired1, special1 = drive()
+    plan2, fired2, special2 = drive()
+    # after=2, times=2: passes 3 and 4 fire, nothing else.
+    assert fired1 == [(2, "drop"), (3, "drop")]
+    assert fired1 == fired2
+    assert special1.action == "delay" and special2.action == "delay"
+    t1 = [(e.site, e.action, e.key) for e in plan1.fired()]
+    t2 = [(e.site, e.action, e.key) for e in plan2.fired()]
+    assert t1 == t2 and len(t1) == 3
+
+
+def test_disarmed_hit_is_none_and_pause_stops_injection():
+    assert chaos.hit("anything") is None
+    plan = chaos.arm(FaultPlan([FaultSpec("x.a", "drop", times=5)]))
+    assert chaos.hit("x.a") is not None
+    chaos.pause()
+    assert chaos.hit("x.a") is None
+    # Recovery pairing still records while paused (the drain phase).
+    assert plan.unrecovered() == {"x": 1}
+    chaos.note_ok("x.anything")
+    assert plan.unrecovered() == {}
+    chaos.resume()
+    assert chaos.hit("x.a") is not None
+    chaos.disarm()
+
+
+def test_env_arming(monkeypatch):
+    plan = FaultPlan([FaultSpec("rpc.send", "drop")], seed=5)
+    monkeypatch.setenv(chaos.PLAN_ENV, plan.to_json())
+    chaos.disarm()
+    chaos._maybe_arm_from_env()
+    armed = chaos.current()
+    assert armed is not None and armed.specs == plan.specs
+    chaos.disarm()
+
+
+def test_env_arming_from_file(tmp_path, monkeypatch):
+    plan = FaultPlan([FaultSpec("store.push", "timeout", after=1)])
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    monkeypatch.setenv(chaos.PLAN_ENV, str(p))
+    chaos.disarm()
+    chaos._maybe_arm_from_env()
+    assert chaos.current().specs == plan.specs
+    chaos.disarm()
+
+
+# ------------------------------------------------------------- rpc class
+
+
+class _Echo:
+    def Echo(self, x):
+        return x
+
+
+def _rpc_cluster(n=2):
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.registry import Node, NodeWatch, Registry
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    class _Reg(Registry):
+        def __init__(self):
+            self.watches = []
+
+        def register(self, *a, **k):
+            raise NotImplementedError
+
+        def services(self):
+            return {}
+
+        def watch_service(self, service_name):
+            w = NodeWatch()
+            self.watches.append(w)
+            return w
+
+    servers = []
+    for _ in range(n):
+        s = ActorServer("127.0.0.1", 0)
+        s.register(_Echo(), "Echo")
+        s.serve()
+        servers.append(s)
+    reg = _Reg()
+    client_holder = {}
+
+    def start_client():
+        t = threading.Thread(
+            target=lambda: client_holder.update(client=Client(
+                "chaos-client", "echo", reg,
+                ConnConfig(retries=4, call_timeout=5.0,
+                           initial_node_timeout=5.0,
+                           retry_backoff_base=0.01,
+                           retry_backoff_cap=0.05))))
+        t.start()
+        deadline = time.monotonic() + 5
+        while not reg.watches and time.monotonic() < deadline:
+            time.sleep(0.01)
+        for w in reg.watches:
+            w._push([Node("127.0.0.1", s.port) for s in servers])
+        t.join(timeout=5)
+        return client_holder["client"]
+
+    return servers, start_client
+
+
+def test_rpc_fault_injection_and_recovery(monkeypatch):
+    """Socket-level rpc.send drop + truncate: the connection dies
+    mid-call, the retry path (jittered backoff + dead-conn redial)
+    completes the call anyway, and the trace pairs every fault with a
+    recovery."""
+    from ptype_tpu import actor as actor_mod
+
+    # Force real TCP: the in-process fast path (_LocalConn) has no
+    # socket to injure.
+    monkeypatch.setattr(actor_mod, "lookup_local", lambda a, p: None)
+    servers, start_client = _rpc_cluster(n=2)
+    client = start_client()
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("rpc.send", "drop", after=1, times=1),
+        FaultSpec("rpc.send", "truncate", after=3, times=1),
+        FaultSpec("rpc.recv", "delay", after=0, times=1, delay_s=0.05),
+    ]))
+    try:
+        for i in range(8):
+            assert client.call("Echo.Echo", i) == i
+        fired = [(e.site, e.action) for e in plan.fired()]
+        assert ("rpc.send", "drop") in fired
+        assert ("rpc.send", "truncate") in fired
+        assert ("rpc.recv", "delay") in fired
+        assert plan.unrecovered() == {}, plan.unrecovered()
+    finally:
+        chaos.disarm()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def test_rpc_dial_fault_routes_around_node(monkeypatch):
+    """A dial timeout against one node: the balancer reports it and
+    calls ride the remaining connection."""
+    from ptype_tpu import actor as actor_mod
+
+    monkeypatch.setattr(actor_mod, "lookup_local", lambda a, p: None)
+    servers, start_client = _rpc_cluster(n=2)
+    victim = f"127.0.0.1:{servers[0].port}"
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("rpc.dial", "timeout", match=victim, times=1),
+    ]))
+    client = None
+    try:
+        client = start_client()
+        for i in range(4):
+            assert client.call("Echo.Echo", i) == i
+        assert [(e.site, e.action, e.key) for e in plan.fired()] == \
+            [("rpc.dial", "timeout", victim)]
+        assert plan.unrecovered() == {}
+    finally:
+        chaos.disarm()
+        if client is not None:
+            client.close()
+        for s in servers:
+            s.close()
+
+
+# ----------------------------------------------------------- coord class
+
+
+def test_coord_lease_revoke_and_reregister(coord_server):
+    """coord.keepalive/revoke kills a member the lease way; the
+    registration's keepalive loop re-registers with a fresh lease —
+    zero lost members, fault paired with recovery."""
+    from ptype_tpu.coord.remote import RemoteCoord
+    from ptype_tpu.registry import CoordRegistry
+
+    coord = RemoteCoord([coord_server.address])
+    registry = CoordRegistry(coord, lease_ttl=0.4)
+    reg = registry.register("svc", "n0", "127.0.0.1", 7010)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("coord.keepalive", "revoke",
+                  match=str(reg.lease_id), times=1),
+    ]))
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not plan.fired():
+            time.sleep(0.05)
+        assert plan.fired(), "keepalive revoke never fired"
+        old_lease = int(plan.fired()[0].key)
+        # The member must come back under a FRESH lease, and the
+        # re-registration is the paired recovery in the trace.
+        deadline = time.monotonic() + 10
+        back = False
+        while time.monotonic() < deadline and not back:
+            nodes = registry.services().get("svc", [])
+            back = (any(n.port == 7010 for n in nodes)
+                    and reg.lease_id != old_lease
+                    and not plan.unrecovered())
+            time.sleep(0.05)
+        assert back, (f"member never re-registered after lease revoke: "
+                      f"{plan.trace()}")
+    finally:
+        chaos.disarm()
+        reg.close()
+        coord.close()
+
+
+def test_coord_wire_drop_reconnects(coord_server):
+    """coord.wire_send drop severs the client connection mid-op; the
+    reader re-dials and later ops succeed."""
+    from ptype_tpu.coord.remote import RemoteCoord
+    from ptype_tpu.errors import CoordinationError
+
+    coord = RemoteCoord([coord_server.address], reconnect_timeout=10.0)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("coord.wire_send", "drop", match="put", times=1),
+    ]))
+    try:
+        with pytest.raises(CoordinationError):
+            coord.put("k", "v1")
+        deadline = time.monotonic() + 10
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                coord.put("k", "v2")
+                ok = True
+            except CoordinationError:
+                time.sleep(0.1)
+        assert ok, "client never recovered from the wire drop"
+        assert coord.range("k").items[0].value == "v2"
+        assert [(e.site, e.action) for e in plan.fired()] == \
+            [("coord.wire_send", "drop")]
+        assert plan.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+        coord.close()
+
+
+# ----------------------------------------------------------- store class
+
+
+def _mesh():
+    import jax
+
+    from ptype_tpu.parallel.mesh import build_mesh
+
+    return build_mesh({"data": jax.device_count()})
+
+
+def test_store_push_timeout_then_retry_succeeds():
+    import jax.numpy as jnp
+
+    from ptype_tpu.errors import ClusterError
+    from ptype_tpu.parallel.tensorstore import TensorStore
+
+    store = TensorStore(_mesh())
+    n = int(store.mesh.shape["data"])
+    stacked = jnp.ones((n, 16), jnp.float32)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("store.push", "timeout", match="grads/w", times=1),
+        FaultSpec("store.push", "delay", match="grads/w", after=0,
+                  times=1, delay_s=0.02),
+    ]))
+    try:
+        with pytest.raises(ClusterError, match="chaos: store.push"):
+            store.push("grads/w", stacked)
+        # The retry rides the straggler delay and commits.
+        out = store.push("grads/w", stacked)
+        np.testing.assert_allclose(np.asarray(out), np.ones(16))
+        assert store.epoch("grads/w") == 1
+        fired = [(e.site, e.action) for e in plan.fired()]
+        assert fired == [("store.push", "timeout"), ("store.push", "delay")]
+        # Two faults, one committed push so far: a follow-up pull is
+        # the second recovery proof.
+        store.pull("grads/w")
+        assert plan.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+
+
+# ------------------------------------------------------ checkpoint class
+
+
+def test_checkpoint_commit_crash_keeps_step_invisible(tmp_path):
+    from ptype_tpu.checkpoint import Checkpointer
+    from ptype_tpu.errors import CheckpointError
+
+    ckpt = Checkpointer(str(tmp_path))
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("checkpoint.commit", "crash", times=1),
+    ]))
+    try:
+        with pytest.raises(CheckpointError, match="chaos: crashed"):
+            ckpt.save(1, tree)
+        assert ckpt.steps() == []  # never visible
+        # Recovery: the next save commits and restores clean.
+        ckpt.save(2, tree)
+        back = ckpt.restore({"w": 0})
+        np.testing.assert_array_equal(np.asarray(back["w"]), tree["w"])
+        assert plan.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+
+
+def test_checkpoint_corrupt_shard_is_caught_by_name(tmp_path):
+    from ptype_tpu.checkpoint import Checkpointer
+    from ptype_tpu.errors import CheckpointError
+
+    ckpt = Checkpointer(str(tmp_path))
+    tree = {"w": np.arange(64, dtype=np.float32),
+            "b": np.ones(4, dtype=np.float32)}
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("checkpoint.shard", "corrupt", match="w.shard", times=1),
+    ]))
+    try:
+        ckpt.save(1, tree)
+        assert ckpt.steps() == [1]  # complete — the rot is silent on disk
+        with pytest.raises(CheckpointError, match="w.shard0"):
+            ckpt.restore({"w": 0, "b": 0}, step=1)
+        # Recovery: re-save; the fresh step restores bit-exact.
+        ckpt.save(2, tree)
+        back = ckpt.restore({"w": 0, "b": 0}, step=2)
+        np.testing.assert_array_equal(np.asarray(back["w"]), tree["w"])
+        assert [(e.site, e.action) for e in plan.fired()] == \
+            [("checkpoint.shard", "corrupt")]
+        assert plan.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+
+
+def test_checksum_catches_out_of_band_corruption(tmp_path):
+    """No chaos at all: a shard rotted on disk by any means must fail
+    restore loudly, naming the bad shard."""
+    import os
+
+    from ptype_tpu.checkpoint import Checkpointer, _corrupt_file
+    from ptype_tpu.errors import CheckpointError
+
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(3, {"w": np.arange(32, dtype=np.float32)})
+    sdir = ckpt._step_dir(3)
+    shard = [f for f in os.listdir(sdir) if f.endswith(".npy")][0]
+    _corrupt_file(os.path.join(sdir, shard))
+    with pytest.raises(CheckpointError, match=shard.replace(".", r"\.")):
+        ckpt.restore({"w": 0}, step=3)

@@ -1,5 +1,6 @@
-"""Unit tier for this round's tooling satellites: the PT001 per-leaf
-collective lint rule and the TTL-derived repl pump idle tick."""
+"""Unit tier for the tooling satellites: the PT001 per-leaf collective
+lint rule, the PT002 bare-sleep-in-retry-loop rule, and the TTL-derived
+repl pump idle tick."""
 
 import os
 import sys
@@ -55,6 +56,78 @@ def test_pt001_ignores_unlooped_calls(tmp_path):
            "    return store.push('k', stacked)\n")
     findings = _check(tmp_path, "train/fine.py", src)
     assert not any("PT001" in f for f in findings), findings
+
+
+SLEEP_LOOP = (
+    "import time\n"
+    "def f(ready):\n"
+    "    while not ready():\n"
+    "        time.sleep(0.2)\n"
+)
+
+
+def test_pt002_flags_sleep_loop_in_package(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/bad.py", SLEEP_LOOP)
+    assert any("PT002" in f for f in findings), findings
+
+
+def test_pt002_flags_aliased_time_module(tmp_path):
+    src = ("import time as _time\n"
+           "def f(n):\n"
+           "    for _ in range(n):\n"
+           "        _time.sleep(0.1)\n")
+    findings = _check(tmp_path, "ptype_tpu/alias.py", src)
+    assert any("PT002" in f for f in findings), findings
+
+
+def test_pt002_silent_outside_package(tmp_path):
+    findings = _check(tmp_path, "tests/ok.py", SLEEP_LOOP)
+    assert not any("PT002" in f for f in findings), findings
+
+
+def test_pt002_exempts_retry_module_and_backoff_calls(tmp_path):
+    # retry.py IS the sanctioned sleeper.
+    findings = _check(tmp_path, "ptype_tpu/retry.py", SLEEP_LOOP)
+    assert not any("PT002" in f for f in findings), findings
+    # Backoff.sleep() inside a loop is the fix, not a finding.
+    src = ("from ptype_tpu.retry import Backoff\n"
+           "def f(ready):\n"
+           "    bo = Backoff()\n"
+           "    while not ready():\n"
+           "        bo.sleep()\n")
+    findings = _check(tmp_path, "ptype_tpu/good.py", src)
+    assert not any("PT002" in f for f in findings), findings
+
+
+def test_pt002_ignores_unlooped_sleep(tmp_path):
+    src = "import time\ndef f():\n    time.sleep(0.1)\n"
+    findings = _check(tmp_path, "ptype_tpu/one.py", src)
+    assert not any("PT002" in f for f in findings), findings
+
+
+def test_pt002_honors_noqa(tmp_path):
+    src = ("import time\n"
+           "def f(ready):\n"
+           "    while not ready():\n"
+           "        time.sleep(0.2)  # noqa: deliberate fixed poll\n")
+    findings = _check(tmp_path, "ptype_tpu/sup.py", src)
+    assert not any("PT002" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt002_clean():
+    """The package itself must honor its own rule (the satellite that
+    converted every retry loop to the shared Backoff)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt002 = [f for f in findings if "PT002" in f]
+    assert not pt002, pt002
 
 
 def test_repl_idle_tick_derives_from_ttl():

@@ -20,6 +20,10 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
 - PT001 (train/ only): an eager collective called inside a Python
   loop/comprehension — the per-leaf launch pattern the bucketed tree
   collectives exist to kill (parallel/collectives.tree_all_reduce)
+- PT002 (ptype_tpu/ only): a bare ``time.sleep`` inside a loop — retry
+  and poll loops must ride ptype_tpu.retry.Backoff (jittered
+  exponential with a cap) so a fleet can't re-fire in lockstep into a
+  dying node set; close-aware loops should use ``Event.wait``
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -229,6 +233,38 @@ class _PerLeafCollectiveCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _SleepInLoopCheck(ast.NodeVisitor):
+    """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
+    loop body. Fixed-interval sleeps in retry/poll loops are the
+    thundering-herd anti-pattern the shared ``ptype_tpu.retry.Backoff``
+    exists to kill; ``Event.wait(timeout)`` is the close-aware
+    alternative for monitor loops."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        self.loop_depth = 0
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (self.loop_depth
+                and isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("time", "_time")):
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT002 bare time.sleep in a "
+                f"loop; use ptype_tpu.retry.Backoff (jittered, capped) "
+                f"or an Event.wait deadline")
+        self.generic_visit(node)
+
+
 def check_file(path: str, findings: list[str]) -> None:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -241,8 +277,13 @@ def check_file(path: str, findings: list[str]) -> None:
     raw: list[str] = []
     v = _AstChecks(path, is_init, raw)
     v.visit(tree)
-    if "train" in os.path.normpath(path).split(os.sep):
+    parts = os.path.normpath(path).split(os.sep)
+    if "train" in parts:
         _PerLeafCollectiveCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and os.path.basename(path) != "retry.py":
+        # retry.py IS the sanctioned sleeper; everything else in the
+        # package must go through it.
+        _SleepInLoopCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
