@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench lint native tpu-smoke tpu-validate chaos
+.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -14,6 +14,13 @@ test-all:
 
 bench:
 	python bench.py
+
+# Serving tail-latency microbench through the inference gateway
+# (docs/OPERATIONS.md "Serving at scale"): three replicas, one slow;
+# the JSON tail carries serve_p99_ms / serve_tokens_per_sec via the
+# gateway and the round-robin comparison p99.
+serve-bench:
+	JAX_PLATFORMS=cpu python bench.py --serve
 
 # Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
 # fault schedule against the in-process trainer + registry +

@@ -378,9 +378,169 @@ def _cpu_fallback(errs: list[str]) -> bool:
     return False
 
 
+# ------------------------------------------------------------ serve bench
+
+
+def serve_main() -> None:
+    """``make serve-bench``: tail latency THROUGH the inference
+    gateway on the host (CPU, tiny preset), against the failure mode
+    the gateway exists for — a fleet where one replica is slow.
+
+    Three replicas serve one service; one of them delays every call by
+    ``SLOW_MS``. The same request stream is driven (a) through the
+    gateway (admission + least-loaded routing) and (b) through the raw
+    round-robin balanced client. The tail record carries
+    ``serve_p99_ms`` / ``serve_tokens_per_sec`` for the gateway path
+    and the round-robin p99 for the comparison the acceptance bar
+    names: least-loaded routing must keep the slow replica out of the
+    gateway's tail, while round-robin serializes every third request
+    behind it.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    SLOW_MS = 250.0
+    N_REQ = 48
+    N_THREADS = 2
+    MAX_NEW = 8
+
+    class _SlowReplica:
+        """Delegates to a real generator, SLOW_MS late — a dying disk,
+        a thermally throttled chip, a noisy neighbor."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def Generate(self, *a, **kw):
+            time.sleep(SLOW_MS / 1000.0)
+            return self._inner.Generate(*a, **kw)
+
+        def Info(self):
+            time.sleep(SLOW_MS / 1000.0)  # probes see the slowness too
+            return self._inner.Info()
+
+    from ptype_tpu.serve import GeneratorActor
+
+    state = CoordState(sweep_interval=0.1)
+    coord = LocalCoord(state)
+    registry = CoordRegistry(coord, lease_ttl=2.0)
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    base = GeneratorActor(cfg)
+    actors = [GeneratorActor(cfg, params=base.params),
+              GeneratorActor(cfg, params=base.params),
+              _SlowReplica(GeneratorActor(cfg, params=base.params))]
+    servers, regs = [], []
+    prompt = jnp.ones((1, 8), jnp.int32)
+    for i, a in enumerate(actors):
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        servers.append(s)
+        regs.append(registry.register("llm-bench", f"r{i}", "127.0.0.1",
+                                      s.port))
+    gw = client = None
+    try:
+        base.Generate(prompt, MAX_NEW)  # compile once; params shared
+
+        def drive(call, warm_ms=None):
+            lat, lock = [], threading.Lock()
+            idx = iter(range(N_REQ))
+
+            def worker():
+                while True:
+                    with lock:
+                        try:
+                            next(idx)
+                        except StopIteration:
+                            return
+                    t0 = time.perf_counter()
+                    out = call()
+                    np.asarray(out)  # force the async result
+                    ms = (time.perf_counter() - t0) * 1000.0
+                    with lock:
+                        lat.append(ms)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(N_THREADS)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t0
+            lat.sort()
+            p = lambda q: lat[min(len(lat) - 1,  # noqa: E731
+                                  int(round(q * (len(lat) - 1))))]
+            return {"p50_ms": round(p(0.50), 1),
+                    "p99_ms": round(p(0.99), 1),
+                    "tokens_per_sec": round(N_REQ * MAX_NEW / wall, 1),
+                    "wall_s": round(wall, 2)}
+
+        gw = InferenceGateway(
+            registry, "llm-bench",
+            GatewayConfig(probe_interval_s=0.2, probe_timeout_s=2.0,
+                          default_deadline_s=60.0, max_queue_depth=64))
+        deadline = time.monotonic() + 10
+        while gw.pool.n_healthy() < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        gw_stats = drive(lambda: gw.generate(prompt, MAX_NEW))
+
+        client = Client("bench", "llm-bench", registry,
+                        ConnConfig(max_connections=0, retries=0,
+                                   call_timeout=60.0,
+                                   initial_node_timeout=5.0))
+        rr_stats = drive(
+            lambda: client.call("Generator.Generate", prompt, MAX_NEW))
+
+        _emit({
+            "metric": "serve p99 through gateway vs round-robin "
+                      "(cpu host, tiny preset, 1 of 3 replicas "
+                      f"{int(SLOW_MS)}ms slow)",
+            "value": gw_stats["p99_ms"],
+            "unit": "ms",
+            "serve_p99_ms": gw_stats["p99_ms"],
+            "serve_p50_ms": gw_stats["p50_ms"],
+            "serve_tokens_per_sec": gw_stats["tokens_per_sec"],
+            "roundrobin_p99_ms": rr_stats["p99_ms"],
+            "roundrobin_p50_ms": rr_stats["p50_ms"],
+            "gateway_beats_rr":
+                gw_stats["p99_ms"] < rr_stats["p99_ms"],
+            "requests": N_REQ,
+            "concurrency": N_THREADS,
+            "max_new_tokens": MAX_NEW,
+            "n_replicas": 3,
+            "slow_replica_ms": SLOW_MS,
+            "shed": gw.admission.shed_total,
+        })
+    finally:
+        if client is not None:
+            client.close()
+        if gw is not None:
+            gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        state.close()
+
+
 def main() -> None:
     if "--worker" in sys.argv:
         worker_main()
+        return
+    if "--serve" in sys.argv:
+        serve_main()
         return
 
     t_start = time.time()

@@ -24,6 +24,7 @@ import traceback
 
 from ptype_tpu import codec, logs
 from ptype_tpu.coord import wire
+from ptype_tpu.errors import ShedError
 
 log = logs.get_logger("actor")
 
@@ -167,6 +168,13 @@ class ActorServer:
             result_parts = codec.encode_parts(result)
             reply = {"id": req_id, "ok": True,
                      "result_len": sum(len(p) for p in result_parts)}
+        except ShedError as e:
+            # Typed admission refusal: marshal the shed flag + retry
+            # hint so the client re-raises a ShedError (and skips its
+            # retry loop) instead of a generic RemoteError.
+            reply = {"id": req_id, "ok": False, "shed": True,
+                     "retry_after_s": e.retry_after_s, "error": str(e)}
+            result_parts = []
         except Exception as e:  # noqa: BLE001 — server must not die
             reply = {"id": req_id, "ok": False, "error": f"{type(e).__name__}: {e}",
                      "traceback": traceback.format_exc()}

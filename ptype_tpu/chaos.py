@@ -26,6 +26,9 @@ site                    actions
 ``store.pull``          ``delay`` (straggler)
 ``checkpoint.commit``   ``crash`` — between shard write and manifest commit
 ``checkpoint.shard``    ``corrupt`` — flip bytes in one shard on disk
+``gateway.admit``       ``shed`` (force-refuse) / ``delay`` (gateway/admission)
+``gateway.route``       ``drop`` (veto the picked replica) / ``delay``
+``gateway.probe``       ``drop`` / ``timeout`` / ``delay`` (gateway/pool)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

@@ -969,10 +969,21 @@ class CoordState:
     def has_live_follower(self, within: float) -> bool:
         """True when some follower completed a round-trip within
         ``within`` seconds — the standby's quorum vote."""
+        return self.last_follower_contact(within) is not None
+
+    def last_follower_contact(self, within: float) -> float | None:
+        """Monotonic stamp of the NEWEST follower round-trip no older
+        than ``within`` seconds, or None. The quorum loop anchors the
+        follower vote's serving window to this stamp (not to "now"):
+        granting a fresh full TTL against an almost-TTL-old heartbeat
+        let a primary serve up to ~2×TTL past its last real contact —
+        overlapping a successor's lease (ADVICE.md, quorum self-fence
+        window)."""
         now = time.monotonic()
         with self._lock:
-            return any(not f.closed and now - f.last_hb <= within
-                       for f in self._repl_feeds)
+            stamps = [f.last_hb for f in self._repl_feeds
+                      if not f.closed and now - f.last_hb <= within]
+        return max(stamps) if stamps else None
 
     def wait_replicated(self, seq: int | None = None,
                         timeout: float | None = None,

@@ -129,10 +129,12 @@ class CoordServer:
         #: boot-time TTL of grace so a seed can start while the
         #: witness is briefly unreachable.
         self._quorum_until = time.monotonic() + witness_ttl
-        #: Set when the witness actively REFUSED renewal (another
-        #: holder took the lease): permanent — a successor exists, so
-        #: this server must never serve again.
+        #: Set when the witness refused renewal with a STRICTLY higher
+        #: term: permanent — a promoted successor exists, so this
+        #: server must never serve again. Same-term refusals are
+        #: retriable (see _quorum_round) and counted here instead.
         self._superseded = None  # (holder, term) | None
+        self._refusals = 0
         if witness_addr is not None:
             # The seed's co-located application talks to this state
             # IN-PROCESS (LocalCoord) — hook the fence into the state
@@ -146,35 +148,71 @@ class CoordServer:
     # ------------------------------------------------------------- quorum
 
     def _quorum_round(self) -> None:
-        """One vote-collection round. Stamps the serving deadline
-        BEFORE the witness RPC so the self-fence always fires at or
-        before the moment the witness could hand the lease away."""
+        """One vote-collection round. Each vote extends the serving
+        deadline only as far as the EVIDENCE behind it reaches:
+
+        - the witness vote stamps ``t0 + ttl`` with ``t0`` taken BEFORE
+          the renewal RPC, so the self-fence always fires at or before
+          the moment the witness could hand the lease away;
+        - the follower vote stamps ``last_round_trip + ttl`` — the
+          follower's actual last contact, NOT "now". Granting a fresh
+          full TTL against an almost-TTL-old heartbeat let a primary
+          serve up to ~2×TTL after its last real round-trip, inside
+          which a partitioned-away standby holding the (vacant) witness
+          lease could already be serving — the ADVICE.md self-fence
+          window. Anchored, the primary's window always ends within one
+          TTL of evidence a majority peer could corroborate.
+
+        The deadline never moves backwards: an older-evidence vote must
+        not shrink a window a better vote already granted.
+        """
         from ptype_tpu.coord import witness as _witness
 
         t0 = time.monotonic()
-        votes = 0
+        grant_until = None
         try:
             reply = _witness.renew(
                 self._witness_addr, holder=self._witness_holder,
                 term=self.state.term,
                 timeout=max(0.3, self._witness_ttl / 3))
             if reply.get("granted"):
-                votes += 1
+                grant_until = t0 + self._witness_ttl
+                self._refusals = 0
             else:
-                self._superseded = (reply.get("holder"),
-                                    reply.get("term"))
-                log.warning(
-                    "witness refused lease renewal: superseded — "
-                    "hard-fencing this coordinator",
-                    kv={"holder": reply.get("holder"),
-                        "term": reply.get("term")})
-                return
+                r_term = reply.get("term")
+                if r_term is not None and r_term <= self.state.term:
+                    # Refusal WITHOUT a successor term: a holder-string
+                    # mismatch (restart under a different address, a
+                    # witness that lost state) — retriable, not proof a
+                    # successor exists. Deny the vote; the next round
+                    # retries one TTL-third later. Permanent fencing is
+                    # reserved for a strictly higher term below.
+                    self._refusals += 1
+                    if self._refusals == 1 or self._refusals % 10 == 0:
+                        log.warning(
+                            "witness refused renewal at same term; "
+                            "retrying (holder mismatch, not a "
+                            "successor)",
+                            kv={"holder": reply.get("holder"),
+                                "term": r_term,
+                                "refusals": self._refusals})
+                else:
+                    self._superseded = (reply.get("holder"), r_term)
+                    log.warning(
+                        "witness refused lease renewal: superseded — "
+                        "hard-fencing this coordinator",
+                        kv={"holder": reply.get("holder"),
+                            "term": r_term})
+                    return
         except (wire.WireError, OSError):
             pass  # witness unreachable: no vote, not a refusal
-        if self.state.has_live_follower(within=self._witness_ttl):
-            votes += 1
-        if votes >= 1:  # plus our own vote = majority of 3
-            self._quorum_until = t0 + self._witness_ttl
+        hb = self.state.last_follower_contact(within=self._witness_ttl)
+        if hb is not None:
+            follower_until = hb + self._witness_ttl
+            if grant_until is None or follower_until > grant_until:
+                grant_until = follower_until
+        if grant_until is not None:  # plus our own vote = majority of 3
+            self._quorum_until = max(self._quorum_until, grant_until)
 
     def _quorum_loop(self) -> None:
         interval = self._witness_ttl / 3

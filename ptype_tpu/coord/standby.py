@@ -601,19 +601,25 @@ class Standby:
     def _promote(self) -> bool:
         if self._closed.is_set():
             return True
-        if not self._acquire_witness():
-            # Keep guarding; the witness grants once the primary's
-            # lease truly lapses (it is still renewing = still alive).
-            return False
         if self.follower is not None and not self.follower.synced.is_set():
             # The mirror never received a snapshot (primary died inside
             # the first connect window, or was never reachable from
             # this host): promoting would serve EMPTY cluster state —
             # silently wiping the control plane. Refuse and keep
             # probing; an operator can still force it via promote().
+            # Checked BEFORE the witness acquire: this standby is not
+            # going to promote, so it must not consume the lease/term —
+            # a lease taken here would brand a later-returning primary
+            # "superseded" by a successor that never serves, turning a
+            # recoverable outage into a permanently fenced cluster
+            # (ADVICE.md, standby._promote ordering).
             log.warning("standby refusing auto-promotion: WAL mirror "
                         "never synced", kv={"primary":
                                             self.primary_address})
+            return False
+        if not self._acquire_witness():
+            # Keep guarding; the witness grants once the primary's
+            # lease truly lapses (it is still renewing = still alive).
             return False
         log.info("promoting standby: primary declared dead",
                  kv={"primary": self.primary_address,

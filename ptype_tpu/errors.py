@@ -42,6 +42,21 @@ class NoClientAvailableError(RPCError):
     """No client nodes available (ref: cluster/rpc.go:15)."""
 
 
+class ShedError(RPCError):
+    """The inference gateway refused admission (overload / deadline).
+
+    A typed, *terminal* RPC error: the RPC client surfaces it without
+    retrying (re-firing into an overloaded service amplifies the
+    overload), and it round-trips the actor wire with its retry hint
+    intact (actor.py marshals it, rpc.py re-raises it typed). Callers
+    back off ``retry_after_s`` and try again.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class LeaseExpiredError(ClusterError):
     """A lease-backed registration expired and was not renewed."""
 

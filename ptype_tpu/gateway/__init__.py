@@ -1,0 +1,27 @@
+"""Inference gateway — the cluster frontdoor for generator fleets.
+
+See :mod:`ptype_tpu.gateway.frontdoor` for the architecture overview,
+docs/OPERATIONS.md "Serving at scale" for the runbook, and
+``examples/serving/fleet.py`` for a runnable walkthrough.
+"""
+
+from ptype_tpu.errors import ShedError
+from ptype_tpu.gateway.admission import AdmissionQueue
+from ptype_tpu.gateway.frontdoor import (GatewayActor, GatewayConfig,
+                                         InferenceGateway,
+                                         least_loaded_picker)
+from ptype_tpu.gateway.pool import Replica, ReplicaPool
+from ptype_tpu.gateway.slo import ScaleHint, SLOTracker
+
+__all__ = [
+    "AdmissionQueue",
+    "GatewayActor",
+    "GatewayConfig",
+    "InferenceGateway",
+    "Replica",
+    "ReplicaPool",
+    "ScaleHint",
+    "SLOTracker",
+    "ShedError",
+    "least_loaded_picker",
+]

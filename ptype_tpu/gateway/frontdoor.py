@@ -1,0 +1,342 @@
+"""The inference gateway: one frontdoor for a fleet of generator actors.
+
+``N`` :class:`~ptype_tpu.serve.GeneratorActor` replicas registered
+under one service name are independent processes to the RPC plane; the
+gateway turns them into ONE service (the Podracer shape — a frontdoor
+that queues and dispatches while the accelerator engines stay
+saturated; PAPERS.md, arxiv 2104.06272):
+
+- requests pass **admission control** (bounded queue, per-request
+  deadlines, SLO-aware shedding with typed
+  :class:`~ptype_tpu.errors.ShedError` + retry-after) before any
+  replica is touched;
+- the **replica pool** routes each admitted request least-loaded (or
+  prefix-affine), retries transport failures on surviving replicas
+  within the deadline, and evicts/revives the dead;
+- every outcome feeds the **SLO tracker**: p50/p95/p99, tokens/sec,
+  shed rate, and a :meth:`scale_hint` the elastic layer can consume.
+
+Deployment shapes:
+
+- **library**: construct in the caller's process over any Registry
+  (``InferenceGateway(cluster.registry)``), call
+  :meth:`generate`/:meth:`call`;
+- **service**: wrap in :class:`GatewayActor`, register it on an
+  ActorServer under e.g. ``llm-gw`` — thin clients then speak plain
+  actor RPC to the gateway tier, and sheds ride the wire typed
+  (actor.py marshalling, rpc.py no-retry contract);
+- **picker injection**: a process that must keep its plain
+  :class:`~ptype_tpu.rpc.Client` can still route load-aware by
+  plugging :func:`least_loaded_picker` into ``ConnConfig.picker``.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from dataclasses import dataclass
+
+from ptype_tpu import chaos, logs, metrics as metrics_mod, retry
+from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
+                              ShedError)
+from ptype_tpu.gateway.admission import AdmissionQueue
+from ptype_tpu.gateway.pool import ReplicaPool
+from ptype_tpu.gateway.slo import SLOTracker
+from ptype_tpu.registry import Registry
+
+log = logs.get_logger("gateway")
+
+
+@dataclass
+class GatewayConfig:
+    """SLO and fleet knobs (docs/OPERATIONS.md "Serving at scale")."""
+
+    #: Waiting-room bound; arrivals past it are shed with retry-after.
+    max_queue_depth: int = 64
+    #: Deadline applied when the caller passes none.
+    default_deadline_s: float = 30.0
+    #: Concurrent dispatches allowed per healthy replica. 1 matches the
+    #: lock-serialized GeneratorActor; raise it for the batching /
+    #: continuous engines, which turn concurrency into batch occupancy.
+    per_replica_inflight: int = 1
+    #: Active health probe cadence / budget (Info round-trips).
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    #: Consecutive probe failures before a replica is evicted.
+    eviction_threshold: int = 3
+    #: EWMA weight for per-replica latency observations.
+    ewma_alpha: float = 0.3
+    #: Dial budget for (re)connecting to a replica.
+    dial_timeout_s: float = 2.0
+    #: Transport-failure re-routes allowed per request (each lands on a
+    #: different replica when one exists; all bounded by the deadline).
+    max_reroutes: int = 2
+    #: Prefix-affinity: how many times costlier (estimated completion
+    #: ms) the affine replica may be than the least-loaded choice
+    #: before affinity yields to load.
+    affinity_slack: float = 3.0
+    #: Endpoint names on the replica actors.
+    generate_method: str = "Generator.Generate"
+    info_method: str = "Generator.Info"
+    #: Optional p99 target feeding the scale hint (None = no SLO term).
+    slo_p99_ms: float | None = None
+    #: Rolling window for shed-rate / tokens-per-sec readouts.
+    stats_window_s: float = 30.0
+
+
+class InferenceGateway:
+    """Admission → routing → dispatch for one generator service."""
+
+    def __init__(self, registry: Registry, service: str = "llm",
+                 cfg: GatewayConfig | None = None,
+                 metrics_registry: metrics_mod.MetricsRegistry | None = None):
+        self.cfg = cfg or GatewayConfig()
+        self.service = service
+        self.slo = SLOTracker(service, registry=metrics_registry,
+                              window_s=self.cfg.stats_window_s,
+                              slo_p99_ms=self.cfg.slo_p99_ms)
+        self.pool = ReplicaPool(
+            registry, service,
+            info_method=self.cfg.info_method,
+            probe_interval=self.cfg.probe_interval_s,
+            probe_timeout=self.cfg.probe_timeout_s,
+            eviction_threshold=self.cfg.eviction_threshold,
+            ewma_alpha=self.cfg.ewma_alpha,
+            dial_timeout=self.cfg.dial_timeout_s,
+            affinity_slack=self.cfg.affinity_slack,
+            on_change=self._on_fleet_change)
+        self.admission = AdmissionQueue(
+            self.cfg.max_queue_depth,
+            capacity=self._capacity,
+            est_service_s=self.slo.est_service_s)
+        self._closed = False
+
+    # ----------------------------------------------------------- capacity
+
+    def _capacity(self) -> int:
+        return max(1, self.pool.n_healthy()) * self.cfg.per_replica_inflight
+
+    def _on_fleet_change(self) -> None:
+        # Revived/arrived replicas may have grown capacity: grant
+        # queued waiters now rather than at the next release(). The
+        # pool's own construction fires this before the admission
+        # queue exists — nothing can be waiting yet, so skipping is
+        # correct, not a race.
+        admission = getattr(self, "admission", None)
+        if admission is not None:
+            admission.poke()
+        pool = getattr(self, "pool", None)
+        if pool is not None:
+            self.slo.g_replicas.set(pool.n_healthy())
+
+    # ------------------------------------------------------------- public
+
+    def generate(self, prompt, max_new_tokens: int = 16, *,
+                 deadline_s: float | None = None,
+                 affinity_key: str | None = None, **gen_kwargs):
+        """The serving call: admit, route, dispatch, account.
+
+        Raises :class:`ShedError` (typed, with ``retry_after_s``) when
+        overloaded or out of deadline; :class:`RemoteError` when the
+        replica's handler itself failed. Transport failures re-route to
+        surviving replicas inside the deadline.
+        """
+        args = (prompt, int(max_new_tokens))
+        if gen_kwargs:
+            # Positional tail matching GeneratorActor.Generate.
+            order = ("temperature", "seed", "top_k", "top_p",
+                     "stop_token", "pad_token", "repetition_penalty")
+            defaults = {"temperature": 0.0, "seed": 0, "top_k": 0,
+                        "top_p": 1.0, "stop_token": -1, "pad_token": 0,
+                        "repetition_penalty": 1.0}
+            unknown = set(gen_kwargs) - set(order)
+            if unknown:
+                raise TypeError(f"unknown generate kwargs: {unknown}")
+            defaults.update(gen_kwargs)
+            args = args + tuple(defaults[k] for k in order)
+        return self.call(self.cfg.generate_method, *args,
+                         deadline_s=deadline_s, affinity_key=affinity_key)
+
+    def call(self, method: str, *args,
+             deadline_s: float | None = None,
+             affinity_key: str | None = None):
+        """Generic gateway dispatch (Generate is sugar over this)."""
+        deadline = time.monotonic() + (deadline_s if deadline_s is not None
+                                       else self.cfg.default_deadline_s)
+        self.slo.arrived()
+        try:
+            self.admission.admit(key=affinity_key or method,
+                                 deadline=deadline)
+        except ShedError:
+            self.slo.shed()
+            self._export_gauges()
+            raise
+        try:
+            return self._dispatch(method, args, deadline, affinity_key)
+        finally:
+            self.admission.release()
+            self._export_gauges()
+
+    def _dispatch(self, method: str, args, deadline: float,
+                  affinity_key: str | None):
+        last_err: Exception | None = None
+        reroutes = 0
+        tried: set[str] = set()
+        bo = retry.Backoff(base=0.05, cap=0.5)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            r = self.pool.pick(affinity_key, exclude=tried)
+            if r is None:
+                # Fleet momentarily empty (mass eviction / churn):
+                # wait a beat for probes to revive someone — the
+                # deadline bounds the patience.
+                last_err = NoClientAvailableError(
+                    f"no healthy replicas for {self.service!r}")
+                bo.sleep(min(bo.next_delay(), max(0.0, remaining)))
+                continue
+            conn = r.conn
+            if conn is None or not conn.healthy:
+                continue
+            self.pool.begin(r)
+            t0 = time.perf_counter()
+            fut = None
+            try:
+                fut = conn.call_async(method, args)
+                result = fut.result(timeout=remaining)
+            except RemoteError as e:
+                # The replica RAN the handler and it raised: an
+                # application error, not a routing problem. The replica
+                # is healthy (it answered) — account and propagate.
+                ms = (time.perf_counter() - t0) * 1000.0
+                self.pool.done(r, ms, ok=True)
+                self.slo.errored()
+                raise e
+            except FuturesTimeoutError:
+                conn.forget(fut)
+                self.pool.fail(r, "deadline expired in flight")
+                last_err = RPCError(
+                    f"call {method!r} exceeded its deadline on {r.key}")
+                break  # remaining is spent; no budget to re-route
+            except Exception as e:  # noqa: BLE001 — transport failure
+                if fut is not None:
+                    conn.forget(fut)
+                self.pool.fail(r, str(e))
+                last_err = e
+                tried.add(r.key)
+                reroutes += 1
+                if reroutes > self.cfg.max_reroutes:
+                    break
+                continue
+            ms = (time.perf_counter() - t0) * 1000.0
+            self.pool.done(r, ms, ok=True)
+            tokens = 0
+            try:
+                tokens = int(result.shape[0]) * int(result.shape[1])
+            except (AttributeError, IndexError, TypeError):
+                pass
+            self.slo.answered(ms, tokens)
+            chaos.note_ok("gateway.call", r.key)
+            # The dispatch rode the rpc transport: its success also
+            # pairs rpc-class faults (the gateway bypasses Client's
+            # retry loop, where that beacon normally lives).
+            chaos.note_ok("rpc.call", method)
+            return result
+        # Out of deadline or out of re-routes: a typed shed, not a
+        # timeout — the caller gets a retry hint and the request is
+        # accounted, never silently lost.
+        self.slo.shed()
+        raise ShedError(
+            f"request not served within its deadline "
+            f"(last error: {last_err})",
+            retry_after_s=self.slo.est_service_s())
+
+    # --------------------------------------------------------- inspection
+
+    def _export_gauges(self) -> None:
+        self.slo.g_queue.set(self.admission.depth)
+        self.slo.g_replicas.set(self.pool.n_healthy())
+
+    def stats(self) -> dict:
+        """One structured readout: SLO surface + fleet + queue — what
+        ``GatewayActor.Info`` serves and the runbook reads."""
+        hint = self.scale_hint()
+        return {
+            "service": self.service,
+            "queue_depth": self.admission.depth,
+            "inflight": self.admission.inflight,
+            "capacity": self._capacity(),
+            "admitted": self.admission.admitted,
+            "shed": {"full": self.admission.shed_full,
+                     "slo": self.admission.shed_slo,
+                     "deadline": self.admission.shed_deadline},
+            "latency": self.slo.percentiles(),
+            "tokens_per_sec": round(self.slo.tokens_per_sec(), 1),
+            "shed_rate": round(self.slo.shed_rate(), 4),
+            "scale_hint": {"delta": hint.delta, "reason": hint.reason},
+            "pool": self.pool.status(),
+        }
+
+    def scale_hint(self):
+        """The autoscale signal (gateway/slo.py): advisory fleet-size
+        delta from queue depth, shed rate, tail latency, utilization."""
+        return self.slo.scale_hint(
+            queue_depth=self.admission.depth,
+            max_depth=self.cfg.max_queue_depth,
+            n_replicas=self.pool.n_healthy(),
+            inflight=self.admission.inflight,
+            capacity=self._capacity())
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.admission.close()
+        self.pool.close()
+
+
+class GatewayActor:
+    """Actor-RPC face of a gateway: register on an ActorServer under
+    e.g. ``llm-gw`` and thin clients get admission control, shedding
+    and load-aware routing through plain ``client.call`` — ShedError
+    rides the wire typed."""
+
+    def __init__(self, gateway: InferenceGateway):
+        self._gw = gateway
+
+    def Generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 stop_token: int = -1, pad_token: int = 0,
+                 repetition_penalty: float = 1.0):
+        return self._gw.generate(
+            prompt, max_new_tokens, temperature=float(temperature),
+            seed=int(seed), top_k=int(top_k), top_p=float(top_p),
+            stop_token=int(stop_token), pad_token=int(pad_token),
+            repetition_penalty=float(repetition_penalty))
+
+    def Info(self) -> dict:
+        return self._gw.stats()
+
+
+def least_loaded_picker(pool: ReplicaPool):
+    """A :class:`~ptype_tpu.rpc.ConnConfig` ``picker`` backed by a
+    pool's load map: processes that keep a plain Client route to the
+    least-loaded replica the pool knows about. Unknown connections (the
+    pool hasn't probed that node) defer to round-robin by returning
+    None."""
+
+    def picker(conns):
+        scores = {r.key: r.score() for r in pool.healthy()}
+        best, best_score = None, None
+        for c in conns:
+            key = f"{c.node.address}:{c.node.port}"
+            s = scores.get(key)
+            if s is None:
+                continue
+            if best_score is None or s < best_score:
+                best, best_score = c, s
+        return best
+
+    return picker

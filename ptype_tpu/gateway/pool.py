@@ -1,0 +1,392 @@
+"""Replica pool: the gateway's live map of the serving fleet.
+
+One :class:`Replica` per registry node of the fronted service, each
+owning its own multiplexed connection (dialed through the same
+``rpc._dial`` seam the balancer uses, so the in-process zero-copy fast
+path and the ``rpc.dial`` chaos site both apply). Two background
+threads keep the map honest:
+
+- the **watch thread** consumes the registry's snapshot stream
+  (``watch_service`` → :meth:`NodeWatch.latest`, so churn bursts
+  collapse to the final membership) and adds/removes replicas;
+- the **probe thread** runs active health checks: an ``Info()``
+  round-trip per replica per interval, feeding a per-replica EWMA
+  latency and the replica-reported ``in_flight``/``queue_depth``
+  (serve.py exports them). ``eviction_threshold`` consecutive probe
+  failures evict the replica (connection closed, no traffic routed);
+  every later round re-dials, so a recovered replica is revived
+  without operator action.
+
+Routing (:meth:`pick`) replaces the RPC plane's blind round-robin:
+
+- **least-loaded** — lowest estimated completion time: (locally
+  tracked in-flight + replica-reported backlog + 1) × EWMA service
+  latency, so a slow OR backed-up replica sheds traffic to its healthy
+  siblings instead of serializing callers behind it;
+- **prefix-affinity** (optional) — requests carrying an affinity key
+  hash (FNV-1a, the balancer's own function) to a stable replica so
+  its KV/prefix caches stay warm, UNLESS that replica's load exceeds
+  the least-loaded choice by more than ``affinity_slack`` — affinity
+  must never pin traffic to a wedged node.
+
+Chaos seams: ``gateway.probe`` (``drop``/``timeout`` — fail this probe,
+``delay`` — slow it) and ``gateway.route`` (``drop`` — veto the picked
+replica, forcing the route elsewhere; ``delay``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ptype_tpu import chaos, logs, retry, rpc as rpc_mod
+from ptype_tpu.registry import Node, Registry
+
+log = logs.get_logger("gateway.pool")
+
+
+class Replica:
+    """One fleet member: connection, load estimate, health state."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.key = f"{node.address}:{node.port}"
+        self.conn = None
+        self.inflight = 0          # locally dispatched, not yet done
+        #: EWMA of CALL latencies only. 0.0 = never called.
+        self.ewma_ms = 0.0
+        #: EWMA of probe (Info) round-trips, kept SEPARATE: probes are
+        #: cheap control-plane calls, and folding their ~1 ms RTTs into
+        #: the call EWMA would decay a degraded replica's slow-call
+        #: signal back to "fast" between requests.
+        self.probe_ms = 0.0
+        self.reported: dict = {}   # last Info() payload
+        self.fails = 0             # consecutive probe failures
+        self.up = False
+        self.dialing = False       # one (re)dial in flight at a time
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def score(self) -> float:
+        """Estimated ms until this replica would finish MY request:
+        (backlog ahead of me + me) × EWMA service time. Lower =
+        preferred. A scalar, not (backlog, latency) lexicographic — a
+        tuple would route to an idle-but-slow replica over a
+        busy-but-fast one, which is exactly the slow-replica trap
+        least-loaded routing exists to avoid. The latency estimate is
+        the WORSE of the call and probe EWMAs: calls catch a replica
+        whose compute degraded but whose Info stays fast; probes catch
+        one that is slow before it has served any call."""
+        with self.lock:
+            backlog = self.inflight + int(
+                self.reported.get("queue_depth", 0) or 0)
+            return (backlog + 1) * max(self.ewma_ms, self.probe_ms, 1.0)
+
+    def observe_ms(self, ms: float, alpha: float) -> None:
+        with self.lock:
+            self.ewma_ms = (ms if self.ewma_ms == 0.0
+                            else alpha * ms + (1 - alpha) * self.ewma_ms)
+
+    def observe_probe_ms(self, ms: float, alpha: float) -> None:
+        with self.lock:
+            self.probe_ms = (ms if self.probe_ms == 0.0
+                             else alpha * ms + (1 - alpha) * self.probe_ms)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {"key": self.key, "up": self.up,
+                    "inflight": self.inflight, "calls": self.calls,
+                    "ewma_ms": round(max(self.ewma_ms, self.probe_ms),
+                                     3),
+                    "call_ewma_ms": round(self.ewma_ms, 3),
+                    "probe_ewma_ms": round(self.probe_ms, 3),
+                    "fails": self.fails,
+                    "reported_queue_depth":
+                        int(self.reported.get("queue_depth", 0) or 0),
+                    "reported_in_flight":
+                        int(self.reported.get("in_flight", 0) or 0)}
+
+
+class ReplicaPool:
+    """Watch + probe + route over every replica of one service."""
+
+    def __init__(self, registry: Registry, service: str,
+                 info_method: str = "Generator.Info",
+                 probe_interval: float = 1.0,
+                 probe_timeout: float = 2.0,
+                 eviction_threshold: int = 3,
+                 ewma_alpha: float = 0.3,
+                 dial_timeout: float = 2.0,
+                 affinity_slack: float = 3.0,
+                 on_change=None):
+        self.service = service
+        self.info_method = info_method
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.eviction_threshold = int(eviction_threshold)
+        self.ewma_alpha = ewma_alpha
+        self.dial_timeout = dial_timeout
+        self.affinity_slack = float(affinity_slack)
+        self._on_change = on_change or (lambda: None)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._closed = threading.Event()
+        self._watch = registry.watch_service(service)
+        # First snapshot synchronously (the registry pushes one
+        # immediately): the gateway is routable the moment it
+        # constructs, instead of racing its first request against the
+        # watch thread.
+        initial = self._watch.latest(timeout=2.0)
+        if initial:
+            self._sync(initial)
+            self.probe_now()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name=f"gw-watch-{service}",
+            daemon=True)
+        self._watch_thread.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name=f"gw-probe-{service}",
+            daemon=True)
+        self._probe_thread.start()
+
+    # --------------------------------------------------------- membership
+
+    def _watch_loop(self) -> None:
+        while not self._closed.is_set():
+            snap = self._watch.latest(timeout=0.5)
+            if snap is None:
+                if self._watch.closed:
+                    return
+                continue
+            self._sync(snap)
+            self.probe_now()
+
+    def _sync(self, nodes: list[Node]) -> None:
+        wanted = {f"{n.address}:{n.port}": n for n in nodes}
+        dropped: list[Replica] = []
+        with self._lock:
+            for key in list(self._replicas):
+                if key not in wanted:
+                    dropped.append(self._replicas.pop(key))
+            for key, node in wanted.items():
+                if key not in self._replicas:
+                    self._replicas[key] = Replica(node)
+        for r in dropped:
+            self._close_conn(r)
+            log.info("replica left the fleet", kv={"replica": r.key})
+        self._on_change()
+
+    # ------------------------------------------------------------- probes
+
+    def _probe_loop(self) -> None:
+        bo = retry.Backoff(base=self.probe_interval,
+                           cap=self.probe_interval, jitter=0.25)
+        while not self._closed.is_set():
+            bo.wait(self._closed)
+            if self._closed.is_set():
+                return
+            self.probe_now()
+
+    def probe_now(self) -> None:
+        """One probe round over the whole fleet (also the re-dial
+        path: an evicted replica that answers again is revived).
+        Probes run CONCURRENTLY — one blackholed node must not stretch
+        the whole fleet's round by its dial timeout, staling the load
+        data routing depends on. The bounded join keeps rounds from
+        stacking; a straggler past it finishes in the background
+        (per-replica ``dialing`` serializes re-dials, and a probe that
+        loses the race with close() discards its connection)."""
+        reps = [r for r in self._snapshot_replicas()]
+        if not reps or self._closed.is_set():
+            return
+        if len(reps) == 1:
+            self._probe_one(reps[0])
+            return
+        threads = [threading.Thread(target=self._probe_one, args=(r,),
+                                    name=f"gw-probe-{r.key}",
+                                    daemon=True)
+                   for r in reps]
+        for t in threads:
+            t.start()
+        deadline = (time.monotonic() + self.dial_timeout
+                    + self.probe_timeout + 1.0)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _probe_one(self, r: Replica) -> None:
+        f = chaos.hit("gateway.probe", r.key)
+        if f is not None:
+            if f.action == "delay":
+                f.sleep()
+            elif f.action in ("drop", "timeout"):
+                self._probe_failed(r, f"chaos: probe {f.action}")
+                return
+        conn = self._ensure_conn(r)
+        if conn is None:
+            self._probe_failed(r, "dial failed")
+            return
+        t0 = time.perf_counter()
+        fut = None
+        try:
+            fut = conn.call_async(self.info_method, ())
+            info = fut.result(timeout=self.probe_timeout)
+        except Exception as e:  # noqa: BLE001 — any failure = unhealthy
+            if fut is not None:
+                conn.forget(fut)
+            self._probe_failed(r, str(e))
+            return
+        ms = (time.perf_counter() - t0) * 1000.0
+        was_down = not r.up
+        with r.lock:
+            r.reported = dict(info) if isinstance(info, dict) else {}
+            r.fails = 0
+            r.up = True
+        r.observe_probe_ms(ms, self.ewma_alpha)
+        if was_down:
+            chaos.note_ok("gateway.probe", r.key)
+            log.info("replica healthy", kv={"replica": r.key,
+                                            "probe_ms": round(ms, 1)})
+            self._on_change()
+
+    def _probe_failed(self, r: Replica, why: str) -> None:
+        with r.lock:
+            r.fails += 1
+            evict = r.up and r.fails >= self.eviction_threshold
+            if evict:
+                r.up = False
+        if evict:
+            self._close_conn(r)
+            log.warning("replica evicted",
+                        kv={"replica": r.key, "fails": r.fails,
+                            "err": why})
+            self._on_change()
+
+    def _ensure_conn(self, r: Replica):
+        conn = r.conn
+        if conn is not None and conn.healthy:
+            return conn
+        with r.lock:
+            if r.dialing:
+                return None  # a concurrent probe owns the re-dial
+            r.dialing = True
+        try:
+            self._close_conn(r)
+            try:
+                conn = rpc_mod._dial(r.node, self.dial_timeout)
+            except OSError:
+                return None
+            with r.lock:
+                r.conn = conn
+        finally:
+            with r.lock:
+                r.dialing = False
+        if self._closed.is_set():
+            # Lost the race with close(): its sweep may already have
+            # run — never leave a live socket + reader thread behind.
+            self._close_conn(r)
+            return None
+        return conn
+
+    def _close_conn(self, r: Replica) -> None:
+        with r.lock:
+            conn, r.conn = r.conn, None
+        if conn is not None:
+            conn.close()
+
+    # ------------------------------------------------------------ routing
+
+    def _snapshot_replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def healthy(self) -> list[Replica]:
+        return [r for r in self._snapshot_replicas()
+                if r.up and r.conn is not None and r.conn.healthy]
+
+    def n_healthy(self) -> int:
+        return len(self.healthy())
+
+    def pick(self, affinity_key: str | None = None,
+             exclude=()) -> Replica | None:
+        """Route one request: affinity first (when sane), else least
+        loaded. None when the fleet has no healthy replica.
+
+        ``exclude`` (replica keys) steers a RE-route away from
+        replicas that already failed this request — when every healthy
+        replica has failed it, exclusion lapses (retrying someone
+        beats shedding with survivors idle)."""
+        candidates = self.healthy()
+        if not candidates:
+            return None
+        if exclude:
+            fresh = [r for r in candidates if r.key not in exclude]
+            if fresh:
+                candidates = fresh
+        candidates.sort(key=lambda r: (r.score(), r.key))
+        chosen = candidates[0]
+        if affinity_key is not None and len(candidates) > 1:
+            stable = sorted(candidates, key=lambda r: r.key)
+            pinned = stable[rpc_mod.fnv32a(affinity_key) % len(stable)]
+            # Affinity yields to load: a warm prefix cache is worth a
+            # bounded cost multiple, not a wedged replica.
+            if (pinned.score()
+                    <= chosen.score() * self.affinity_slack + 10.0):
+                chosen = pinned
+        f = chaos.hit("gateway.route", chosen.key)
+        if f is not None:
+            if f.action == "delay":
+                f.sleep()
+            elif f.action == "drop":
+                rest = [r for r in candidates if r is not chosen]
+                return rest[0] if rest else None
+        return chosen
+
+    def begin(self, r: Replica) -> None:
+        with r.lock:
+            r.inflight += 1
+            r.calls += 1
+
+    def done(self, r: Replica, ms: float | None = None,
+             ok: bool = True) -> None:
+        with r.lock:
+            r.inflight = max(0, r.inflight - 1)
+        if ok and ms is not None:
+            r.observe_ms(ms, self.ewma_alpha)
+
+    def fail(self, r: Replica, why: str = "") -> None:
+        """A dispatch failed on transport: count it like a probe
+        failure so repeated call failures evict without waiting for
+        ``eviction_threshold`` probe rounds."""
+        with r.lock:
+            r.inflight = max(0, r.inflight - 1)
+        self._probe_failed(r, why or "call transport failure")
+
+    # --------------------------------------------------------- inspection
+
+    def min_ewma_ms(self) -> float:
+        obs = [r.ewma_ms for r in self.healthy() if r.ewma_ms > 0]
+        return min(obs) if obs else 0.0
+
+    def status(self) -> dict:
+        reps = [r.snapshot() for r in self._snapshot_replicas()]
+        return {"service": self.service,
+                "replicas": sorted(reps, key=lambda d: d["key"]),
+                "healthy": sum(1 for d in reps if d["up"])}
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._watch.cancel()
+        # Join the loops (bounded) BEFORE sweeping connections: a
+        # probe mid-dial could otherwise install a fresh conn (and its
+        # reader thread) after the sweep — the wedged-thread leak the
+        # chaos soak's teardown invariant exists to catch. A straggler
+        # that outlives the join is covered by _ensure_conn's
+        # closed-check, which discards its connection.
+        for t in (self._probe_thread, self._watch_thread):
+            if t is not threading.current_thread():
+                t.join(timeout=self.dial_timeout + self.probe_timeout
+                       + 2.0)
+        for r in self._snapshot_replicas():
+            self._close_conn(r)

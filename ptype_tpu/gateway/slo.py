@@ -1,0 +1,179 @@
+"""SLO accounting and autoscale signals for the gateway.
+
+Every request outcome lands here: answered (with latency and token
+count), shed (by cause), expired, or errored. The tracker exports the
+serving tail through the shared :class:`~ptype_tpu.metrics
+.MetricsRegistry` (counters, gauges, and a latency histogram with
+p50/p95/p99) and distills the state into a :class:`ScaleHint` — the
+one-number signal an elastic layer (ptype_tpu.elastic, an operator
+loop, or an external autoscaler polling ``Gateway.Info``) can consume
+without understanding the gateway's internals.
+
+Metric names (under the process-global registry by default):
+
+======================================  ================================
+``gateway.<svc>.requests``              arrivals (counter)
+``gateway.<svc>.answered``              successful responses (counter)
+``gateway.<svc>.shed``                  typed sheds, all causes (counter)
+``gateway.<svc>.errors``                non-shed failures (counter)
+``gateway.<svc>.latency_ms``            answered-request latency (histogram)
+``gateway.<svc>.queue_depth``           admission queue depth (gauge)
+``gateway.<svc>.healthy_replicas``      routable fleet size (gauge)
+``gateway.<svc>.scale_hint``            last computed hint delta (gauge)
+======================================  ================================
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ptype_tpu import metrics as metrics_mod
+
+
+@dataclass
+class ScaleHint:
+    """What the fleet should do: ``delta`` replicas (+N grow, -N
+    shrink, 0 hold), with the deciding signal spelled out."""
+
+    delta: int
+    reason: str
+    signals: dict = field(default_factory=dict)
+
+
+class SLOTracker:
+    """Windowed serving stats + the scale-hint policy.
+
+    ``window_s`` bounds every rate (shed rate, tokens/sec) to recent
+    traffic, so an hour-old burst cannot hold a scale-up hostage.
+    """
+
+    def __init__(self, service: str,
+                 registry: metrics_mod.MetricsRegistry | None = None,
+                 window_s: float = 30.0,
+                 slo_p99_ms: float | None = None):
+        self.service = service
+        self.window_s = float(window_s)
+        self.slo_p99_ms = slo_p99_ms
+        reg = registry if registry is not None else metrics_mod.metrics
+        self._reg = reg
+        p = f"gateway.{service}"
+        self.c_requests = reg.counter(f"{p}.requests")
+        self.c_answered = reg.counter(f"{p}.answered")
+        self.c_shed = reg.counter(f"{p}.shed")
+        self.c_errors = reg.counter(f"{p}.errors")
+        self.h_latency = reg.histogram(f"{p}.latency_ms")
+        self.g_queue = reg.gauge(f"{p}.queue_depth")
+        self.g_replicas = reg.gauge(f"{p}.healthy_replicas")
+        self.g_hint = reg.gauge(f"{p}.scale_hint")
+        self._lock = threading.Lock()
+        #: (t, latency_ms, tokens) for answered requests in the window.
+        self._ok: list[tuple[float, float, int]] = []
+        #: (t,) stamps for sheds in the window.
+        self._sheds: list[float] = []
+        self._ewma_ms = 0.0
+
+    # ------------------------------------------------------------ intake
+
+    def arrived(self) -> None:
+        self.c_requests.add(1)
+
+    def answered(self, latency_ms: float, tokens: int = 0) -> None:
+        self.c_answered.add(1)
+        self.h_latency.observe(latency_ms)
+        now = time.monotonic()
+        with self._lock:
+            self._ok.append((now, latency_ms, int(tokens)))
+            self._trim(now)
+            self._ewma_ms = (latency_ms if self._ewma_ms == 0.0
+                             else 0.2 * latency_ms + 0.8 * self._ewma_ms)
+
+    def shed(self) -> None:
+        self.c_shed.add(1)
+        now = time.monotonic()
+        with self._lock:
+            self._sheds.append(now)
+            self._trim(now)
+
+    def errored(self) -> None:
+        self.c_errors.add(1)
+
+    def _trim(self, now: float) -> None:
+        cut = now - self.window_s
+        while self._ok and self._ok[0][0] < cut:
+            self._ok.pop(0)
+        while self._sheds and self._sheds[0] < cut:
+            self._sheds.pop(0)
+
+    # ----------------------------------------------------------- readouts
+
+    def est_service_s(self) -> float:
+        """Current one-request service-time estimate (admission's
+        SLO-aware shed math); a conservative floor before any data."""
+        with self._lock:
+            return (self._ewma_ms / 1000.0) if self._ewma_ms else 0.1
+
+    def shed_rate(self) -> float:
+        """Shed fraction of window traffic (sheds / (sheds + ok))."""
+        with self._lock:
+            self._trim(time.monotonic())
+            total = len(self._sheds) + len(self._ok)
+            return len(self._sheds) / total if total else 0.0
+
+    def tokens_per_sec(self) -> float:
+        with self._lock:
+            self._trim(time.monotonic())
+            if len(self._ok) < 2:
+                return 0.0
+            span = self._ok[-1][0] - self._ok[0][0]
+            toks = sum(t for _, _, t in self._ok)
+            return toks / span if span > 0 else 0.0
+
+    def percentiles(self) -> dict:
+        return {"p50_ms": self.h_latency.percentile(50),
+                "p95_ms": self.h_latency.percentile(95),
+                "p99_ms": self.h_latency.percentile(99)}
+
+    # --------------------------------------------------------- scale hint
+
+    def scale_hint(self, queue_depth: int, max_depth: int,
+                   n_replicas: int, inflight: int,
+                   capacity: int) -> ScaleHint:
+        """Distill the window into one fleet-size delta.
+
+        Priority order: shedding (capacity is actively short) beats a
+        deep queue (capacity is about to be short) beats a p99 SLO
+        breach (capacity is marginal) beats idle shrink. Hold
+        otherwise. The hint is advisory — the elastic layer owns
+        actuation and rate-limiting.
+        """
+        signals = {"queue_depth": queue_depth,
+                   "shed_rate": round(self.shed_rate(), 4),
+                   "n_replicas": n_replicas,
+                   "inflight": inflight,
+                   "capacity": capacity,
+                   "tokens_per_sec": round(self.tokens_per_sec(), 1),
+                   **{k: round(v, 2)
+                      for k, v in self.percentiles().items()}}
+        delta, reason = 0, "steady"
+        per_replica = max(1, capacity // max(1, n_replicas))
+        if signals["shed_rate"] > 0.0:
+            # Backlog the queue could not absorb: size the step to the
+            # standing queue, at least one replica.
+            delta = max(1, queue_depth // per_replica)
+            reason = "shedding load"
+        elif max_depth and queue_depth >= max_depth // 2:
+            delta = max(1, queue_depth // per_replica)
+            reason = "admission queue above half depth"
+        elif (self.slo_p99_ms is not None and self.h_latency.count >= 20
+              and signals["p99_ms"] > self.slo_p99_ms):
+            delta = 1
+            reason = (f"p99 {signals['p99_ms']:.0f}ms over SLO "
+                      f"{self.slo_p99_ms:.0f}ms")
+        elif (n_replicas > 1 and queue_depth == 0
+              and inflight * 3 < capacity):
+            delta = -1
+            reason = "fleet under a third utilized"
+        self.g_hint.set(delta)
+        return ScaleHint(delta=delta, reason=reason, signals=signals)

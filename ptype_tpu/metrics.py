@@ -85,6 +85,73 @@ class Timing:
             return self.total / self.count if self.count else 0.0
 
 
+@dataclass
+class Gauge:
+    """A last-write-wins level (queue depth, live replicas, scale
+    hint) — the counter/timing pair can't express 'current value'."""
+
+    name: str
+    value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self.value += delta
+
+
+class Histogram:
+    """Windowed reservoir with exact percentiles over the last
+    ``window`` observations — the tail-latency surface (p50/p95/p99)
+    the gateway's SLO accounting and autoscale signals read. A ring
+    buffer, not a sketch: serving windows are small (thousands), and
+    exact tails are what an SLO check needs."""
+
+    __slots__ = ("name", "window", "_ring", "_idx", "_count", "_lock")
+
+    def __init__(self, name: str, window: int = 2048):
+        self.name = name
+        self.window = int(window)
+        self._ring: list[float] = []
+        self._idx = 0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            if len(self._ring) < self.window:
+                self._ring.append(float(value))
+            else:
+                self._ring[self._idx] = float(value)
+                self._idx = (self._idx + 1) % self.window
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the window; 0.0 when empty."""
+        with self._lock:
+            data = sorted(self._ring)
+        if not data:
+            return 0.0
+        rank = max(0, min(len(data) - 1,
+                          int(round(p / 100.0 * (len(data) - 1)))))
+        return data[rank]
+
+    def summary(self) -> dict:
+        return {"count": self.count,
+                "p50": self.percentile(50.0),
+                "p95": self.percentile(95.0),
+                "p99": self.percentile(99.0)}
+
+
 class MetricsRegistry:
     """Process-local named counters/timings with a JSON dump — the
     metrics surface the reference never had (SURVEY.md §5)."""
@@ -92,6 +159,8 @@ class MetricsRegistry:
     def __init__(self):
         self._counters: dict[str, Counter] = {}
         self._timings: dict[str, Timing] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
@@ -101,6 +170,15 @@ class MetricsRegistry:
     def timing(self, name: str) -> Timing:
         with self._lock:
             return self._timings.setdefault(name, Timing(name))
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge(name))
+
+    def histogram(self, name: str, window: int = 2048) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name,
+                                               Histogram(name, window))
 
     def timed(self, name: str):
         """Context manager recording wall time into a Timing."""
@@ -119,14 +197,20 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "counters": {n: c.value for n, c in self._counters.items()},
-                "timings": {
-                    n: {"mean_s": t.mean, "count": t.count,
-                        "last_s": t.last}
-                    for n, t in self._timings.items()
-                },
-            }
+            counters = dict(self._counters)
+            timings = dict(self._timings)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in counters.items()},
+            "timings": {
+                n: {"mean_s": t.mean, "count": t.count,
+                    "last_s": t.last}
+                for n, t in timings.items()
+            },
+            "gauges": {n: g.value for n, g in gauges.items()},
+            "histograms": {n: h.summary() for n, h in histograms.items()},
+        }
 
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), separators=(",", ":"))

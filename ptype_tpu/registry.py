@@ -131,6 +131,22 @@ class NodeWatch:
                 return self._queue.pop(0)
             return None
 
+    def latest(self, timeout: float | None = None) -> list[Node] | None:
+        """Newest queued snapshot, draining any older ones — the
+        consumer shape for membership-as-state users (the gateway's
+        replica pool): only the CURRENT node set matters, and replaying
+        a churn burst snapshot-by-snapshot would dial/evict through
+        intermediate states that no longer exist. Blocks like
+        :meth:`get` when the queue is empty."""
+        snap = self.get(timeout=timeout)
+        if snap is None:
+            return None
+        with self._cond:
+            if self._queue:
+                snap = self._queue[-1]
+                self._queue.clear()
+        return snap
+
     def cancel(self) -> None:
         with self._cond:
             if self._closed:

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 from ptype_tpu import actor as actor_mod
 from ptype_tpu import chaos, codec, logs, retry
 from ptype_tpu.coord import wire
-from ptype_tpu.errors import NoClientAvailableError, RemoteError, RPCError
+from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
+                              ShedError)
 from ptype_tpu.registry import Node, NodeWatch, Registry
 
 log = logs.get_logger("rpc")
@@ -63,6 +64,13 @@ class ConnConfig:
     #: waits ~``retry_backoff_base``, growing to ``retry_backoff_cap``.
     retry_backoff_base: float = 0.05
     retry_backoff_cap: float = 1.0
+    #: Pluggable connection picker: ``picker(healthy_conns) -> conn``
+    #: replaces blind round-robin in the balancer's ``get()`` — the
+    #: seam the inference gateway uses to inject its load-aware choice
+    #: (``gateway.least_loaded_picker``). Returning None (or anything
+    #: not in the list, or raising) falls back to round-robin, so a
+    #: picker can never strand a caller.
+    picker: object = None
 
 
 DEFAULT_CONN_CONFIG = ConnConfig()
@@ -129,6 +137,13 @@ class _Conn:
                     fut.set_result(codec.decode(blob))
                 except Exception as e:  # noqa: BLE001
                     fut.set_exception(RPCError(f"decode failed: {e}"))
+            elif msg.get("shed"):
+                # Typed admission refusal (gateway overload): keep the
+                # retry hint and the ShedError type across the wire —
+                # callers back off, the retry loop must NOT re-fire.
+                fut.set_exception(ShedError(
+                    msg.get("error", "request shed"),
+                    retry_after_s=msg.get("retry_after_s", 1.0)))
             else:
                 fut.set_exception(
                     RemoteError(msg.get("error", "remote error"),
@@ -255,6 +270,8 @@ class _LocalConn:
         def run():
             try:
                 fut.set_result(self._server.dispatch(method, args))
+            except ShedError as e:
+                fut.set_exception(e)  # typed: parity with the wire path
             except Exception as e:  # noqa: BLE001
                 import traceback
 
@@ -430,7 +447,10 @@ class _ConnectionBalancer:
 
     def get(self):
         """Round-robin connection (ref: rpc.go:176-183); wraps at 2**64
-        like the reference's uint64 counter (rpc_test.go:390-425)."""
+        like the reference's uint64 counter (rpc_test.go:390-425). A
+        configured ``picker`` sees the healthy set first and may
+        override the choice (load-aware routing); any misbehavior —
+        None, a stale conn, an exception — falls back to round-robin."""
         with self._seq_lock:
             seq = self._seq
             self._seq = (self._seq + 1) & 0xFFFFFFFFFFFFFFFF
@@ -444,6 +464,13 @@ class _ConnectionBalancer:
                 self._kick_redial()
             if not conns:
                 return None
+            if self.cfg.picker is not None:
+                try:
+                    chosen = self.cfg.picker(list(conns))
+                except Exception:  # noqa: BLE001 — picker is advisory
+                    chosen = None
+                if chosen is not None and any(chosen is c for c in conns):
+                    return chosen
             return conns[seq % len(conns)]
 
     def _kick_redial(self) -> None:
@@ -551,6 +578,13 @@ class Client:
                     f"call {method!r} timed out after {self.cfg.call_timeout}s"
                 )
                 self._conns._report(last_err)
+            except ShedError:
+                # Typed overload refusal: terminal by contract — every
+                # retry would land back in the same overloaded
+                # admission queue and amplify the overload the shed
+                # exists to relieve. The caller owns the backoff
+                # (retry_after_s rides the exception).
+                raise
             except Exception as e:  # noqa: BLE001
                 # Both transport errors and remote handler errors retry —
                 # "retries are possibly done on different nodes"

@@ -46,8 +46,22 @@ class GeneratorActor:
                        else jax.jit(lambda r: tfm.init_params(r, cfg))(rng))
         self._lock = threading.Lock()
         self._calls = 0
+        #: Load telemetry for the gateway's replica pool: requests that
+        #: have entered Generate/Logits and not yet returned. Kept
+        #: under its own lock — _lock is HELD for a whole decode loop,
+        #: and Info() must answer while one is in flight.
+        self._load_lock = threading.Lock()
+        self._in_flight = 0
         self._forward = jax.jit(
             lambda p, t: tfm.forward(p, t, self.cfg))
+
+    def _enter_request(self) -> None:
+        with self._load_lock:
+            self._in_flight += 1
+
+    def _exit_request(self) -> None:
+        with self._load_lock:
+            self._in_flight -= 1
 
     def Generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0,
@@ -56,24 +70,34 @@ class GeneratorActor:
                  repetition_penalty: float = 1.0):
         """prompt: (B, S) int32 tokens → (B, max_new_tokens) int32."""
         prompt = _norm_prompt(prompt)
-        with self._lock:
-            self._calls += 1
-            out = gen.generate(
-                self.params, self.cfg, prompt, int(max_new_tokens),
-                float(temperature), jax.random.PRNGKey(int(seed)),
-                top_k=int(top_k), top_p=float(top_p),
-                stop_token=int(stop_token), pad_token=int(pad_token),
-                repetition_penalty=float(repetition_penalty),
-            )
-        return out
+        self._enter_request()
+        try:
+            with self._lock:
+                self._calls += 1
+                out = gen.generate(
+                    self.params, self.cfg, prompt, int(max_new_tokens),
+                    float(temperature), jax.random.PRNGKey(int(seed)),
+                    top_k=int(top_k), top_p=float(top_p),
+                    stop_token=int(stop_token), pad_token=int(pad_token),
+                    repetition_penalty=float(repetition_penalty),
+                )
+            return out
+        finally:
+            self._exit_request()
 
     def Logits(self, tokens):
         """Full-sequence logits (B, S, V) — the eval endpoint."""
         tokens = _norm_prompt(tokens)
-        with self._lock:
-            return self._forward(self.params, tokens)
+        self._enter_request()
+        try:
+            with self._lock:
+                return self._forward(self.params, tokens)
+        finally:
+            self._exit_request()
 
     def Info(self) -> dict:
+        with self._load_lock:
+            in_flight = self._in_flight
         return {
             "n_params": tfm.count_params(self.params),
             "d_model": self.cfg.d_model,
@@ -81,6 +105,10 @@ class GeneratorActor:
             "vocab_size": self.cfg.vocab_size,
             "max_seq": self.cfg.max_seq,
             "calls": self._calls,
+            # Load telemetry (the gateway's least-loaded signal): the
+            # serialized actor's backlog is everyone parked on _lock.
+            "in_flight": in_flight,
+            "queue_depth": max(0, in_flight - 1),
         }
 
 
@@ -151,15 +179,19 @@ class BatchingGeneratorActor(GeneratorActor):
                                     seed, top_k, top_p, stop_token,
                                     pad_token, repetition_penalty)
         req = _Pending(_norm_prompt(prompt), int(max_new_tokens))
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("generator actor is closed")
-            self._queue.append(req)
-            self._cond.notify()
-        req.done.wait()
-        if req.err is not None:
-            raise req.err
-        return req.out
+        self._enter_request()
+        try:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                self._queue.append(req)
+                self._cond.notify()
+            req.done.wait()
+            if req.err is not None:
+                raise req.err
+            return req.out
+        finally:
+            self._exit_request()
 
     # ------------------------------------------------------------ worker
 
@@ -259,6 +291,9 @@ class BatchingGeneratorActor(GeneratorActor):
         info = super().Info()
         info["batches"] = self._batches
         info["batched_requests"] = self._batched_requests
+        with self._cond:
+            # Requests queued for a batching round, not lock-waiters.
+            info["queue_depth"] = len(self._queue)
         return info
 
     def close(self) -> None:
@@ -393,20 +428,24 @@ class ContinuousGeneratorActor(GeneratorActor):
         rows = [_RowPending(np.asarray(prompt[i]), max_new,
                             int(stop_token))
                 for i in range(prompt.shape[0])]
-        with self._lock:
-            self._calls += 1
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("generator actor is closed")
-            self._queue.extend(rows)
-            self._cond.notify()
-        out = np.full((len(rows), max_new), int(pad_token), np.int32)
-        for i, r in enumerate(rows):
-            r.done.wait()
-            if r.err is not None:
-                raise r.err
-            out[i, :len(r.emitted)] = r.emitted
-        return jnp.asarray(out)
+        self._enter_request()
+        try:
+            with self._lock:
+                self._calls += 1
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                self._queue.extend(rows)
+                self._cond.notify()
+            out = np.full((len(rows), max_new), int(pad_token), np.int32)
+            for i, r in enumerate(rows):
+                r.done.wait()
+                if r.err is not None:
+                    raise r.err
+                out[i, :len(r.emitted)] = r.emitted
+            return jnp.asarray(out)
+        finally:
+            self._exit_request()
 
     # ------------------------------------------------------------ engine
 
@@ -528,6 +567,11 @@ class ContinuousGeneratorActor(GeneratorActor):
         info["n_slots"] = self.n_slots
         info["engine_steps"] = self._steps
         info["max_live_slots"] = self._max_live
+        with self._cond:
+            # Rows waiting for a slot — the continuous engine's real
+            # backlog (admitted rows are being decoded, not queued).
+            info["queue_depth"] = len(self._queue)
+        info["live_slots"] = int(self._active.sum())
         return info
 
     def close(self) -> None:

@@ -362,3 +362,144 @@ def test_wal_append_delay_wedges_primary_and_standby_promotes(tmp_path):
         coord.close()
         standby.close()
         primary.close()
+
+
+# ------------------------------------------------- gateway under chaos
+
+
+def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
+    """The serving-plane soak (ISSUE 3 acceptance shape): three
+    generator replicas behind the inference gateway over REAL sockets,
+    under a chaos plan that drops sends, vetoes routes, forces sheds
+    and times out probes — while one replica is killed outright
+    mid-run and another slow-replies every call. Invariants:
+
+    - zero requests lost: every request is answered or typed-shed;
+    - serving continues after the replica death (the pool evicts the
+      corpse and routes around it);
+    - every injected fault drains to a paired recovery
+      (``chaos.unrecovered() == {}``).
+    """
+    from unittest import mock
+
+    import numpy as np
+
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.errors import ShedError
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.registry import CoordRegistry
+
+    class _Gen:
+        def __init__(self, delay_s=0.0):
+            self.delay_s = delay_s
+            self.calls = 0
+
+        def Generate(self, prompt, max_new=8, *a):
+            self.calls += 1
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            return np.full((np.asarray(prompt).shape[0], int(max_new)),
+                           3, np.int32)
+
+        def Info(self):
+            return {"in_flight": 0, "queue_depth": 0,
+                    "calls": self.calls}
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    prompt = np.zeros((1, 4), np.int32)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("gateway.route", "drop", after=3, times=2),
+        FaultSpec("gateway.admit", "shed", after=9, times=2),
+        FaultSpec("gateway.probe", "timeout", after=5, times=3),
+        FaultSpec("rpc.send", "drop", match="Generator.Generate",
+                  after=6, times=2),
+    ], seed=3, name="gateway-soak"))
+    actors, servers, regs = [], [], []
+    gw = None
+    # Real TCP end to end: the in-process fast path has no socket for
+    # rpc.send faults to injure.
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        try:
+            for i, d in enumerate((0.0, 0.0, 0.08)):
+                a = _Gen(delay_s=d)
+                s = ActorServer("127.0.0.1", 0)
+                s.register(a, "Generator")
+                s.serve()
+                actors.append(a)
+                servers.append(s)
+                regs.append(registry.register(
+                    "llm-soak", f"r{i}", "127.0.0.1", s.port))
+            gw = InferenceGateway(
+                registry, "llm-soak",
+                GatewayConfig(probe_interval_s=0.1,
+                              probe_timeout_s=1.0,
+                              default_deadline_s=8.0,
+                              max_queue_depth=32))
+            deadline = time.monotonic() + 10
+            while (gw.pool.n_healthy() < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert gw.pool.n_healthy() == 3
+
+            answered, shed, lost = [], [], []
+
+            def fire(i):
+                try:
+                    out = gw.generate(prompt, 8)
+                    assert np.asarray(out).shape == (1, 8)
+                    answered.append(i)
+                except ShedError:
+                    shed.append(i)
+                except Exception as e:  # noqa: BLE001 — lost bucket
+                    lost.append((i, repr(e)))
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(48)]
+            for t in threads[:16]:
+                t.start()
+            for t in threads[:16]:
+                t.join(timeout=60)
+            servers[0].close()  # SIGKILL-shaped: lease keeps it listed
+            for t in threads[16:]:
+                t.start()
+            for t in threads[16:]:
+                t.join(timeout=60)
+
+            assert not lost, f"requests lost: {lost}"
+            assert len(answered) + len(shed) == 48
+            assert [i for i in answered if i >= 16], (
+                "nothing served after the replica death")
+            # The corpse is evicted; survivors carry the service.
+            deadline = time.monotonic() + 10
+            while (gw.pool.n_healthy() > 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert gw.pool.n_healthy() == 2
+
+            chaos.pause()  # drain: pair anything still outstanding
+            deadline = time.monotonic() + 15
+            while chaos.unrecovered() and time.monotonic() < deadline:
+                try:
+                    gw.generate(prompt, 8)
+                except ShedError:
+                    pass
+                time.sleep(0.05)
+            assert plan.fired(), "the plan never fired a single fault"
+            assert chaos.unrecovered() == {}, (
+                f"unpaired: {chaos.unrecovered()}: {plan.trace()}")
+        except BaseException:
+            print(f"\nGATEWAY CHAOS SOAK FAILED; plan: {plan.to_json()}")
+            raise
+        finally:
+            chaos.disarm()
+            if gw is not None:
+                gw.close()
+            for r in regs:
+                r.close()
+            for s in servers:
+                s.close()
+            state.close()

@@ -127,3 +127,18 @@ def test_optimus_prime_example(tmp_path):
     finally:
         for p in procs:
             p.kill()
+
+
+def test_serving_fleet_walkthrough():
+    """The gateway walkthrough (examples/serving/fleet.py) runs end to
+    end: routing around the slow replica, typed sheds, SLO stats."""
+    proc = subprocess.Popen(
+        [sys.executable, str(EXAMPLES / "serving" / "fleet.py")],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        lines = _wait_output(proc, "FLEET WALKTHROUGH OK", 240)
+        out = "".join(lines)
+        assert "scale hint" in out
+    finally:
+        proc.kill()

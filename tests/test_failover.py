@@ -1355,3 +1355,113 @@ def free_port_pair():
     for s in socks:
         s.close()
     return addrs
+
+
+# ------------------------------------------------ quorum window anchoring
+
+
+def test_follower_vote_anchors_quorum_to_last_round_trip():
+    """Regression (ADVICE.md medium, quorum self-fence window): the
+    follower vote must extend the serving window from the follower's
+    actual last round-trip, not from "now" — an almost-TTL-old
+    heartbeat granting a fresh full TTL let a primary serve up to
+    ~2×TTL past its last real contact, overlapping a successor that
+    took the (vacant) witness lease."""
+    import socket as _socket
+
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.service import CoordServer
+
+    # Witness configured but unreachable (immediately-refused port):
+    # majority-pair mode — the follower round-trip is the only vote.
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+    ttl = 3.0
+    server = CoordServer("127.0.0.1:0", CoordState(sweep_interval=0.05),
+                         witness_addr=dead_addr, witness_ttl=ttl)
+    try:
+        feed = server.state.repl_subscribe()
+        stale = time.monotonic() - 0.8 * ttl
+        feed.last_hb = stale
+        server._quorum_until = 0.0  # white-box: decay the boot grace
+        server._quorum_round()
+        granted = server._quorum_until
+        # Old behavior: t0 + ttl ≈ now + 3.0 s of window. Anchored:
+        # stale + ttl ≈ now + 0.6 s.
+        assert granted == pytest.approx(stale + ttl, abs=0.4), (
+            f"follower vote granted "
+            f"{granted - time.monotonic():.2f}s of serving window; "
+            f"must anchor to the follower's last round-trip")
+        assert granted - time.monotonic() < 1.5
+    finally:
+        server.close()
+
+
+def test_same_term_witness_refusal_is_retriable_not_terminal():
+    """Regression (ADVICE.md low): a witness refusal whose reported
+    term is NOT above ours proves a holder-string mismatch (restart
+    under a different address, witness state loss), not a successor —
+    it must deny the vote and retry, never terminally fence. A refusal
+    carrying a strictly higher term still hard-fences."""
+    from ptype_tpu.coord import witness as witness_mod
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.service import CoordServer
+    from ptype_tpu.coord.witness import WitnessServer
+
+    w = WitnessServer(ttl=30.0)
+    server = None
+    try:
+        # Another holder string at the SAME term the server runs at —
+        # the shape an address change across a restart produces.
+        assert witness_mod.acquire(w.address, candidate="old-name",
+                                   term=0)["granted"]
+        server = CoordServer("127.0.0.1:0",
+                             CoordState(sweep_interval=0.05),
+                             witness_addr=w.address, witness_ttl=30.0)
+        server._quorum_round()
+        assert server._superseded is None, (
+            "same-term refusal must be retriable, not terminal")
+        assert server._refusals >= 1
+        # A strictly-higher recorded term — a promoted successor.
+        with w._lock:
+            w._term = server.state.term + 3
+        server._quorum_round()
+        assert server._superseded is not None
+    finally:
+        if server is not None:
+            server.close()
+        w.close()
+
+
+def test_unsynced_standby_never_consumes_witness_lease(tmp_path,
+                                                       free_port_pair):
+    """Regression (ADVICE.md low, standby._promote ordering): the
+    synced-mirror precondition must run BEFORE the witness acquire. An
+    unsynced standby that grabbed the lease (bumped term) and then
+    refused to promote left a later-returning primary permanently
+    'superseded' by a successor that never serves."""
+    from ptype_tpu.coord import witness as witness_mod
+    from ptype_tpu.coord.witness import WitnessServer
+
+    primary_addr, standby_addr = free_port_pair
+    # Nothing ever listens on primary_addr: the mirror can never sync
+    # and every probe fails — promotion attempts fire continuously.
+    w = WitnessServer(ttl=WITNESS_TTL)
+    standby = Standby(primary_addr, standby_addr, str(tmp_path / "s"),
+                      check_interval=0.1, failure_threshold=2,
+                      probe_timeout=0.3, replicate=True,
+                      register=False, witness_addr=w.address,
+                      witness_ttl=WITNESS_TTL)
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            assert not standby.promoted.is_set(), (
+                "unsynced standby must never promote")
+            time.sleep(0.1)
+        st = witness_mod.status(w.address)
+        assert st["holder"] is None, (
+            f"unsynced standby consumed the witness lease: {st}")
+    finally:
+        standby.close()
+        w.close()

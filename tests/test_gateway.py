@@ -1,0 +1,580 @@
+"""Inference gateway: admission/shed/deadline, least-loaded routing,
+dead-replica eviction + revival, typed ShedError over the wire, chaos
+seams, metrics and autoscale signals.
+
+Fast tier on purpose: the gateway is a control-plane layer, so these
+tests front FAKE generator actors (sleep + numpy, no XLA compiles) —
+the gateway cannot tell and the tests stay in the `make test` budget.
+The model-path integration rides test_serve.py / the chaos soak.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ptype_tpu import chaos
+from ptype_tpu.actor import ActorServer
+from ptype_tpu.chaos import FaultPlan, FaultSpec
+from ptype_tpu.errors import ShedError
+from ptype_tpu.gateway import (AdmissionQueue, GatewayActor, GatewayConfig,
+                               InferenceGateway, least_loaded_picker)
+from ptype_tpu.metrics import MetricsRegistry
+from ptype_tpu.registry import CoordRegistry
+from ptype_tpu.rpc import Client, ConnConfig
+
+
+class _FakeGen:
+    """Stands in for a GeneratorActor: same surface (Generate/Info),
+    no model — latency injected per-replica."""
+
+    def __init__(self, delay_s: float = 0.0, name: str = "?"):
+        self.delay_s = delay_s
+        self.name = name
+        self.calls = 0
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def Generate(self, prompt, max_new_tokens: int = 8, *args):
+        with self._lock:
+            self.calls += 1
+            self._inflight += 1
+        try:
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            rows = np.asarray(prompt).shape[0]
+            return np.full((rows, int(max_new_tokens)), 7, np.int32)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def Info(self) -> dict:
+        with self._lock:
+            return {"in_flight": self._inflight,
+                    "queue_depth": max(0, self._inflight - 1),
+                    "calls": self.calls, "name": self.name}
+
+
+def _fleet(registry, service, delays):
+    """N fake replicas served + registered; returns (actors, servers,
+    registrations)."""
+    actors, servers, regs = [], [], []
+    for i, d in enumerate(delays):
+        a = _FakeGen(delay_s=d, name=f"r{i}")
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        actors.append(a)
+        servers.append(s)
+        regs.append(registry.register(service, f"r{i}", "127.0.0.1",
+                                      s.port))
+    return actors, servers, regs
+
+
+def _gateway(registry, service, **over):
+    cfg = GatewayConfig(probe_interval_s=0.1, probe_timeout_s=1.0,
+                        eviction_threshold=3, default_deadline_s=10.0)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return InferenceGateway(registry, service, cfg,
+                            metrics_registry=MetricsRegistry())
+
+
+def _wait_healthy(gw, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if gw.pool.n_healthy() >= n:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+PROMPT = np.zeros((1, 4), np.int32)
+
+
+# ----------------------------------------------------- admission (unit)
+
+
+def test_admission_sheds_typed_when_queue_full():
+    q = AdmissionQueue(max_depth=2, capacity=lambda: 1,
+                       est_service_s=lambda: 0.01)
+    q.admit("a")                       # takes the only slot
+    q_t = [threading.Thread(target=q.admit, args=(f"w{i}",))
+           for i in range(2)]
+    for t in q_t:
+        t.start()
+    deadline = time.monotonic() + 2
+    while q.depth < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(ShedError) as ei:
+        q.admit("overflow")
+    assert ei.value.retry_after_s > 0
+    assert q.shed_full == 1
+    # Draining grants the queued waiters FIFO.
+    q.release()
+    q.release()
+    q.release()
+    for t in q_t:
+        t.join(timeout=5)
+    assert q.depth == 0 and q.admitted == 3
+
+
+def test_admission_slo_shed_when_estimated_wait_exceeds_deadline():
+    q = AdmissionQueue(max_depth=16, capacity=lambda: 1,
+                       est_service_s=lambda: 1.0)
+    q.admit("a")
+    with pytest.raises(ShedError):
+        # Estimated wait ~1s against a 0.2s budget: shed NOW, not via
+        # a timeout 0.2s from now.
+        q.admit("b", deadline=time.monotonic() + 0.2)
+    assert q.shed_slo == 1
+    q.release()
+
+
+def test_admission_deadline_lapses_while_queued():
+    q = AdmissionQueue(max_depth=16, capacity=lambda: 1,
+                       est_service_s=lambda: 0.001)
+    q.admit("a")  # never released during the wait below
+    t0 = time.monotonic()
+    with pytest.raises(ShedError):
+        q.admit("b", deadline=time.monotonic() + 0.25)
+    assert 0.2 < time.monotonic() - t0 < 2.0
+    assert q.shed_deadline == 1
+    q.release()
+
+
+# -------------------------------------------------- typed shed over RPC
+
+
+def test_shed_error_rides_the_wire_typed_and_is_not_retried(coord):
+    """A handler's ShedError must reach the caller AS a ShedError with
+    its retry hint — and the client's retry loop must NOT re-fire into
+    the overload (attempts == 1, not retries+1)."""
+    from unittest import mock
+
+    from ptype_tpu import actor as actor_mod
+
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    attempts = []
+
+    def overloaded(x):
+        attempts.append(x)
+        raise ShedError("service overloaded", retry_after_s=2.5)
+
+    server = ActorServer("127.0.0.1", 0)
+    server.register_function("Gen.Generate", overloaded)
+    server.serve()
+    reg = registry.register("shed-svc", "n0", "127.0.0.1", server.port)
+    # Real sockets: the typed error must survive MARSHALLING, not just
+    # the in-process fast path.
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        client = Client("t", "shed-svc", registry,
+                        ConnConfig(retries=3, initial_node_timeout=5.0,
+                                   debounce_time=0.1))
+        try:
+            with pytest.raises(ShedError) as ei:
+                client.call("Gen.Generate", 1)
+            assert ei.value.retry_after_s == pytest.approx(2.5)
+            assert len(attempts) == 1, (
+                f"shed was retried {len(attempts)} times")
+        finally:
+            client.close()
+            reg.close()
+            server.close()
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_least_loaded_routing_steers_around_slow_replica(coord):
+    """One of three replicas answers 40x slower: the gateway's
+    estimated-completion scoring must route the overwhelming majority
+    of traffic to the fast pair (round-robin would send a third into
+    the slow one and serialize callers behind it)."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "route-svc",
+                                   [0.005, 0.005, 0.2])
+    gw = _gateway(registry, "route-svc")
+    try:
+        assert _wait_healthy(gw, 3)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(gw.generate(PROMPT, 8)))
+            for _ in range(30)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 30
+        fast_calls = actors[0].calls + actors[1].calls
+        assert fast_calls >= 24, (
+            f"fast pair served {fast_calls}/30; slow replica got "
+            f"{actors[2].calls} — routing is not load-aware")
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_prefix_affinity_pins_stable_replica_and_yields_under_load(
+        coord):
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "aff-svc", [0, 0, 0])
+    gw = _gateway(registry, "aff-svc")
+    try:
+        assert _wait_healthy(gw, 3)
+        picks = {gw.pool.pick(affinity_key="user-42").key
+                 for _ in range(10)}
+        assert len(picks) == 1, f"affinity not stable: {picks}"
+        pinned = gw.pool.pick(affinity_key="user-42")
+        # Pile synthetic load onto the pinned replica: affinity must
+        # yield to the least-loaded choice rather than wedge the user.
+        for _ in range(15):
+            gw.pool.begin(pinned)
+        try:
+            assert gw.pool.pick(affinity_key="user-42").key != pinned.key
+        finally:
+            for _ in range(15):
+                gw.pool.done(pinned)
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_pick_excludes_replicas_that_already_failed_this_request(coord):
+    """A re-route must not land back on the replica that just failed
+    (while others are healthy); when EVERY healthy replica has failed
+    the request, exclusion lapses rather than shedding with idle
+    survivors."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "excl-svc", [0, 0])
+    gw = _gateway(registry, "excl-svc")
+    try:
+        assert _wait_healthy(gw, 2)
+        keys = sorted(r.key for r in gw.pool.healthy())
+        for _ in range(6):
+            assert gw.pool.pick(exclude={keys[0]}).key == keys[1]
+            assert gw.pool.pick(exclude={keys[1]}).key == keys[0]
+        # All healthy replicas excluded: fall back to SOMETHING.
+        assert gw.pool.pick(exclude=set(keys)) is not None
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_dead_replica_evicted_then_revived(coord):
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "evict-svc", [0, 0])
+    gw = _gateway(registry, "evict-svc")
+    try:
+        assert _wait_healthy(gw, 2)
+        dead_port = servers[1].port
+        servers[1].close()  # crash, not deregistration: lease lives on
+        deadline = time.monotonic() + 10
+        while gw.pool.n_healthy() > 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.n_healthy() == 1, "dead replica never evicted"
+        # Service continues on the survivor the whole time.
+        out = gw.generate(PROMPT, 8)
+        assert out.shape == (1, 8)
+        # The process comes back on the same port: probes must revive
+        # it without operator action.
+        revived = ActorServer("127.0.0.1", dead_port)
+        revived.register(_FakeGen(name="revived"), "Generator")
+        revived.serve()
+        servers.append(revived)
+        deadline = time.monotonic() + 10
+        while gw.pool.n_healthy() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.n_healthy() == 2, "revived replica not re-dialed"
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# ----------------------------------------------------- overload (e2e)
+
+
+def test_gateway_sheds_typed_under_overload(coord):
+    """Capacity 1 (one slow replica), queue depth 2, a burst of 8:
+    every request is either answered or shed with a retry hint —
+    nothing times out, nothing is lost."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "over-svc", [0.15])
+    gw = _gateway(registry, "over-svc", max_queue_depth=2,
+                  default_deadline_s=30.0)
+    try:
+        assert _wait_healthy(gw, 1)
+        answered, shed = [], []
+
+        def fire():
+            try:
+                answered.append(gw.generate(PROMPT, 8))
+            except ShedError as e:
+                shed.append(e)
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(answered) + len(shed) == 8
+        assert len(shed) >= 4, (answered, shed)
+        assert all(e.retry_after_s > 0 for e in shed)
+        assert gw.admission.shed_full >= 4
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# -------------------------------------------------- metrics / autoscale
+
+
+def test_metrics_and_scale_hint(coord):
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "met-svc", [0.1])
+    reg_metrics = MetricsRegistry()
+    cfg = GatewayConfig(probe_interval_s=0.1, max_queue_depth=2,
+                        default_deadline_s=30.0)
+    gw = InferenceGateway(registry, "met-svc", cfg,
+                          metrics_registry=reg_metrics)
+    try:
+        assert _wait_healthy(gw, 1)
+        outcomes = []
+
+        def fire():
+            try:
+                outcomes.append(("ok", gw.generate(PROMPT, 8)))
+            except ShedError:
+                outcomes.append(("shed", None))
+
+        threads = [threading.Thread(target=fire) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        snap = reg_metrics.snapshot()
+        assert snap["counters"]["gateway.met-svc.requests"] == 6
+        assert snap["counters"]["gateway.met-svc.answered"] >= 1
+        assert snap["counters"]["gateway.met-svc.shed"] >= 1
+        assert snap["histograms"]["gateway.met-svc.latency_ms"]["p99"] > 0
+        stats = gw.stats()
+        assert stats["tokens_per_sec"] >= 0
+        assert stats["pool"]["healthy"] == 1
+        # Shedding in the window: the autoscale hint must ask for
+        # MORE replicas, and say why.
+        hint = gw.scale_hint()
+        assert hint.delta >= 1
+        assert "shed" in hint.reason
+        assert hint.signals["shed_rate"] > 0
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_scale_hint_suggests_shrink_when_idle(coord):
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "idle-svc", [0, 0, 0])
+    gw = _gateway(registry, "idle-svc")
+    try:
+        assert _wait_healthy(gw, 3)
+        gw.generate(PROMPT, 8)  # some traffic, no pressure
+        hint = gw.scale_hint()
+        assert hint.delta == -1, hint
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# ------------------------------------------------------- picker plug-in
+
+
+def test_pluggable_picker_overrides_round_robin(coord):
+    """ConnConfig.picker is the seam for injecting the gateway's
+    load-aware choice into a plain Client: with least_loaded_picker
+    every call lands on the pool's preferred replica instead of
+    alternating."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "pick-svc", [0, 0])
+    gw = _gateway(registry, "pick-svc")
+    try:
+        assert _wait_healthy(gw, 2)
+        # Make replica 1 look expensive to the pool.
+        target = gw.pool.healthy()
+        loaded = [r for r in target if r.node.port == servers[1].port][0]
+        for _ in range(5):
+            gw.pool.begin(loaded)
+        client = Client(
+            "t", "pick-svc", registry,
+            ConnConfig(max_connections=0, initial_node_timeout=5.0,
+                       debounce_time=0.1,
+                       picker=least_loaded_picker(gw.pool)))
+        try:
+            for _ in range(6):
+                client.call("Generator.Generate", PROMPT, 4)
+            assert actors[0].calls == 6 and actors[1].calls == 0
+        finally:
+            for _ in range(5):
+                gw.pool.done(loaded)
+            client.close()
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# ------------------------------------------------------------- chaos
+
+
+def test_gateway_chaos_seams_fire_and_pair(coord):
+    """The three gateway seams behave like every PR-2 site: they fire
+    per the armed plan, land in the trace, and successful serving
+    pairs the recoveries (chaos.unrecovered() drains to {})."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "chaos-svc", [0, 0])
+    gw = _gateway(registry, "chaos-svc")
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("gateway.admit", "shed", times=1),
+        FaultSpec("gateway.route", "drop", times=1),
+        FaultSpec("gateway.probe", "timeout", times=1),
+    ]))
+    try:
+        assert _wait_healthy(gw, 2)
+        shed = 0
+        for _ in range(6):
+            try:
+                out = gw.generate(PROMPT, 8)
+                assert out.shape == (1, 8)
+            except ShedError:
+                shed += 1
+        assert shed == 1, "gateway.admit/shed must fire exactly once"
+        sites = {e.site for e in plan.fired()}
+        assert "gateway.admit" in sites and "gateway.route" in sites
+        deadline = time.monotonic() + 10
+        while chaos.unrecovered() and time.monotonic() < deadline:
+            gw.generate(PROMPT, 8)
+            time.sleep(0.05)
+        assert chaos.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_gateway_zero_loss_through_replica_kill_and_slow_reply(coord):
+    """The acceptance drill at fast-tier scale: one of three replicas
+    is killed mid-run and another slow-replies throughout, while chaos
+    vetoes routes and forces sheds. The gateway keeps serving: every
+    request is answered or typed-shed (zero lost), service continues
+    AFTER the kill, and the fault trace drains to paired."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "soak-svc",
+                                   [0.0, 0.0, 0.08])
+    gw = _gateway(registry, "soak-svc", default_deadline_s=8.0)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("gateway.route", "drop", after=4, times=2),
+        FaultSpec("gateway.admit", "shed", after=10, times=2),
+        FaultSpec("gateway.probe", "timeout", after=6, times=2),
+    ]))
+    answered, shed, lost = [], [], []
+    try:
+        assert _wait_healthy(gw, 3)
+
+        def fire(i):
+            try:
+                answered.append((i, gw.generate(PROMPT, 8)))
+            except ShedError as e:
+                shed.append((i, e))
+            except Exception as e:  # noqa: BLE001 — the "lost" bucket
+                lost.append((i, e))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(40)]
+        for t in threads[:14]:
+            t.start()
+        for t in threads[:14]:
+            t.join(timeout=30)
+        servers[0].close()  # kill one fast replica mid-run
+        for t in threads[14:]:
+            t.start()
+        for t in threads[14:]:
+            t.join(timeout=30)
+        assert not lost, f"requests lost (not answered, not shed): {lost}"
+        assert len(answered) + len(shed) == 40
+        post_kill = [i for i, _ in answered if i >= 14]
+        assert post_kill, "nothing served after the replica kill"
+        chaos.pause()
+        deadline = time.monotonic() + 10
+        while chaos.unrecovered() and time.monotonic() < deadline:
+            gw.generate(PROMPT, 8)
+            time.sleep(0.05)
+        assert chaos.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# ---------------------------------------------------- actor wrapper
+
+
+def test_gateway_actor_fronts_fleet_over_rpc(coord):
+    """GatewayActor: thin clients speak plain actor RPC to the gateway
+    tier and still get typed sheds + stats."""
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = _fleet(registry, "fleet-svc", [0, 0])
+    gw = _gateway(registry, "fleet-svc")
+    gw_server = ActorServer("127.0.0.1", 0)
+    gw_server.register(GatewayActor(gw), "Gateway")
+    gw_server.serve()
+    gw_reg = registry.register("fleet-gw", "gw0", "127.0.0.1",
+                               gw_server.port)
+    client = Client("t", "fleet-gw", registry,
+                    ConnConfig(initial_node_timeout=5.0,
+                               debounce_time=0.1))
+    try:
+        assert _wait_healthy(gw, 2)
+        out = client.call("Gateway.Generate", PROMPT, 8)
+        assert np.asarray(out).shape == (1, 8)
+        info = client.call("Gateway.Info")
+        assert info["pool"]["healthy"] == 2
+        assert info["queue_depth"] == 0
+        assert "scale_hint" in info
+    finally:
+        client.close()
+        gw_reg.close()
+        gw_server.close()
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()

@@ -139,3 +139,55 @@ def test_repl_idle_tick_derives_from_ttl():
     # small TTL: 3 ticks per TTL so a quiet follower's vote can't flap
     assert _repl_idle_tick(0.6) == pytest.approx(0.2)
     assert _repl_idle_tick(30.0) == 1.0      # big TTL: 1 s ceiling holds
+
+
+PT003_BYPASS = (
+    "def serve(cluster):\n"
+    "    client = cluster.new_client('llm')\n"
+    "    return client.call('Generator.Generate', [1, 2], 8)\n"
+)
+
+
+def test_pt003_flags_direct_llm_client_in_package(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/bypass.py", PT003_BYPASS)
+    assert any("PT003" in f for f in findings), findings
+
+
+def test_pt003_silent_inside_gateway_package(tmp_path):
+    # The gateway IS the sanctioned frontdoor.
+    findings = _check(tmp_path, "ptype_tpu/gateway/ok.py", PT003_BYPASS)
+    assert not any("PT003" in f for f in findings), findings
+
+
+def test_pt003_silent_outside_package(tmp_path):
+    # Examples / tests may drive the raw client deliberately.
+    findings = _check(tmp_path, "examples/demo.py", PT003_BYPASS)
+    assert not any("PT003" in f for f in findings), findings
+
+
+def test_pt003_ignores_other_services(tmp_path):
+    src = ("def f(cluster):\n"
+           "    return cluster.new_client('calculator')\n")
+    findings = _check(tmp_path, "ptype_tpu/calc.py", src)
+    assert not any("PT003" in f for f in findings), findings
+
+
+def test_pt003_honors_noqa(tmp_path):
+    src = ("def f(cluster):\n"
+           "    return cluster.new_client('llm')  # noqa: bench path\n")
+    findings = _check(tmp_path, "ptype_tpu/sup3.py", src)
+    assert not any("PT003" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt003_clean():
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt003 = [f for f in findings if "PT003" in f]
+    assert not pt003, pt003

@@ -45,6 +45,9 @@ def test_generate_over_rpc():
         info = client.call("Generator.Info")
         assert info["n_params"] == tfm.count_params(actor.params)
         assert info["calls"] >= 1
+        # Load telemetry for the gateway's replica pool: idle here.
+        assert info["in_flight"] == 0
+        assert info["queue_depth"] == 0
 
         logits = client.call("Generator.Logits", prompt)
         assert logits.shape == (2, 4, CFG.vocab_size)
@@ -88,6 +91,8 @@ def test_batching_generator_coalesces_and_matches_solo():
         assert info["batched_requests"] == 6
         # Coalescing actually happened: fewer rounds than requests.
         assert info["batches"] < 6
+        # Load telemetry drained with the queue.
+        assert info["queue_depth"] == 0 and info["in_flight"] == 0
     finally:
         actor.close()
 

@@ -24,6 +24,10 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   and poll loops must ride ptype_tpu.retry.Backoff (jittered
   exponential with a cap) so a fleet can't re-fire in lockstep into a
   dying node set; close-aware loops should use ``Event.wait``
+- PT003 (ptype_tpu/ outside gateway/): ``new_client("llm")`` — a
+  direct balanced client to the generation service bypasses the
+  gateway's admission control, shedding, and load-aware routing
+  (gateway.InferenceGateway / GatewayActor is the frontdoor)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -233,6 +237,41 @@ class _PerLeafCollectiveCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: Service names whose balanced-client path must go through the
+#: gateway: raw ``new_client`` calls to them skip admission control
+#: and least-loaded routing, so one slow replica re-serializes callers.
+_GATED_SERVICES = frozenset({"llm"})
+
+
+class _GatewayBypassCheck(ast.NodeVisitor):
+    """PT003: a direct ``new_client("llm")`` inside ptype_tpu/ (the
+    gateway package itself excepted). Framework code must front the
+    generation fleet with gateway.InferenceGateway — the raw balancer
+    is round-robin with no admission queue, exactly the path the
+    gateway subsystem replaces."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = None
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        if (name == "new_client" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in _GATED_SERVICES):
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT003 direct "
+                f"new_client({node.args[0].value!r}) bypasses the "
+                f"inference gateway (admission control, shedding, "
+                f"load-aware routing); use gateway.InferenceGateway "
+                f"or a GatewayActor service")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -284,6 +323,9 @@ def check_file(path: str, findings: list[str]) -> None:
         # retry.py IS the sanctioned sleeper; everything else in the
         # package must go through it.
         _SleepInLoopCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and "gateway" not in parts:
+        # The gateway package is the one sanctioned frontdoor.
+        _GatewayBypassCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
