@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos
+.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -30,6 +30,14 @@ serve-bench:
 chaos:
 	PTYPE_CHAOS_SOAK_SEED=$${PTYPE_CHAOS_SOAK_SEED:-$$(date +%s)} \
 		python -m pytest tests/test_chaos_soak.py -q
+
+# Distributed-tracing walkthrough (docs/OBSERVABILITY.md): a traced
+# in-process fleet (coordinator + two workers over real sockets +
+# gateway) serves a few requests — one under a seeded chaos fault —
+# then the cluster telemetry snapshot is pulled over actor RPC and a
+# stitched Chrome trace (Perfetto-loadable) is written.
+obs-demo:
+	JAX_PLATFORMS=cpu python examples/observability/demo.py
 
 # Compile + run the Pallas flash kernel fwd/bwd on an attached TPU —
 # the only tier that sees Mosaic tiling checks (exit 42 = no TPU,

@@ -25,6 +25,12 @@ operations need. Commands:
                ``witness_ttl``): the third vote that lets a
                partitioned-minority primary self-fence and gates
                standby promotion on a real majority.
+- ``obs``    — fleet-wide observability snapshot: walk the registry
+               of the cluster described by $CONFIG, pull every node's
+               telemetry (metrics + flight-recorder spans) over its
+               actor RPC surface, write a stitched Chrome trace
+               ($OBS_DIR/trace.json — load in Perfetto) + spans JSONL,
+               and print the summary (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -261,6 +267,32 @@ def _witness() -> None:
         srv.close()
 
 
+def _obs() -> None:
+    import os
+
+    from ptype_tpu import config_from_env
+    from ptype_tpu import telemetry as tel
+    from ptype_tpu.coord.remote import RemoteCoord
+    from ptype_tpu.registry import CoordRegistry
+
+    cfg = config_from_env()
+    coord = RemoteCoord([cfg.platform.coordinator_address])
+    try:
+        snap = tel.cluster_snapshot(CoordRegistry(coord),
+                                    include_local=False)
+        out_dir = os.environ.get("OBS_DIR", ".")
+        chrome = tel.write_chrome_trace(
+            os.path.join(out_dir, "trace.json"), snap)
+        jsonl = tel.write_spans_jsonl(
+            os.path.join(out_dir, "spans.jsonl"), snap)
+        print(tel.render_summary(snap))
+        print(f"chrome trace: {chrome} (load in ui.perfetto.dev or "
+              f"chrome://tracing)")
+        print(f"spans jsonl:  {jsonl}")
+    finally:
+        coord.close()
+
+
 COMMANDS = {
     "info": _info,
     "join": _join,
@@ -270,6 +302,7 @@ COMMANDS = {
     "bench": _bench,
     "standby": _standby,
     "witness": _witness,
+    "obs": _obs,
 }
 
 

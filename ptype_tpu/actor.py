@@ -22,7 +22,7 @@ import struct
 import threading
 import traceback
 
-from ptype_tpu import codec, logs
+from ptype_tpu import codec, logs, trace
 from ptype_tpu.coord import wire
 from ptype_tpu.errors import ShedError
 
@@ -50,6 +50,12 @@ class ActorServer:
         # advertises the host's routable IP (cluster.go:198-213), so the
         # server must be reachable on it.
         self._handlers: dict[str, object] = {}
+        # Built-in observability endpoint: every actor server answers
+        # the cluster telemetry pull plane (metrics snapshot + recent
+        # spans from the flight recorder) without registration —
+        # ptype_tpu.telemetry.cluster_snapshot walks the registry and
+        # calls this on every node.
+        self._handlers["ptype.Telemetry"] = trace.telemetry
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -164,7 +170,11 @@ class ActorServer:
         method = msg.get("method", "")
         try:
             args = codec.decode(args_blob) if args_blob is not None else ()
-            result = self.dispatch(method, args)
+            # Adopt the caller's trace context (the "tp" frame field)
+            # so dispatch()'s handler span joins the caller's trace —
+            # the cross-process stitch.
+            with trace.attach(msg.get("tp")):
+                result = self.dispatch(method, args)
             result_parts = codec.encode_parts(result)
             reply = {"id": req_id, "ok": True,
                      "result_len": sum(len(p) for p in result_parts)}
@@ -179,6 +189,11 @@ class ActorServer:
             reply = {"id": req_id, "ok": False, "error": f"{type(e).__name__}: {e}",
                      "traceback": traceback.format_exc()}
             result_parts = []
+            # An unhandled handler error is a post-mortem moment:
+            # snapshot the flight recorder (no-op unless a dump dir is
+            # configured; rate-limited inside).
+            trace.maybe_dump(f"actor error in {method}: "
+                             f"{type(e).__name__}")
         try:
             payload = json.dumps(reply, separators=(",", ":")).encode()
             # One writev (native) / one sendall keeps the header frame and
@@ -193,13 +208,19 @@ class ActorServer:
             pass
 
     def dispatch(self, method: str, args):
-        """Invoke a handler directly (used by the zero-copy local path)."""
+        """Invoke a handler directly (used by the zero-copy local path).
+
+        The handler runs inside an ``actor/<method>`` span — for wire
+        calls it parents under the traceparent `_handle_request`
+        attached; for local calls the caller's context flows in via
+        `_LocalConn`'s copied contextvars. Both paths stitch."""
         fn = self._handlers.get(method)
         if fn is None:
             raise AttributeError(f"no such method: {method!r}")
-        if isinstance(args, (list, tuple)):
-            return fn(*args)
-        return fn(args)
+        with trace.span(f"actor/{method}", port=self.port):
+            if isinstance(args, (list, tuple)):
+                return fn(*args)
+            return fn(args)
 
     def close(self) -> None:
         if self._closed.is_set():

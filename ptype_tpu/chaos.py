@@ -63,6 +63,7 @@ __all__ = [
     "FaultSpec", "FaultPlan", "FaultEvent", "Fault",
     "arm", "disarm", "current", "armed", "pause", "resume",
     "hit", "note_ok", "trace", "fired", "unrecovered",
+    "set_observer",
 ]
 
 #: Env var carrying a plan for workers spawned as separate processes:
@@ -213,13 +214,17 @@ class FaultPlan:
             self._pending[_cls(site)] = self._pending.get(_cls(site), 0) + 1
             return Fault(winner)
 
-    def _note_ok(self, site: str, key: str) -> None:
+    def _note_ok(self, site: str, key: str) -> bool:
+        """Returns True when a recovery was recorded (a fault of this
+        class was outstanding) — the module-level beacon forwards those
+        to the trace observer."""
         with self._lock:
             c = _cls(site)
             if self._pending.get(c, 0) <= 0:
-                return
+                return False
             self._pending[c] -= 1
             self._record("recovery", site, "ok", key)
+            return True
 
     def _record(self, kind: str, site: str, action: str, key: str) -> None:
         self._trace.append(FaultEvent(
@@ -252,6 +257,27 @@ class FaultPlan:
 
 _plan: FaultPlan | None = None
 _paused: bool = False
+#: Optional ``cb(kind, site, action, key)`` notified on every recorded
+#: firing/recovery OUTSIDE the plan lock — how the trace plane
+#: (ptype_tpu.trace) attaches chaos events to the afflicted request's
+#: span without this module importing anything above the stdlib.
+_observer = None
+
+
+def set_observer(cb) -> None:
+    """Install (or clear, with None) the firing/recovery observer."""
+    global _observer
+    _observer = cb
+
+
+def _notify(kind: str, site: str, action: str, key: str) -> None:
+    obs = _observer
+    if obs is None:
+        return
+    try:
+        obs(kind, site, action, key)
+    except Exception:  # noqa: BLE001 — observers must never break a seam
+        pass
 
 
 def arm(plan: FaultPlan) -> FaultPlan:
@@ -303,15 +329,18 @@ def hit(site: str, key: str = "") -> Fault | None:
     plan = _plan
     if plan is None or _paused:
         return None
-    return plan._hit(site, key)
+    f = plan._hit(site, key)
+    if f is not None:
+        _notify("fault", site, f.action, key)
+    return f
 
 
 def note_ok(site: str, key: str = "") -> None:
     """Success-path beacon: records a recovery if a fault of this
     site's class is outstanding; free no-op otherwise."""
     plan = _plan
-    if plan is not None:
-        plan._note_ok(site, key)
+    if plan is not None and plan._note_ok(site, key):
+        _notify("recovery", site, "ok", key)
 
 
 def trace() -> list[FaultEvent]:

@@ -15,7 +15,7 @@ import socket
 import threading
 import time
 
-from ptype_tpu import chaos, logs, retry
+from ptype_tpu import chaos, logs, retry, trace
 from ptype_tpu.coord import wire
 from ptype_tpu.coord.core import CoordState, RangeOptions, Watch
 
@@ -324,6 +324,11 @@ class CoordServer:
                 msg: dict) -> None:
         req_id = msg.get("id")
         op = msg.get("op", "")
+        # Wire trace context (coord/wire.py injects "_tp"): popped
+        # unconditionally so op handlers never see it; adopted around
+        # the dispatch below so coordinator work joins the caller's
+        # trace.
+        tp = msg.pop("_tp", None)
         pump_watch: Watch | None = None
         pump_feed = None
         # Quorum fence BEFORE anything else: a minority-partition or
@@ -395,6 +400,14 @@ class CoordServer:
                 with watches_lock:
                     feeds[pump_feed.id] = pump_feed
                 result = pump_feed.id
+            elif tp is not None and trace.enabled():
+                # Request-scoped op carrying trace context: run it as a
+                # child span of the caller's rpc/train span. Untraced
+                # callers skip the span (no per-keepalive root-trace
+                # noise in the flight recorder).
+                with trace.attach(tp), trace.span(f"coord.{op}", op=op):
+                    result = self._dispatch(conn, send_lock, watches,
+                                            watches_lock, op, msg)
             else:
                 result = self._dispatch(conn, send_lock, watches,
                                         watches_lock, op, msg)

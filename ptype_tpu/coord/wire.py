@@ -14,7 +14,7 @@ import socket
 import struct
 import threading
 
-from ptype_tpu import chaos
+from ptype_tpu import chaos, trace
 
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -40,6 +40,13 @@ def _chaos_kill(sock: socket.socket) -> None:
 
 
 def send_msg(sock: socket.socket, lock: threading.Lock, msg: dict) -> None:
+    tp = trace.traceparent()
+    if tp is not None and "_tp" not in msg:
+        # Trace context rides the frame (the coord-plane analog of the
+        # actor frame's "tp"): CoordServer attaches it around op
+        # dispatch so coordinator work joins the caller's trace.
+        # Replies/pushes sent from untraced threads carry nothing.
+        msg = {**msg, "_tp": tp}
     payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     if len(payload) > MAX_FRAME:
         raise WireError(f"frame too large: {len(payload)} bytes")

@@ -36,7 +36,7 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 
-from ptype_tpu import chaos, logs, metrics as metrics_mod, retry
+from ptype_tpu import chaos, logs, metrics as metrics_mod, retry, trace
 from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
                               ShedError)
 from ptype_tpu.gateway.admission import AdmissionQueue
@@ -160,22 +160,32 @@ class InferenceGateway:
     def call(self, method: str, *args,
              deadline_s: float | None = None,
              affinity_key: str | None = None):
-        """Generic gateway dispatch (Generate is sugar over this)."""
+        """Generic gateway dispatch (Generate is sugar over this).
+
+        The whole request runs inside a ``gateway.request`` span with
+        ``gateway.admit`` / ``gateway.route`` / ``rpc.call`` children —
+        one stitched trace from frontdoor to replica handler (served as
+        a GatewayActor, the span parents under the caller's actor RPC
+        trace automatically)."""
         deadline = time.monotonic() + (deadline_s if deadline_s is not None
                                        else self.cfg.default_deadline_s)
-        self.slo.arrived()
-        try:
-            self.admission.admit(key=affinity_key or method,
-                                 deadline=deadline)
-        except ShedError:
-            self.slo.shed()
-            self._export_gauges()
-            raise
-        try:
-            return self._dispatch(method, args, deadline, affinity_key)
-        finally:
-            self.admission.release()
-            self._export_gauges()
+        with trace.span("gateway.request", service=self.service,
+                        method=method):
+            self.slo.arrived()
+            try:
+                with trace.span("gateway.admit"):
+                    self.admission.admit(key=affinity_key or method,
+                                         deadline=deadline)
+            except ShedError:
+                self.slo.shed()
+                self._export_gauges()
+                trace.maybe_dump(f"shed at admission ({self.service})")
+                raise
+            try:
+                return self._dispatch(method, args, deadline, affinity_key)
+            finally:
+                self.admission.release()
+                self._export_gauges()
 
     def _dispatch(self, method: str, args, deadline: float,
                   affinity_key: str | None):
@@ -187,7 +197,9 @@ class InferenceGateway:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
-            r = self.pool.pick(affinity_key, exclude=tried)
+            with trace.span("gateway.route") as rsp:
+                r = self.pool.pick(affinity_key, exclude=tried)
+                rsp.set_attr("replica", r.key if r is not None else None)
             if r is None:
                 # Fleet momentarily empty (mass eviction / churn):
                 # wait a beat for probes to revive someone — the
@@ -202,9 +214,16 @@ class InferenceGateway:
             self.pool.begin(r)
             t0 = time.perf_counter()
             fut = None
+            # The dispatch span: the traceparent injected by
+            # call_async is this span, so the replica's handler span
+            # parents under the exact attempt that carried it (the
+            # gateway bypasses Client's retry loop, where the rpc.call
+            # span normally lives).
+            dsp = trace.span("rpc.call", method=method, replica=r.key)
             try:
-                fut = conn.call_async(method, args)
-                result = fut.result(timeout=remaining)
+                with dsp:
+                    fut = conn.call_async(method, args)
+                    result = fut.result(timeout=remaining)
             except RemoteError as e:
                 # The replica RAN the handler and it raised: an
                 # application error, not a routing problem. The replica
@@ -247,6 +266,8 @@ class InferenceGateway:
         # timeout — the caller gets a retry hint and the request is
         # accounted, never silently lost.
         self.slo.shed()
+        trace.add_event("gateway.shed", last_error=str(last_err)[:200])
+        trace.maybe_dump(f"shed in dispatch ({self.service})")
         raise ShedError(
             f"request not served within its deadline "
             f"(last error: {last_err})",

@@ -9,12 +9,15 @@ module; this is it.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
 from dataclasses import dataclass, field
 
 import jax
+
+from ptype_tpu import trace as trace_mod
 
 #: Peak bf16 matmul TFLOP/s per chip, by PJRT device_kind substring.
 #: Public numbers (cloud.google.com/tpu docs); CPU entry is a nominal
@@ -61,6 +64,12 @@ class Counter:
             self.value += delta
 
 
+#: Recent observations a Timing keeps for its percentile window —
+#: enough to be distribution-aware on hot paths, small enough that the
+#: per-observe cost stays one deque append.
+TIMING_WINDOW = 256
+
+
 @dataclass
 class Timing:
     name: str
@@ -72,17 +81,52 @@ class Timing:
     last: float = 0.0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
+    #: Ring of the most recent observations, powering percentile() —
+    #: hot-path timings (rpc calls, store pushes) are long-tailed, and
+    #: a mean hides exactly the tail an SLO check needs.
+    _recent: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=TIMING_WINDOW),
+        repr=False, compare=False)
 
     def observe(self, seconds: float) -> None:
         with self._lock:
             self.total += seconds
             self.count += 1
             self.last = seconds
+            self._recent.append(seconds)
 
     @property
     def mean(self) -> float:
         with self._lock:
             return self.total / self.count if self.count else 0.0
+
+    @staticmethod
+    def _rank(data: list, p: float) -> float:
+        if not data:
+            return 0.0
+        rank = max(0, min(len(data) - 1,
+                          int(round(p / 100.0 * (len(data) - 1)))))
+        return data[rank]
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the recent window (seconds);
+        0.0 before any observation."""
+        with self._lock:
+            data = sorted(self._recent)
+        return self._rank(data, p)
+
+    def summary(self) -> dict:
+        # One lock round-trip + one sort for all three percentiles:
+        # snapshot() calls this per timing on every ptype.Telemetry
+        # pull, and observe() contends the same lock on hot paths.
+        with self._lock:
+            data = sorted(self._recent)
+            total, count, last = self.total, self.count, self.last
+        return {"mean_s": total / count if count else 0.0,
+                "count": count, "last_s": last,
+                "p50_s": self._rank(data, 50.0),
+                "p95_s": self._rank(data, 95.0),
+                "p99_s": self._rank(data, 99.0)}
 
 
 @dataclass
@@ -201,13 +245,13 @@ class MetricsRegistry:
             timings = dict(self._timings)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
+        # Every family dumps uniformly: counters/gauges as values,
+        # timings and histograms as distribution summaries (count +
+        # p50/p95/p99) — the gateway's SLO tail and a hot path's
+        # Timing read the same way in one dump.
         return {
             "counters": {n: c.value for n, c in counters.items()},
-            "timings": {
-                n: {"mean_s": t.mean, "count": t.count,
-                    "last_s": t.last}
-                for n, t in timings.items()
-            },
+            "timings": {n: t.summary() for n, t in timings.items()},
             "gauges": {n: g.value for n, g in gauges.items()},
             "histograms": {n: h.summary() for n, h in histograms.items()},
         }
@@ -290,14 +334,43 @@ class trace:
         return False
 
 
+class _AnnotatedSpan:
+    """TraceAnnotation + distributed-trace span entered as one scope —
+    profiler timelines and the flight recorder see the same region."""
+
+    __slots__ = ("_ann", "_sp")
+
+    def __init__(self, ann, sp):
+        self._ann = ann
+        self._sp = sp
+
+    def __enter__(self):
+        self._ann.__enter__()
+        self._sp.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._sp.__exit__(*exc)
+        return self._ann.__exit__(*exc)
+
+
 def annotate(name: str, **kwargs):
     """Named region in profiler traces (host + device timeline). Use
     around Store pushes so allreduce time is attributable:
 
     >>> with metrics.annotate("store.push/grads"):
     ...     store.push_tree("grads", grads)
+
+    When distributed tracing is armed (:mod:`ptype_tpu.trace`), the
+    region ALSO opens a span of the same name — store pushes and train
+    steps nest inside both the jax profiler trace and the request's
+    distributed trace through this one seam. Disabled tracing costs
+    one ``enabled()`` check.
     """
-    return jax.profiler.TraceAnnotation(name, **kwargs)
+    ann = jax.profiler.TraceAnnotation(name, **kwargs)
+    if not trace_mod.enabled():
+        return ann
+    return _AnnotatedSpan(ann, trace_mod.span(name))
 
 
 def step_annotation(step: int):

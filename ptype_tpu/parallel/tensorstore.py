@@ -155,12 +155,16 @@ class TensorStore:
     def pull(self, key: str, gather: bool = False) -> jax.Array:
         """Get; with ``gather=True``, return a fully-replicated view
         (allgather lowering of a linearizable read)."""
-        _store_fault("store.pull", key)
-        value = self.get(key)
-        if gather:
-            value = jax.device_put(value, NamedSharding(self.mesh, P()))
-        chaos.note_ok("store.pull", key)
-        return value
+        from ptype_tpu.metrics import annotate
+
+        with annotate(f"store.pull/{key}"):
+            _store_fault("store.pull", key)
+            value = self.get(key)
+            if gather:
+                value = jax.device_put(value,
+                                       NamedSharding(self.mesh, P()))
+            chaos.note_ok("store.pull", key)
+            return value
 
     def delete(self, key: str) -> None:
         with self._lock:
@@ -341,7 +345,18 @@ class TensorStore:
                  gather: bool = False) -> dict[str, jax.Array]:
         """All keys under ``prefix/`` as a flat dict. ``gather=True``
         returns fully-replicated views (the allgather lowering of a
-        linearizable read), resharded through one batched device_put."""
+        linearizable read), resharded through one batched device_put.
+
+        Runs as a ``store.pull_tree/<prefix>`` region through the
+        metrics.annotate seam — profiler timeline + distributed-trace
+        span from the one hook (same contract as push_tree)."""
+        from ptype_tpu.metrics import annotate
+
+        with annotate(f"store.pull_tree/{prefix}"):
+            return self._get_tree(prefix, gather)
+
+    def _get_tree(self, prefix: str,
+                  gather: bool = False) -> dict[str, jax.Array]:
         _store_fault("store.pull", prefix)
         sep = prefix + "/"
         with self._lock:

@@ -19,6 +19,7 @@ Documented reference bugs are **fixed, not replicated** (SURVEY.md §2):
 
 from __future__ import annotations
 
+import contextvars
 import json
 import queue
 import struct
@@ -29,7 +30,7 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 
 from ptype_tpu import actor as actor_mod
-from ptype_tpu import chaos, codec, logs, retry
+from ptype_tpu import chaos, codec, logs, retry, trace
 from ptype_tpu.coord import wire
 from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
                               ShedError)
@@ -171,10 +172,13 @@ class _Conn:
         fut.req_id = req_id  # lets the caller forget() a timed-out call
         with self._pending_lock:
             self._pending[req_id] = fut
-        header = json.dumps(
-            {"id": req_id, "method": method, "args_len": args_len},
-            separators=(",", ":"),
-        ).encode("utf-8")
+        frame = {"id": req_id, "method": method, "args_len": args_len}
+        tp = trace.traceparent()
+        if tp is not None:
+            # Trace context rides the request frame: the server attaches
+            # it around dispatch so the handler's spans join this trace.
+            frame["tp"] = tp
+        header = json.dumps(frame, separators=(",", ":")).encode("utf-8")
         try:
             with self._send_lock:
                 # One writev (native) / one sendall: the header frame and
@@ -266,10 +270,15 @@ class _LocalConn:
 
     def call_async(self, method: str, args) -> Future:
         fut: Future = Future()
+        # Carry the caller's trace context into the dispatch thread —
+        # contextvars do not flow into new threads on their own, and
+        # the local fast path must stitch like the wire path does.
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                fut.set_result(self._server.dispatch(method, args))
+                fut.set_result(
+                    ctx.run(self._server.dispatch, method, args))
             except ShedError as e:
                 fut.set_exception(e)  # typed: parity with the wire path
             except Exception as e:  # noqa: BLE001
@@ -567,33 +576,51 @@ class Client:
             if conn is None:
                 last_err = NoClientAvailableError("no client nodes available")
                 continue
-            fut = conn.call_async(method, args)
-            try:
-                result = fut.result(timeout=self.cfg.call_timeout)
-                chaos.note_ok("rpc.call", method)
-                return result
-            except FuturesTimeoutError:
-                conn.forget(fut)
-                last_err = RPCError(
-                    f"call {method!r} timed out after {self.cfg.call_timeout}s"
-                )
-                self._conns._report(last_err)
-            except ShedError:
-                # Typed overload refusal: terminal by contract — every
-                # retry would land back in the same overloaded
-                # admission queue and amplify the overload the shed
-                # exists to relieve. The caller owns the backoff
-                # (retry_after_s rides the exception).
-                raise
-            except Exception as e:  # noqa: BLE001
-                # Both transport errors and remote handler errors retry —
-                # "retries are possibly done on different nodes"
-                # (rpc.go:28-30; retry-until-healthy-handler contract
-                # rpc_test.go:55-77).
-                last_err = e
-                if not isinstance(e, RemoteError):
-                    self._conns._report(e if isinstance(e, RPCError)
-                                        else RPCError(str(e)))
+            # One span per attempt: the traceparent injected by
+            # call_async is THIS span, so the server-side handler span
+            # parents under the attempt that actually carried it.
+            with trace.span("rpc.call", method=method,
+                            node=f"{conn.node.address}:{conn.node.port}",
+                            attempt=attempt) as sp:
+                fut = conn.call_async(method, args)
+                try:
+                    result = fut.result(timeout=self.cfg.call_timeout)
+                    chaos.note_ok("rpc.call", method)
+                    return result
+                except FuturesTimeoutError:
+                    conn.forget(fut)
+                    last_err = RPCError(
+                        f"call {method!r} timed out after "
+                        f"{self.cfg.call_timeout}s"
+                    )
+                    # The failure is absorbed for retry, so the span
+                    # exit never sees it — record it explicitly or the
+                    # flight recorder shows a failed attempt as ok.
+                    sp.set_status("error")
+                    sp.add_event("exception", type="TimeoutError",
+                                 message=str(last_err)[:200])
+                    self._conns._report(last_err)
+                    continue
+                except ShedError:
+                    # Typed overload refusal: terminal by contract —
+                    # every retry would land back in the same
+                    # overloaded admission queue and amplify the
+                    # overload the shed exists to relieve. The caller
+                    # owns the backoff (retry_after_s rides the
+                    # exception).
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # Both transport errors and remote handler errors
+                    # retry — "retries are possibly done on different
+                    # nodes" (rpc.go:28-30; retry-until-healthy-handler
+                    # contract rpc_test.go:55-77).
+                    last_err = e
+                    sp.set_status("error")
+                    sp.add_event("exception", type=type(e).__name__,
+                                 message=str(e)[:200])
+                    if not isinstance(e, RemoteError):
+                        self._conns._report(e if isinstance(e, RPCError)
+                                            else RPCError(str(e)))
         raise last_err if last_err is not None else NoClientAvailableError(
             "no client nodes available"
         )

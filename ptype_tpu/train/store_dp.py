@@ -76,7 +76,19 @@ class StoreDPTrainer:
 
     def step(self, batch: dict) -> dict:
         """One DP step. ``batch`` leaves are (B, S); B splits evenly into
-        n_workers stacked shards (the scatter, coordinator.go:67-73)."""
+        n_workers stacked shards (the scatter, coordinator.go:67-73).
+
+        The whole step runs inside a ``train.step`` region (the
+        metrics.annotate seam): one profiler annotation AND — when the
+        trace plane is armed — one span whose children are the Store
+        push (``store.push_tree/...``) and any coord manifest traffic,
+        so a soak failure shows which step a fault landed in."""
+        from ptype_tpu.metrics import annotate
+
+        with annotate("train.step"):
+            return self._step(batch)
+
+    def _step(self, batch: dict) -> dict:
         B = batch["tokens"].shape[0]
         if B % self.n_workers:
             raise ValueError(

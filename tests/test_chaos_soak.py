@@ -6,6 +6,9 @@ schedule (ptype_tpu.chaos) and must hold the invariants:
 - no wedged threads after teardown;
 - every injected fault appears in the trace paired with a recovery
   event of its class (``chaos.unrecovered() == {}``);
+- fault firings land as ``chaos.fault`` span events on the afflicted
+  request's distributed trace (ISSUE 4: the flight recorder shows
+  WHICH request a fault hit), paired with ``chaos.recovery`` beacons;
 - the final checkpoint restores BIT-EXACT on a survivor mesh (half the
   devices — the resharded-restore path);
 - with a fixed seed, the per-site fault firing sequence is identical
@@ -31,9 +34,28 @@ from unittest import mock
 import numpy as np
 import pytest
 
-from ptype_tpu import chaos
+from ptype_tpu import chaos, trace
 from ptype_tpu.chaos import FaultPlan, FaultSpec
 from ptype_tpu.errors import ClusterError, CoordinationError
+
+
+def _span_chaos_events(rec):
+    """(kind, site) pairs from chaos span events in a flight recorder,
+    in record order."""
+    out = []
+    for sp in rec.spans():
+        for ev in sp.events:
+            if ev["name"].startswith("chaos."):
+                out.append((ev["name"].split(".", 1)[1],
+                            ev["attrs"]["site"]))
+    return out
+
+
+#: Sites whose chaos.hit runs on a request thread INSIDE a span
+#: (client retry loop, train.step annotate) — their firings must all
+#: land as span events. Other sites fire on reader/probe threads or
+#: un-spanned drain calls and are legitimately span-less.
+SPAN_VISIBLE_SITES = {"rpc.send", "store.push"}
 
 STEPS = 24
 SAVE_EVERY = 6
@@ -107,6 +129,7 @@ def run_soak(seed: int, root) -> list[tuple]:
     from ptype_tpu.train.store_dp import StoreDPTrainer
 
     plan = FaultPlan.random(seed, SOAK_MENU, n_faults=8)
+    rec = trace.enable(f"soak-{seed}", capacity=16384)
     baseline_threads = threading.active_count()
     ckpt_dir = os.path.join(str(root), f"ckpt-{seed}-{time.monotonic_ns()}")
 
@@ -178,6 +201,27 @@ def run_soak(seed: int, root) -> list[tuple]:
             assert chaos.unrecovered() == {}, (
                 f"unpaired faults {chaos.unrecovered()}: {plan.trace()}")
 
+            # ---- ISSUE 4: fault firings appear as span events on the
+            # afflicted request's trace. Every firing at a span-visible
+            # site (client retry loop, train.step) must be on a span,
+            # and each such class must show a paired recovery beacon
+            # somewhere in the flight recorder.
+            span_events = _span_chaos_events(rec)
+            for site in SPAN_VISIBLE_SITES:
+                n_fired = sum(1 for s, _, _ in fired if s == site)
+                n_span = sum(1 for kind, s in span_events
+                             if kind == "fault" and s == site)
+                assert n_span == n_fired, (
+                    f"{site}: {n_fired} fired but {n_span} span "
+                    f"events; {span_events}")
+                if n_fired:
+                    cls = site.split(".", 1)[0]
+                    assert any(kind == "recovery"
+                               and s.startswith(cls)
+                               for kind, s in span_events), (
+                        f"no recovery beacon on any span for {cls}: "
+                        f"{span_events}")
+
             # ---- bit-exact restore on the SURVIVOR mesh (half the
             # devices): reshard-on-restore must reproduce the trained
             # params exactly.
@@ -200,6 +244,7 @@ def run_soak(seed: int, root) -> list[tuple]:
             raise
         finally:
             chaos.disarm()
+            trace.disable()
             if client is not None:
                 client.close()
             for r in regs:
@@ -378,7 +423,10 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
     - serving continues after the replica death (the pool evicts the
       corpse and routes around it);
     - every injected fault drains to a paired recovery
-      (``chaos.unrecovered() == {}``).
+      (``chaos.unrecovered() == {}``);
+    - gateway-path fault firings (admit sheds, route vetoes, dropped
+      sends) land as chaos.fault span events on the afflicted
+      request's gateway.request trace (ISSUE 4).
     """
     from unittest import mock
 
@@ -411,6 +459,7 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
     state = CoordState(sweep_interval=0.1)
     registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
     prompt = np.zeros((1, 4), np.int32)
+    rec = trace.enable("gateway-soak", capacity=16384)
     plan = chaos.arm(FaultPlan([
         FaultSpec("gateway.route", "drop", after=3, times=2),
         FaultSpec("gateway.admit", "shed", after=9, times=2),
@@ -491,11 +540,35 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
             assert plan.fired(), "the plan never fired a single fault"
             assert chaos.unrecovered() == {}, (
                 f"unpaired: {chaos.unrecovered()}: {plan.trace()}")
+
+            # ISSUE 4: request-thread fault firings ride request
+            # traces. Admit sheds land on gateway.admit spans, route
+            # vetoes on gateway.route, dropped sends on the dispatch
+            # rpc.call — each inside a gateway.request trace; probe
+            # faults fire on the probe thread (span-less by design).
+            fired_sites = {e.site for e in plan.fired()}
+            span_faults = {}
+            for sp in rec.spans():
+                for ev in sp.events:
+                    if ev["name"] == "chaos.fault":
+                        span_faults.setdefault(
+                            ev["attrs"]["site"], []).append(sp)
+            for site, span_name in (("gateway.admit", "gateway.admit"),
+                                    ("gateway.route", "gateway.route"),
+                                    ("rpc.send", "rpc.call")):
+                if site not in fired_sites:
+                    continue
+                hits = span_faults.get(site, [])
+                assert hits, f"{site} fired but left no span event"
+                assert all(s.name == span_name for s in hits), (
+                    site, [s.name for s in hits])
+                assert all(s.trace_id for s in hits)
         except BaseException:
             print(f"\nGATEWAY CHAOS SOAK FAILED; plan: {plan.to_json()}")
             raise
         finally:
             chaos.disarm()
+            trace.disable()
             if gw is not None:
                 gw.close()
             for r in regs:

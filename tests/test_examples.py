@@ -142,3 +142,32 @@ def test_serving_fleet_walkthrough():
         assert "scale hint" in out
     finally:
         proc.kill()
+
+
+def test_observability_demo(tmp_path):
+    """`make obs-demo` (examples/observability/demo.py): a traced
+    fleet serves requests (one under a chaos fault), the cluster
+    telemetry snapshot is pulled over actor RPC, and the stitched
+    Chrome trace parses with the request chain + chaos events."""
+    import json
+
+    env = dict(_env(), OBS_DIR=str(tmp_path))
+    proc = subprocess.Popen(
+        [sys.executable, str(EXAMPLES / "observability" / "demo.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        lines = _wait_output(proc, "chrome trace:", 240)
+        out = "".join(lines)
+        assert "spans with chaos events" in out
+    finally:
+        proc.kill()
+    chrome = json.load(open(tmp_path / "trace.json"))
+    names = {e["name"] for e in chrome["traceEvents"] if e["ph"] == "X"}
+    assert {"gateway.request", "gateway.admit", "gateway.route",
+            "rpc.call", "actor/Work.Do"} <= names
+    # The chaos fault landed in the export as an instant event.
+    assert any(e["ph"] == "i" and e["name"] == "chaos.fault"
+               for e in chrome["traceEvents"])
+    spans = [json.loads(x) for x in open(tmp_path / "spans.jsonl")]
+    assert any(s["name"] == "gateway.request" for s in spans)

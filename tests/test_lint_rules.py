@@ -191,3 +191,52 @@ def test_ptype_tpu_package_is_pt003_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt003 = [f for f in findings if "PT003" in f]
     assert not pt003, pt003
+
+
+PT004_PRINT = (
+    "def f(x):\n"
+    "    print('debugging', x)\n"
+    "    return x\n"
+)
+
+
+def test_pt004_flags_bare_print_in_package(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/noisy.py", PT004_PRINT)
+    assert any("PT004" in f for f in findings), findings
+
+
+def test_pt004_exempts_the_operator_cli(tmp_path):
+    # __main__.py's stdout IS its contract (JSON records, usage).
+    findings = _check(tmp_path, "ptype_tpu/__main__.py", PT004_PRINT)
+    assert not any("PT004" in f for f in findings), findings
+
+
+def test_pt004_silent_outside_package(tmp_path):
+    # Tests / examples / bench print deliberately.
+    findings = _check(tmp_path, "examples/demo.py", PT004_PRINT)
+    assert not any("PT004" in f for f in findings), findings
+    findings = _check(tmp_path, "tests/t.py", PT004_PRINT)
+    assert not any("PT004" in f for f in findings), findings
+
+
+def test_pt004_honors_noqa(tmp_path):
+    src = ("def f(x):\n"
+           "    print('one-off diagnostic', x)  # noqa: deliberate\n")
+    findings = _check(tmp_path, "ptype_tpu/sup4.py", src)
+    assert not any("PT004" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt004_clean():
+    """Framework diagnostics ride logs/trace events, never stdout —
+    the rule the package itself must honor (ISSUE 4 satellite)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt004 = [f for f in findings if "PT004" in f]
+    assert not pt004, pt004

@@ -1,4 +1,51 @@
-"""Metrics sinks: the JSONL writer (file-based observability tier)."""
+"""Metrics sinks: the JSONL writer (file-based observability tier),
+and the uniform snapshot/percentile surface (ISSUE 4 satellite)."""
+
+
+def test_timing_percentiles_over_window():
+    from ptype_tpu.metrics import TIMING_WINDOW, Timing
+
+    t = Timing("op")
+    assert t.percentile(50) == 0.0  # empty: defined, not a crash
+    for i in range(1, 101):
+        t.observe(i / 1000.0)
+    assert t.percentile(50) == 0.051  # nearest rank over the window
+    assert t.percentile(100) == 0.1
+    assert t.count == 100 and t.last == 0.1
+    s = t.summary()
+    assert s["p50_s"] == 0.051 and s["p95_s"] < s["p99_s"]
+    # The window is bounded: old observations age out of the tail.
+    for _ in range(TIMING_WINDOW):
+        t.observe(1.0)
+    assert t.percentile(50) == 1.0
+    assert t.count == 100 + TIMING_WINDOW  # totals still lifetime
+
+
+def test_snapshot_uniform_across_families():
+    """Counters/gauges as values, timings/histograms as distribution
+    summaries with p50/p95/p99 — the gateway SLO tail and hot-path
+    timings read the same way in one dump (they used to diverge)."""
+    import json
+
+    from ptype_tpu.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("c").add(3)
+    reg.gauge("g").set(7)
+    for i in range(100):
+        reg.timing("t").observe(i / 100.0)
+        reg.histogram("h").observe(float(i))
+    snap = json.loads(reg.dump_json())
+    assert snap["counters"]["c"] == 3
+    assert snap["gauges"]["g"] == 7
+    for fam, name in (("timings", "t"), ("histograms", "h")):
+        s = snap[fam][name]
+        assert s["count"] == 100
+        for k in ("p50", "p95", "p99"):
+            suffix = "_s" if fam == "timings" else ""
+            assert s[f"{k}{suffix}"] >= 0.0
+    assert snap["timings"]["t"]["p99_s"] >= snap["timings"]["t"]["p50_s"]
+
 
 def test_metrics_writer_jsonl(tmp_path):
     import json

@@ -1,0 +1,617 @@
+"""Distributed tracing plane (ISSUE 4): span model, wire propagation
+over real sockets, chaos event correlation, the flight recorder, the
+cluster telemetry pull plane, and the zero-cost disabled path.
+
+The headline test is the acceptance shape: ONE request through a
+GatewayActor over real TCP produces a single stitched trace
+(client rpc.call → actor/Gateway.Generate → gateway.request →
+admit → route → dispatch rpc.call → replica actor handler) in the
+Chrome trace-event export.
+"""
+
+import json
+import logging
+import threading
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from ptype_tpu import chaos, telemetry, trace
+from ptype_tpu.chaos import FaultPlan, FaultSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Every test starts and ends untraced/unarmed."""
+    trace.disable()
+    chaos.disarm()
+    yield
+    chaos.disarm()
+    trace.disable()
+
+
+# ------------------------------------------------------------ span model
+
+
+def test_span_nesting_parent_links_and_events():
+    rec = trace.enable("t")
+    with trace.span("outer", kind="test") as outer:
+        with trace.span("inner") as inner:
+            trace.add_event("hello", n=1)
+            assert trace.current() is inner
+        assert trace.current() is outer
+    assert trace.current() is None
+    spans = {s.name: s for s in rec.spans()}
+    assert spans["inner"].trace_id == spans["outer"].trace_id
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].attrs["kind"] == "test"
+    assert spans["inner"].events[0]["name"] == "hello"
+    assert spans["inner"].dur_s <= spans["outer"].dur_s
+
+
+def test_span_error_status_and_exception_event():
+    rec = trace.enable("t")
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("bad")
+    (sp,) = rec.spans()
+    assert sp.status == "error"
+    assert sp.events[0]["name"] == "exception"
+    assert sp.events[0]["attrs"]["type"] == "ValueError"
+
+
+def test_shed_is_typed_status_not_error():
+    from ptype_tpu.errors import ShedError
+
+    rec = trace.enable("t")
+    with pytest.raises(ShedError):
+        with trace.span("req"):
+            raise ShedError("overload", retry_after_s=0.5)
+    assert rec.spans()[0].status == "shed"
+
+
+def test_traceparent_roundtrip_and_malformed():
+    trace.enable("t")
+    assert trace.traceparent() is None  # no active span
+    with trace.span("a") as sp:
+        tp = trace.traceparent()
+        assert trace.parse_traceparent(tp) == (sp.trace_id, sp.span_id)
+    for bad in (None, "", "junk", "00-short-ids-01", 42,
+                "00-" + "x" * 32 + "-" + "y" * 16 + "-01"):
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_span_from_adopts_remote_parent():
+    rec = trace.enable("t")
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with trace.span_from(tp, "server") as sp:
+        assert sp.trace_id == "ab" * 16
+        assert sp.parent_id == "cd" * 8
+    assert rec.spans()[0].trace_id == "ab" * 16
+
+
+def test_disabled_path_allocates_no_spans(monkeypatch):
+    """The zero-cost contract: with no recorder armed the span entry
+    points return one module singleton and never construct a Span."""
+    constructed = []
+    real_init = trace.Span.__init__
+
+    def counting_init(self, *a, **kw):
+        constructed.append(self)
+        real_init(self, *a, **kw)
+
+    monkeypatch.setattr(trace.Span, "__init__", counting_init)
+    assert trace.span("x") is trace.span("y")
+    assert trace.span("x") is trace._NOOP
+    assert trace.span_from("00-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                           "z") is trace._NOOP
+    assert trace.attach("00-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+        is trace._NOOP
+    with trace.span("x") as sp:
+        sp.set_attr("k", 1)
+        sp.add_event("e")
+    trace.add_event("e2")
+    assert trace.current() is None
+    assert trace.traceparent() is None
+    assert constructed == []
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_recorder_ring_bound_and_dump(tmp_path):
+    rec = trace.enable("t", capacity=8)
+    for i in range(20):
+        with trace.span(f"s{i}"):
+            pass
+    assert len(rec.spans()) == 8
+    assert rec.finished == 20
+    assert [s.name for s in rec.spans()] == [f"s{i}" for i in range(12, 20)]
+    path = str(tmp_path / "flight.jsonl")
+    assert rec.dump_jsonl(path) == 8
+    lines = [json.loads(x) for x in open(path)]
+    assert [d["name"] for d in lines] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_maybe_dump_on_error_rate_limited(tmp_path, monkeypatch):
+    monkeypatch.setattr(trace, "_dump_last", 0.0)
+    trace.enable("t", dump_dir=str(tmp_path))
+    with trace.span("s"):
+        pass
+    p1 = trace.maybe_dump("first")
+    assert p1 is not None and json.loads(open(p1).readline())["name"] == "s"
+    assert trace.maybe_dump("second") is None  # inside the interval
+
+
+def test_maybe_dump_noop_without_dir(monkeypatch):
+    monkeypatch.setattr(trace, "_dump_last", 0.0)
+    monkeypatch.delenv(trace.DUMP_ENV, raising=False)
+    trace.enable("t")
+    assert trace.maybe_dump("x") is None
+
+
+# ------------------------------------------------- logs auto-correlation
+
+
+def test_logs_attach_trace_ids_inside_span():
+    from ptype_tpu import logs
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    log = logs.get_logger("trace-test")
+    h = _Capture()
+    logging.getLogger("ptype_tpu").addHandler(h)
+    try:
+        trace.enable("t")
+        log.info("outside")
+        with trace.span("op") as sp:
+            log.info("inside", kv={"x": 1})
+        trace.disable()
+        log.info("after")
+    finally:
+        logging.getLogger("ptype_tpu").removeHandler(h)
+    outside, inside, after = records
+    assert not (outside.kv or {}).get("trace_id")
+    assert inside.kv["trace_id"] == sp.trace_id
+    assert inside.kv["span_id"] == sp.span_id
+    assert inside.kv["x"] == 1  # caller fields preserved
+    assert not (after.kv or {}).get("trace_id")
+
+
+# --------------------------------------------------- chaos correlation
+
+
+def test_chaos_fault_and_recovery_land_on_spans():
+    chaos.arm(FaultPlan([FaultSpec("rpc.send", "drop", times=1)]))
+    rec = trace.enable("t")
+    with trace.span("attempt-1"):
+        f = chaos.hit("rpc.send", "X.Y")
+        assert f is not None and f.action == "drop"
+    with trace.span("attempt-2"):
+        assert chaos.hit("rpc.send", "X.Y") is None  # spent
+        chaos.note_ok("rpc.call", "X.Y")
+    s1, s2 = rec.spans()
+    assert s1.events[0]["name"] == "chaos.fault"
+    assert s1.events[0]["attrs"] == {
+        "site": "rpc.send", "action": "drop", "key": "X.Y"}
+    assert s2.events[0]["name"] == "chaos.recovery"
+    assert chaos.unrecovered() == {}
+
+
+def test_chaos_observer_cleared_on_disable():
+    chaos.arm(FaultPlan([FaultSpec("rpc.send", "drop", times=1)]))
+    trace.enable("t")
+    trace.disable()
+    assert chaos._observer is None
+    assert chaos.hit("rpc.send") is not None  # chaos itself still works
+
+
+# ------------------------------------------- metrics.annotate seam
+
+
+def test_annotate_opens_span_only_when_enabled():
+    from ptype_tpu import metrics as metrics_mod
+
+    with metrics_mod.annotate("region"):
+        assert trace.current() is None  # disabled: no span
+    rec = trace.enable("t")
+    with metrics_mod.annotate("region"):
+        sp = trace.current()
+        assert sp is not None and sp.name == "region"
+    assert [s.name for s in rec.spans()] == ["region"]
+
+
+# ------------------------------------- wire propagation (real sockets)
+
+
+class _Gen:
+    """Serving replica stand-in (numpy, no jax compile cost)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def Generate(self, prompt, max_new=8, *a):
+        self.calls += 1
+        return np.full((np.asarray(prompt).shape[0], int(max_new)), 7,
+                       np.int32)
+
+    def Info(self):
+        return {"in_flight": 0, "queue_depth": 0, "calls": self.calls}
+
+
+def _registry():
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.registry import CoordRegistry
+
+    state = CoordState(sweep_interval=0.1)
+    return state, CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+
+
+def test_rpc_propagation_over_real_socket():
+    """Client span context crosses a real TCP actor call: the server
+    handler span joins the caller's trace with correct parenting."""
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    class _Echo:
+        def Echo(self, x):
+            return x
+
+    state, registry = _registry()
+    rec = trace.enable("t")
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        server = ActorServer("127.0.0.1", 0)
+        server.register(_Echo(), "Echo")
+        server.serve()
+        reg = registry.register("echo", "e0", "127.0.0.1", server.port)
+        client = Client("test", "echo", registry,
+                        ConnConfig(initial_node_timeout=10.0))
+        try:
+            with trace.span("request") as root:
+                assert client.call("Echo.Echo", 42) == 42
+        finally:
+            client.close()
+            reg.close()
+            server.close()
+            state.close()
+    spans = {s.name: s for s in rec.spans()}
+    assert spans["actor/Echo.Echo"].trace_id == root.trace_id
+    assert spans["rpc.call"].parent_id == root.span_id
+    # The handler span parents under the EXACT attempt that carried it.
+    assert spans["actor/Echo.Echo"].parent_id == spans["rpc.call"].span_id
+
+
+def test_local_fast_path_propagates_context():
+    """The zero-copy same-process dispatch stitches like the wire path
+    (contextvars are copied into the dispatch thread)."""
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    class _Echo:
+        def Echo(self, x):
+            return x
+
+    state, registry = _registry()
+    rec = trace.enable("t")
+    server = ActorServer("127.0.0.1", 0)
+    server.register(_Echo(), "Echo")
+    server.serve()
+    reg = registry.register("echo", "e0", "127.0.0.1", server.port)
+    client = Client("test", "echo", registry,
+                    ConnConfig(initial_node_timeout=10.0))
+    try:
+        with trace.span("request") as root:
+            assert client.call("Echo.Echo", 1) == 1
+    finally:
+        client.close()
+        reg.close()
+        server.close()
+        state.close()
+    spans = {s.name: s for s in rec.spans()}
+    assert spans["actor/Echo.Echo"].trace_id == root.trace_id
+
+
+def test_coord_wire_propagation():
+    """Coordinator ops carry the caller's trace context over the coord
+    wire: the server-side op span joins the trace."""
+    from ptype_tpu.coord.remote import RemoteCoord
+    from ptype_tpu.coord.service import CoordServer
+
+    server = CoordServer("127.0.0.1:0")
+    coord = RemoteCoord([server.address])
+    rec = trace.enable("t")
+    try:
+        with trace.span("op") as root:
+            coord.put("k", "v")
+        deadline = time.monotonic() + 5
+        while (not any(s.name == "coord.put" for s in rec.spans())
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        coord.close()
+        server.close()
+    coord_spans = [s for s in rec.spans() if s.name == "coord.put"]
+    assert coord_spans, [s.name for s in rec.spans()]
+    assert coord_spans[0].trace_id == root.trace_id
+    # Untraced ops (keepalives etc.) must not mint root traces: every
+    # recorded span belongs to the op's trace.
+    assert {s.trace_id for s in rec.spans()} == {root.trace_id}
+
+
+# ------------------------- the acceptance trace: gateway over real TCP
+
+
+def test_single_stitched_trace_through_gateway_actor_over_tcp():
+    """ISSUE 4 acceptance: one request through a GatewayActor over real
+    TCP sockets produces a single stitched trace — client rpc.call →
+    actor/Gateway.Generate → gateway.request → gateway.admit →
+    gateway.route → dispatch rpc.call → actor/Generator.Generate — and
+    the Chrome trace-event export carries it."""
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.gateway import (GatewayActor, GatewayConfig,
+                                   InferenceGateway)
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    state, registry = _registry()
+    rec = trace.enable("t")
+    servers, regs = [], []
+    gw = client = None
+    prompt = np.zeros((1, 4), np.int32)
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        try:
+            for i in range(2):
+                s = ActorServer("127.0.0.1", 0)
+                s.register(_Gen(), "Generator")
+                s.serve()
+                servers.append(s)
+                regs.append(registry.register("llm-t", f"r{i}",
+                                              "127.0.0.1", s.port))
+            gw = InferenceGateway(
+                registry, "llm-t",
+                GatewayConfig(probe_interval_s=0.2,
+                              default_deadline_s=15.0))
+            deadline = time.monotonic() + 10
+            while (gw.pool.n_healthy() < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert gw.pool.n_healthy() == 2
+            gws = ActorServer("127.0.0.1", 0)
+            gws.register(GatewayActor(gw), "Gateway")
+            gws.serve()
+            servers.append(gws)
+            regs.append(registry.register("llm-gw", "gw0", "127.0.0.1",
+                                          gws.port))
+            client = Client("test", "llm-gw", registry,
+                            ConnConfig(initial_node_timeout=10.0))
+            out = client.call("Gateway.Generate", prompt, 8)
+            assert np.asarray(out).shape == (1, 8)
+        finally:
+            if client is not None:
+                client.close()
+            if gw is not None:
+                gw.close()
+            for r in regs:
+                r.close()
+            for s in servers:
+                s.close()
+            state.close()
+
+    # One connected trace: every hop shares the client root's trace_id.
+    roots = [s for s in rec.spans()
+             if s.name == "rpc.call" and s.parent_id is None]
+    assert len(roots) == 1, [(s.name, s.parent_id) for s in rec.spans()]
+    tid = roots[0].trace_id
+    chain = {s.name: s for s in rec.spans(trace_id=tid)}
+    for name in ("rpc.call", "actor/Gateway.Generate", "gateway.request",
+                 "gateway.admit", "gateway.route",
+                 "actor/Generator.Generate"):
+        assert name in chain, (name, sorted(chain))
+    # Parent links: admit/route under request; request under the
+    # GatewayActor handler; handler under the client call; the replica
+    # handler under the gateway's dispatch rpc.call.
+    assert chain["gateway.admit"].parent_id == \
+        chain["gateway.request"].span_id
+    assert chain["gateway.route"].parent_id == \
+        chain["gateway.request"].span_id
+    assert chain["gateway.request"].parent_id == \
+        chain["actor/Gateway.Generate"].span_id
+    assert chain["actor/Gateway.Generate"].parent_id == \
+        roots[0].span_id
+    dispatch = [s for s in rec.spans(trace_id=tid)
+                if s.name == "rpc.call"
+                and s.parent_id == chain["gateway.request"].span_id]
+    assert len(dispatch) == 1
+    assert chain["actor/Generator.Generate"].parent_id == \
+        dispatch[0].span_id
+
+    # And the Chrome trace-event export carries the stitched request.
+    chrome = telemetry.chrome_trace(rec.to_dicts())
+    evs = [e for e in chrome["traceEvents"]
+           if e["ph"] == "X" and e["args"].get("trace_id") == tid]
+    names = {e["name"] for e in evs}
+    assert {"rpc.call", "actor/Gateway.Generate", "gateway.request",
+            "gateway.admit", "gateway.route",
+            "actor/Generator.Generate"} <= names
+    by_id = {e["args"]["span_id"]: e for e in evs}
+    # Parent links survive the export (that's what lets Perfetto/
+    # post-processing rebuild the tree).
+    for e in evs:
+        pid = e["args"].get("parent_id")
+        assert pid is None or pid in by_id or pid == roots[0].parent_id
+
+
+def test_chaos_fault_rides_the_request_trace_through_retry():
+    """A dropped send lands as a chaos.fault event on the afflicted
+    attempt's span; the retry that succeeds carries the paired
+    chaos.recovery beacon — same trace."""
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.rpc import Client, ConnConfig
+
+    class _Echo:
+        def Echo(self, x):
+            return x
+
+    state, registry = _registry()
+    rec = trace.enable("t")
+    chaos.arm(FaultPlan([FaultSpec("rpc.send", "drop",
+                                   match="Echo.Echo", times=1)]))
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        server = ActorServer("127.0.0.1", 0)
+        server.register(_Echo(), "Echo")
+        server.serve()
+        reg = registry.register("echo", "e0", "127.0.0.1", server.port)
+        client = Client("test", "echo", registry,
+                        ConnConfig(retries=3, retry_backoff_base=0.01,
+                                   retry_backoff_cap=0.05,
+                                   initial_node_timeout=10.0))
+        try:
+            with trace.span("request") as root:
+                assert client.call("Echo.Echo", "x") == "x"
+        finally:
+            client.close()
+            reg.close()
+            server.close()
+            state.close()
+    spans = rec.spans(trace_id=root.trace_id)
+    faults = [(s.name, e) for s in spans for e in s.events
+              if e["name"] == "chaos.fault"]
+    recoveries = [(s.name, e) for s in spans for e in s.events
+                  if e["name"] == "chaos.recovery"]
+    assert len(faults) == 1 and faults[0][0] == "rpc.call"
+    assert faults[0][1]["attrs"]["site"] == "rpc.send"
+    assert len(recoveries) == 1 and recoveries[0][0] == "rpc.call"
+    assert chaos.unrecovered() == {}
+
+
+# ------------------------------------------------ telemetry pull plane
+
+
+def test_telemetry_endpoint_and_cluster_snapshot():
+    """Every ActorServer answers ptype.Telemetry; cluster_snapshot
+    walks the registry, tolerates dead nodes, and stitches traces."""
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.registry import Node
+
+    class _Echo:
+        def Echo(self, x):
+            return x
+
+    state, registry = _registry()
+    rec = trace.enable("snap-test")
+    with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
+        server = ActorServer("127.0.0.1", 0)
+        server.register(_Echo(), "Echo")
+        server.serve()
+        reg = registry.register("echo", "e0", "127.0.0.1", server.port)
+        # A registered corpse: the walk must report it, not die on it.
+        dead = registry.register("echo", "dead", "127.0.0.1", 1)
+        with trace.span("snap-span"):
+            pass
+        try:
+            t = telemetry.node_telemetry(
+                Node("127.0.0.1", server.port))
+            assert t["tracing"] and t["service"] == "snap-test"
+            assert "counters" in t["metrics"]
+            assert any(s["name"] == "snap-span" for s in t["spans"])
+            snap = telemetry.cluster_snapshot(registry, timeout=2.0)
+        finally:
+            reg.close()
+            dead.close()
+            server.close()
+            state.close()
+    assert f"echo/127.0.0.1:{server.port}" in snap["nodes"]
+    assert any("dead" not in k for k in snap["nodes"])
+    assert "echo/127.0.0.1:1" in snap["errors"]
+    assert "local" in snap["nodes"]
+    # Shared-process dedup: the server node and "local" are the same
+    # recorder; each span appears once in the stitched traces.
+    all_ids = [s["span_id"] for s in telemetry.all_spans(snap)]
+    assert len(all_ids) == len(set(all_ids))
+    assert any(any(s["name"] == "snap-span" for s in spans)
+               for spans in snap["traces"].values())
+    assert rec.finished >= 1
+
+
+def test_exporters_write_files(tmp_path):
+    rec = trace.enable("t")
+    with trace.span("a"):
+        with trace.span("b"):
+            trace.add_event("ev")
+    spans = rec.to_dicts()
+    p1 = telemetry.write_chrome_trace(str(tmp_path / "trace.json"), spans)
+    chrome = json.load(open(p1))
+    assert {e["name"] for e in chrome["traceEvents"]
+            if e["ph"] == "X"} == {"a", "b"}
+    assert any(e["ph"] == "i" and e["name"] == "ev"
+               for e in chrome["traceEvents"])
+    p2 = telemetry.write_spans_jsonl(str(tmp_path / "spans.jsonl"), spans)
+    lines = [json.loads(x) for x in open(p2)]
+    assert {d["name"] for d in lines} == {"a", "b"}
+    summary = telemetry.render_summary(
+        {"ts": 0, "nodes": {"local": {"pid": 1, "tracing": True,
+                                      "spans": spans, "metrics": {}}},
+         "errors": {}, "traces": telemetry.stitch_traces(spans)})
+    assert "traces: 1" in summary
+
+
+def test_gateway_shed_marks_span_status():
+    """A shed request's gateway.request span carries status=shed (and
+    the typed refusal still reaches the caller)."""
+    from ptype_tpu.errors import ShedError
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+
+    state, registry = _registry()
+    rec = trace.enable("t")
+    from ptype_tpu.actor import ActorServer
+
+    server = ActorServer("127.0.0.1", 0)
+    server.register(_Gen(), "Generator")
+    server.serve()
+    reg = registry.register("llm-s", "r0", "127.0.0.1", server.port)
+    gw = None
+    try:
+        gw = InferenceGateway(registry, "llm-s",
+                              GatewayConfig(probe_interval_s=0.2))
+        deadline = time.monotonic() + 10
+        while gw.pool.n_healthy() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        chaos.arm(FaultPlan([FaultSpec("gateway.admit", "shed",
+                                       times=1)]))
+        with pytest.raises(ShedError):
+            gw.call("Generator.Generate", np.zeros((1, 2), np.int32), 4)
+    finally:
+        chaos.disarm()
+        if gw is not None:
+            gw.close()
+        reg.close()
+        server.close()
+        state.close()
+    req = [s for s in rec.spans() if s.name == "gateway.request"]
+    assert req and req[-1].status == "shed"
+    admits = [s for s in rec.spans() if s.name == "gateway.admit"]
+    assert any(e["name"] == "chaos.fault" for s in admits
+               for e in s.events)
+
+
+def test_threads_do_not_leak_span_context():
+    """A thread spawned inside a span starts clean — span context is
+    per-thread, never ambient process state."""
+    trace.enable("t")
+    seen = []
+    with trace.span("parent"):
+        t = threading.Thread(target=lambda: seen.append(trace.current()))
+        t.start()
+        t.join()
+    assert seen == [None]

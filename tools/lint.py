@@ -28,6 +28,10 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   direct balanced client to the generation service bypasses the
   gateway's admission control, shedding, and load-aware routing
   (gateway.InferenceGateway / GatewayActor is the frontdoor)
+- PT004 (ptype_tpu/ except __main__.py): a bare ``print(`` — framework
+  diagnostics must ride the structured logs (trace-correlated via
+  logs.KVLogger) or trace events, never stdout; __main__.py is the
+  operator CLI whose stdout IS its contract
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -272,6 +276,30 @@ class _GatewayBypassCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _BarePrintCheck(ast.NodeVisitor):
+    """PT004: ``print(`` anywhere in ptype_tpu/ except __main__.py.
+
+    A print is invisible to every observability tier this repo has —
+    no level, no kv fields, no trace_id correlation, no capture in the
+    KV formatter — so framework diagnostics must go through
+    ``logs.get_logger`` (which auto-attaches the active span's
+    trace_id/span_id) or trace span events. The operator CLI
+    (__main__.py) is exempt: its stdout is machine-read output, not
+    diagnostics."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT004 bare print() in "
+                f"framework code; use logs.get_logger (trace-correlated "
+                f"kv logging) or a trace span event")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -326,6 +354,9 @@ def check_file(path: str, findings: list[str]) -> None:
     if "ptype_tpu" in parts and "gateway" not in parts:
         # The gateway package is the one sanctioned frontdoor.
         _GatewayBypassCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and os.path.basename(path) != "__main__.py":
+        # __main__.py is the operator CLI: stdout IS its contract.
+        _BarePrintCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
