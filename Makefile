@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos obs-demo
+.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -38,6 +38,14 @@ chaos:
 # stitched Chrome trace (Perfetto-loadable) is written.
 obs-demo:
 	JAX_PLATFORMS=cpu python examples/observability/demo.py
+
+# Cluster health plane walkthrough (docs/OBSERVABILITY.md "Health
+# plane & alerting"): a simulated 3-worker fleet with per-node goodput
+# ledgers + samplers, a seeded chaos straggler fault on one worker's
+# store.push — the alert engine names the afflicted node from the
+# stitched cluster snapshot and the `obs top` view renders it.
+health-demo:
+	JAX_PLATFORMS=cpu python examples/observability/health_demo.py
 
 # Compile + run the Pallas flash kernel fwd/bwd on an attached TPU —
 # the only tier that sees Mosaic tiling checks (exit 42 = no TPU,

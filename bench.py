@@ -34,6 +34,12 @@ Reliability contract (VERDICT r3 weak #1: three rounds of empty tails):
   measured disabled-hook cost in its note — the trace plane's
   ~zero-cost contract as a number (acceptance: <1% disabled, <5%
   enabled).
+- ``goodput_pct`` / ``step_breakdown`` / ``sampler_overhead_pct``
+  come from the health plane's goodput ledger on the same host-mesh
+  store-DP loop (ptype_tpu.health.bench.measure_health_overhead):
+  live compute/collective/data/stall attribution per step, plus the
+  measured sampler tick cost as a fraction of its cadence (ISSUE 5
+  acceptance: <1% of step time).
 """
 
 from __future__ import annotations
@@ -193,6 +199,10 @@ def worker_main() -> None:
             "bucketed probe did not complete" if n_chips > 1 else None),
         "trace_overhead_pct": None,
         "trace_overhead_note": None,
+        "goodput_pct": None,
+        "step_breakdown": None,
+        "sampler_overhead_pct": None,
+        "health_note": None,
         "final_loss": round(float(out["loss"]), 4),
     }
     # The primary metric is EARNED at this point — print it before the
@@ -349,6 +359,18 @@ def _trace_overhead_hostmesh() -> tuple[dict | None, str]:
         STORE_PROBE_TIMEOUT)
 
 
+def _health_hostmesh() -> tuple[dict | None, str]:
+    """Store-DP step loop with the goodput ledger + sampler armed —
+    fills ``goodput_pct`` / ``step_breakdown`` /
+    ``sampler_overhead_pct`` (ISSUE 5 acceptance: sampler < 1% of
+    step time)."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.health.bench import measure_health_overhead\n"
+        "print(json.dumps(measure_health_overhead()))\n",
+        STORE_PROBE_TIMEOUT)
+
+
 def _patch_store_metric(rec: dict) -> None:
     """Fill the Store metrics from the host-mesh probes — but ONLY when
     the worker left the fields null (the 1-chip case). A multi-chip run
@@ -384,6 +406,22 @@ def _patch_store_metric(rec: dict) -> None:
             f"({probe['spans_per_step']} spans/step, traced "
             f"{probe['traced_step_ms']} ms vs untraced "
             f"{probe['untraced_step_ms']} ms); {note}"
+            if probe else note)
+    if rec.get("goodput_pct") is None:
+        # Health plane on the same host-mesh loop: live goodput +
+        # breakdown, and the sampler cost alongside trace_overhead_pct
+        # (ISSUE 5 acceptance: sampler < 1% of step time).
+        probe, note = _health_hostmesh()
+        rec["goodput_pct"] = probe["goodput_pct"] if probe else None
+        rec["step_breakdown"] = (
+            probe["step_breakdown"] if probe else None)
+        rec["sampler_overhead_pct"] = (
+            probe["sampler_overhead_pct"] if probe else None)
+        rec["health_note"] = (
+            f"sampler tick {probe['sampler_tick_us']}us at "
+            f"{probe['sampler_cadence_s']}s cadence, ledger observer "
+            f"{probe['ledger_observe_us']}us "
+            f"({probe['ledger_overhead_pct']}% of step); {note}"
             if probe else note)
 
 

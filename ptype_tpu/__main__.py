@@ -31,6 +31,13 @@ operations need. Commands:
                actor RPC surface, write a stitched Chrome trace
                ($OBS_DIR/trace.json — load in Perfetto) + spans JSONL,
                and print the summary (docs/OBSERVABILITY.md).
+- ``obs top`` — LIVE cluster health view: re-pull the cluster
+               telemetry every $TOP_INTERVAL (default 2 s), run the
+               health alert rules over the per-node series, and
+               repaint per-node goodput / step breakdown / memory +
+               recent alerts ($TOP_ITERS bounds the refreshes for
+               scripted runs; ^C exits). docs/OPERATIONS.md has the
+               per-alert runbook.
 """
 
 from __future__ import annotations
@@ -278,6 +285,17 @@ def _obs() -> None:
     cfg = config_from_env()
     coord = RemoteCoord([cfg.platform.coordinator_address])
     try:
+        if len(sys.argv) > 2 and sys.argv[2] == "top":
+            from ptype_tpu.health import run_top
+
+            try:
+                run_top(CoordRegistry(coord),
+                        iters=int(os.environ.get("TOP_ITERS", "0")),
+                        interval_s=float(
+                            os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
         snap = tel.cluster_snapshot(CoordRegistry(coord),
                                     include_local=False)
         out_dir = os.environ.get("OBS_DIR", ".")

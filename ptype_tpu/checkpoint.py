@@ -108,10 +108,18 @@ class Checkpointer:
         """Synchronous save; returns the step directory. ``extras`` are
         additional ``{filename: json-text}`` committed WITH the step
         (written before the completion marker). Waits for any pending
-        async save first — one writer at a time per Checkpointer."""
-        self.wait()
-        host = self._snapshot(tree)
-        return self._write(step, host, extras)
+        async save first — one writer at a time per Checkpointer.
+
+        Runs as a ``checkpoint.save/<step>`` region through the
+        metrics.annotate seam — the goodput ledger's checkpoint leg
+        and (when tracing is armed) a span, so a blocking save is
+        attributable instead of reading as stall."""
+        from ptype_tpu.metrics import annotate
+
+        with annotate(f"checkpoint.save/{step}"):
+            self.wait()
+            host = self._snapshot(tree)
+            return self._write(step, host, extras)
 
     def async_save(self, step: int, tree: Any) -> None:
         """Snapshot now (device→host), write in the background. At most
@@ -120,8 +128,14 @@ class Checkpointer:
         (e.g. the multi-controller barrier timeout) re-raises from the
         NEXT ``wait``/``save``/``async_save`` — it must not die silently
         with the daemon thread while training continues uncheckpointed."""
-        self.wait()
-        host = self._snapshot(tree)
+        from ptype_tpu.metrics import annotate
+
+        # Only the BLOCKING leg (drain + device→host snapshot) is the
+        # step's checkpoint cost; the background write overlaps compute
+        # and must not be attributed against it.
+        with annotate(f"checkpoint.snapshot/{step}"):
+            self.wait()
+            host = self._snapshot(tree)
 
         def run():
             try:
@@ -384,13 +398,23 @@ class Checkpointer:
         state from ``jax.eval_shape`` or a live pytree); ``shardings``,
         when given, is a matching pytree of NamedSharding for device
         placement (the resume-into-mesh path).
-        """
+
+        Runs as a ``checkpoint.restore/<step>`` region (annotate seam:
+        goodput ledger checkpoint leg + trace span) — a mid-run
+        restore blocks the loop and must be attributable."""
+        from ptype_tpu.metrics import annotate
+
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise ClusterError(
                     f"no complete checkpoint under {self.directory}"
                 )
+        with annotate(f"checkpoint.restore/{step}"):
+            return self._restore(treedef_like, step, shardings)
+
+    def _restore(self, treedef_like: Any, step: int,
+                 shardings: Any | None) -> Any:
         sdir = self._step_dir(step)
         manifest = _merged_manifest(sdir, step)
 

@@ -471,10 +471,26 @@ class CoordState:
             self._compact()
         elif bump_term:
             self._term += int(bump_term)
+        self._publish_term()
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="coord-lease-sweeper", daemon=True
         )
         self._sweeper.start()
+
+    def _publish_term(self) -> None:
+        """Stamp the term into the ``coord.term`` gauge so the health
+        plane's sampler turns promotions into a series — the
+        coord-flap alert rule counts its increases. Only when metrics
+        is ALREADY loaded: the module imports jax, and a lean
+        coordinator/standby (deliberately jax-free, and on the
+        promotion path latency-critical) must not pay a cold jax
+        import for a gauge no sampler in that process would read."""
+        import sys
+
+        metrics_mod = sys.modules.get("ptype_tpu.metrics")
+        if metrics_mod is None:
+            return
+        metrics_mod.metrics.gauge("coord.term").set(float(self._term))
 
     # ------------------------------------------------------------ WAL
     def _wal_path(self) -> str:

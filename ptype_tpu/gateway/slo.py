@@ -121,6 +121,18 @@ class SLOTracker:
             total = len(self._sheds) + len(self._ok)
             return len(self._sheds) / total if total else 0.0
 
+    def burn_rate(self, budget: float = 0.01) -> float:
+        """Error-budget burn rate: the windowed shed fraction divided
+        by the SLO's allowed bad fraction (default 1% — a 99%
+        answered-SLO). 1.0 spends the budget exactly on schedule; the
+        health plane's ``slo-burn-rate`` rule pages at the classic
+        fast-burn multiple (14.4x) computed the same way from the
+        sampled counter series, so the local and cluster views agree.
+        """
+        if budget <= 0:
+            return 0.0
+        return self.shed_rate() / budget
+
     def tokens_per_sec(self) -> float:
         with self._lock:
             self._trim(time.monotonic())

@@ -1,0 +1,34 @@
+"""Cluster health plane: series, goodput accounting, alerting.
+
+The layer that turns the PR 4 telemetry pull plane into live cluster
+health (ISSUE 5): bounded per-process time series sampled from the
+metrics registry (:mod:`~ptype_tpu.health.series`), a per-step
+goodput ledger + cross-node straggler detection over the
+``metrics.annotate`` seam (:mod:`~ptype_tpu.health.goodput`),
+declarative alert rules with an engine that logs, counts, and
+triggers flight-recorder dumps (:mod:`~ptype_tpu.health.rules`), and
+the live ``obs top`` view (:mod:`~ptype_tpu.health.top`). See
+docs/OBSERVABILITY.md ("Health plane & alerting") and the per-alert
+runbook in docs/OPERATIONS.md.
+"""
+
+from ptype_tpu.health.goodput import (GoodputLedger, detect_stragglers,
+                                      node_series_means, node_span_means)
+from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
+                                    ClusterView, CoordFlapRule, LossRule,
+                                    MemoryGrowthRule, P99Rule, Rule,
+                                    StallRule, StragglerRule,
+                                    default_rules)
+from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
+                                     telemetry_endpoint)
+from ptype_tpu.health.top import render_top, run_top
+
+__all__ = [
+    "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
+    "GoodputLedger", "detect_stragglers", "node_series_means",
+    "node_span_means",
+    "Alert", "AlertEngine", "ClusterView", "Rule", "BurnRateRule",
+    "P99Rule", "StallRule", "StragglerRule", "LossRule",
+    "CoordFlapRule", "MemoryGrowthRule", "default_rules",
+    "render_top", "run_top",
+]

@@ -1,0 +1,97 @@
+"""Health-plane cost probe: goodput numbers + measured overhead.
+
+Backs the ``goodput_pct`` / ``step_breakdown`` /
+``sampler_overhead_pct`` fields in bench.py's tail record: run the
+host-mesh store-DP step loop with the ledger installed on the real
+annotate seam and the sampler ticking, then cost the machinery
+DIRECTLY (same method as ``telemetry.measure_trace_overhead`` — the
+per-call cost measures in microseconds against a step measured in
+tens of milliseconds, so a wall-clock A/B reports scheduler noise,
+not the signal):
+
+- sampler: ``tick cost / cadence`` — the sampler thread spends one
+  tick per cadence window regardless of step rate;
+- ledger: ``observe cost × regions/step / step time`` — the observer
+  fires once per annotate region.
+
+Acceptance bar (ISSUE 5): sampler overhead < 1% of step time.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def measure_health_overhead(steps: int = 12, preset: str = "tiny",
+                            batch: int = 8, seq: int = 32,
+                            cadence_s: float = 0.05) -> dict:
+    import jax
+
+    from ptype_tpu import metrics as metrics_mod
+    from ptype_tpu.health import goodput as goodput_mod
+    from ptype_tpu.health import series as series_mod
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import TensorStore
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer
+
+    n_chips = jax.device_count()
+    mesh = build_mesh({"data": n_chips})
+    cfg = tfm.preset(preset)
+    trainer = StoreDPTrainer(cfg, TensorStore(mesh))
+    stream = synthetic_batches(cfg.vocab_size, batch, seq)
+    trainer.step(next(stream))  # compile outside the measurement
+
+    ledger = goodput_mod.install(
+        tokens_per_step=batch * seq,
+        flops_per_token=tfm.flops_per_token(cfg, seq),
+        n_chips=n_chips)
+    sampler = series_mod.Sampler(cadence_s=cadence_s).start()
+    try:
+        t_loop0 = time.perf_counter()
+        for _ in range(steps):
+            trainer.step(next(stream))
+        loop_s = time.perf_counter() - t_loop0
+        summary = ledger.summary()
+
+        # Regions per step, from the ledger's own breakdown inputs:
+        # every component region + the step region itself fired once
+        # through the observer.
+        step_s = max(loop_s / steps, 1e-9)
+
+        # Direct sampler tick cost over the live registry.
+        n_ticks = 200
+        t0 = time.perf_counter()
+        for _ in range(n_ticks):
+            sampler.sample_once()
+        tick_s = (time.perf_counter() - t0) / n_ticks
+
+        # Direct observer cost (a throwaway ledger so the probe does
+        # not pollute the measured records).
+        probe = goodput_mod.GoodputLedger(
+            registry=metrics_mod.MetricsRegistry())
+        n_obs = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n_obs):
+            probe.observe("store.push_tree/probe", 0.0)
+        obs_s = (time.perf_counter() - t0) / n_obs
+    finally:
+        sampler.close()
+        goodput_mod.uninstall()
+
+    regions_per_step = 3.0  # train.step + train.data + store.push_tree
+    return {
+        "goodput_pct": summary["goodput_pct"],
+        "step_breakdown": summary["step_breakdown"],
+        "tokens_per_sec": summary.get("tokens_per_sec"),
+        "mfu": summary.get("mfu"),
+        "steps": steps,
+        "step_ms": round(step_s * 1e3, 2),
+        "sampler_tick_us": round(tick_s * 1e6, 2),
+        "sampler_cadence_s": cadence_s,
+        "sampler_overhead_pct": round(100.0 * tick_s / cadence_s, 4),
+        "ledger_observe_us": round(obs_s * 1e6, 3),
+        "ledger_overhead_pct": round(
+            100.0 * obs_s * regions_per_step / step_s, 5),
+    }

@@ -1,0 +1,516 @@
+"""Declarative alert rules over cluster health series.
+
+The watchdog half of the health plane: :class:`Rule`\\ s evaluate a
+cluster telemetry snapshot (per-node series + metrics, the shape
+``telemetry.cluster_snapshot`` returns once the sampler is armed) and
+fire typed :class:`Alert`\\ s. The :class:`AlertEngine` runs a rule
+set, de-duplicates within a cooldown, lands every alert in the
+structured log (``logs.KVLogger``), bumps ``health.alerts`` counters,
+and triggers the flight recorder's ``maybe_dump`` — the moment an
+alert fires is exactly when a post-mortem wants the span ring.
+
+Rule catalogue (see docs/OBSERVABILITY.md for the full table and
+docs/OPERATIONS.md for the per-alert runbook):
+
+====================  ====================================================
+rule                  fires when
+====================  ====================================================
+``slo-burn-rate``     gateway shed fraction burns the error budget at
+                      ≥ ``burn_threshold``× (multi-window SRE math)
+``slo-p99``           gateway latency p99 series exceeds the SLO target
+``train-stall``       no step-counter progress within N× median step time
+``straggler``         one node's step/collective mean exceeds
+                      median + k·MAD across the fleet (names the node)
+``loss``              training loss goes non-finite (page) or spikes
+                      over ``spike_factor``× its recent median (warn)
+``coord-flap``        the coordination term bumps more than allowed in a
+                      window (promotion churn — dueling standbys)
+``memory-growth``     a memory watermark grows past ``growth_frac``
+                      within the window above a floor
+====================  ====================================================
+
+Every rule takes the evaluation time from the :class:`ClusterView`
+(injectable) and reads only series/metrics — deterministic unit tests
+feed synthetic snapshots with fabricated timestamps.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ptype_tpu import logs, trace
+from ptype_tpu import metrics as metrics_mod
+from ptype_tpu.health.goodput import (_dedup_aliases, detect_stragglers,
+                                      node_series_means, node_span_means)
+
+log = logs.get_logger("health")
+
+
+@dataclass
+class Alert:
+    """One typed firing: which rule, which node, why."""
+
+    rule: str
+    severity: str  # "page" | "warn"
+    node: str
+    message: str
+    value: float | None = None
+    threshold: float | None = None
+    ts: float = 0.0
+    labels: dict = field(default_factory=dict)
+
+    def key(self) -> tuple[str, str]:
+        return (self.rule, self.node)
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "node": self.node, "message": self.message,
+             "ts": round(self.ts, 3)}
+        if self.value is not None:
+            d["value"] = round(self.value, 4)
+        if self.threshold is not None:
+            d["threshold"] = round(self.threshold, 4)
+        if self.labels:
+            d["labels"] = self.labels
+        return d
+
+
+class ClusterView:
+    """Read helpers over one cluster snapshot — what rules evaluate."""
+
+    def __init__(self, snapshot: dict, now: float | None = None):
+        self.snapshot = snapshot
+        #: Evaluation instant; defaults to the snapshot's own stamp so
+        #: replayed/synthetic snapshots evaluate at their own time.
+        self.now = (now if now is not None
+                    else snapshot.get("ts") or time.time())
+        #: Alias-deduped: several registry service names can alias one
+        #: process; every rule must see it once or (rule, node-key)
+        #: cooldowns can't stop the duplicate alert.
+        self.nodes: dict = dict(_dedup_aliases(snapshot))
+
+    def node_keys(self) -> list[str]:
+        return sorted(self.nodes)
+
+    def series(self, node: str, name: str) -> list:
+        return (self.nodes.get(node, {}).get("series", {})
+                .get(name) or [])
+
+    def last(self, node: str, name: str):
+        pts = self.series(node, name)
+        return pts[-1] if pts else None
+
+    def gauge(self, node: str, name: str):
+        return (self.nodes.get(node, {}).get("metrics", {})
+                .get("gauges", {}).get(name))
+
+    def each_series(self, name: str) -> dict[str, list]:
+        out = {}
+        for key in self.nodes:
+            pts = self.series(key, name)
+            if pts:
+                out[key] = pts
+        return out
+
+
+def counter_delta(points: list, window_s: float, now: float) -> float:
+    """Increase of a cumulative-counter series over the window: last
+    value minus the value at (or just before) the window start.
+    Clamped at 0 — a process restart resets the counter, and a reset
+    must read as 'no traffic', not negative traffic."""
+    if not points:
+        return 0.0
+    base = None
+    for t, v in points:
+        if t <= now - window_s:
+            base = v
+        else:
+            break
+    if base is None:
+        # Whole series inside the window: the first point is the base
+        # (its increase happened at/after the window opened).
+        base = points[0][1]
+    return max(0.0, points[-1][1] - base)
+
+
+class Rule:
+    """Base: a named, severity-tagged predicate over a ClusterView."""
+
+    name = "rule"
+    severity = "warn"
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        raise NotImplementedError
+
+    def _alert(self, node: str, message: str, *, value=None,
+               threshold=None, severity: str | None = None,
+               **labels) -> Alert:
+        return Alert(rule=self.name,
+                     severity=severity or self.severity, node=node,
+                     message=message, value=value, threshold=threshold,
+                     labels=labels)
+
+
+class BurnRateRule(Rule):
+    """Gateway SLO error-budget burn from the shed/request counter
+    series. ``budget`` is the allowed bad fraction (0.01 = 99% of
+    requests answered); the burn rate is ``shed_fraction / budget`` —
+    1.0 spends the budget exactly at period's end, 14.4 (the classic
+    fast-burn page) exhausts a 30-day budget in ~2 days."""
+
+    name = "slo-burn-rate"
+    severity = "page"
+
+    def __init__(self, service: str = "llm", budget: float = 0.01,
+                 burn_threshold: float = 14.4, window_s: float = 60.0,
+                 min_requests: float = 10.0):
+        self.service = service
+        self.budget = float(budget)
+        self.burn_threshold = float(burn_threshold)
+        self.window_s = float(window_s)
+        self.min_requests = float(min_requests)
+
+    def burn_rate(self, shed_pts: list, req_pts: list,
+                  now: float) -> float | None:
+        """The deterministic math: windowed shed/requests fraction over
+        the budget; None below the traffic floor (an empty window must
+        not divide its way into a page)."""
+        req = counter_delta(req_pts, self.window_s, now)
+        if req < self.min_requests or self.budget <= 0:
+            return None
+        shed = counter_delta(shed_pts, self.window_s, now)
+        return (shed / req) / self.budget
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        p = f"gateway.{self.service}"
+        for node in view.node_keys():
+            burn = self.burn_rate(view.series(node, f"{p}.shed"),
+                                  view.series(node, f"{p}.requests"),
+                                  view.now)
+            if burn is not None and burn >= self.burn_threshold:
+                out.append(self._alert(
+                    node,
+                    f"gateway {self.service} shed burn rate "
+                    f"{burn:.1f}x the error budget "
+                    f"(window {self.window_s:.0f}s)",
+                    value=burn, threshold=self.burn_threshold,
+                    service=self.service))
+        return out
+
+
+class P99Rule(Rule):
+    """Gateway latency p99 (histogram series the sampler stamps as
+    ``gateway.<svc>.latency_ms.p99``) over the SLO target."""
+
+    name = "slo-p99"
+    severity = "warn"
+
+    def __init__(self, service: str = "llm",
+                 slo_p99_ms: float = 1000.0):
+        self.service = service
+        self.slo_p99_ms = float(slo_p99_ms)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        name = f"gateway.{self.service}.latency_ms.p99"
+        for node in view.node_keys():
+            last = view.last(node, name)
+            if last is not None and last[1] > self.slo_p99_ms:
+                out.append(self._alert(
+                    node,
+                    f"gateway {self.service} p99 {last[1]:.0f}ms over "
+                    f"SLO {self.slo_p99_ms:.0f}ms",
+                    value=last[1], threshold=self.slo_p99_ms,
+                    service=self.service))
+        return out
+
+
+class StallRule(Rule):
+    """Training stall: the step counter stopped advancing for longer
+    than ``factor``× the node's median step time (with an absolute
+    floor — a 1 ms CPU-smoke step must not page on a 10 ms pause)."""
+
+    name = "train-stall"
+    severity = "page"
+
+    def __init__(self, factor: float = 5.0, min_steps: int = 3,
+                 min_gap_s: float = 5.0,
+                 steps_series: str = "goodput.steps",
+                 step_ms_series: str = "goodput.step_ms"):
+        self.factor = float(factor)
+        self.min_steps = int(min_steps)
+        self.min_gap_s = float(min_gap_s)
+        self.steps_series = steps_series
+        self.step_ms_series = step_ms_series
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = view.series(node, self.steps_series)
+            if not pts or pts[-1][1] < self.min_steps:
+                continue
+            # The sampler appends only on change: the last point IS
+            # the last observed progress.
+            last_progress_t = pts[-1][0]
+            step_vals = [v for _, v in
+                         view.series(node, self.step_ms_series)]
+            med_s = (statistics.median(step_vals) / 1e3
+                     if step_vals else 0.0)
+            threshold = max(self.factor * med_s, self.min_gap_s)
+            gap = view.now - last_progress_t
+            if gap > threshold:
+                out.append(self._alert(
+                    node,
+                    f"no step progress for {gap:.1f}s "
+                    f"(median step {med_s * 1e3:.0f}ms, "
+                    f"threshold {threshold:.1f}s)",
+                    value=gap, threshold=threshold))
+        return out
+
+
+class StragglerRule(Rule):
+    """Cross-node straggler: one node's recent mean of
+    ``metric`` (default per-step wall ms) exceeds the fleet's
+    median + k·MAD (:func:`~ptype_tpu.health.goodput
+    .detect_stragglers`). Falls back to stitched-span durations
+    (``span_prefix``) for fleets running the trace plane without the
+    sampler."""
+
+    name = "straggler"
+    severity = "warn"
+
+    def __init__(self, metric: str = "goodput.step_ms", k: float = 4.0,
+                 min_nodes: int = 3, min_excess_ms: float = 50.0,
+                 min_ratio: float = 1.5,
+                 window_s: float | None = 300.0,
+                 span_prefix: str = "store.push_tree"):
+        # window_s bounded by default: change-driven sampling retains
+        # points indefinitely, and one historic outlier (a warm-up
+        # step, an incident hours ago) must not mark a currently-
+        # healthy node as a straggler forever.
+        self.metric = metric
+        self.k = float(k)
+        self.min_nodes = int(min_nodes)
+        self.min_excess_ms = float(min_excess_ms)
+        self.min_ratio = float(min_ratio)
+        self.window_s = window_s
+        self.span_prefix = span_prefix
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        per_node = node_series_means(view.snapshot, self.metric,
+                                     self.window_s, view.now)
+        source = self.metric
+        if len(per_node) < self.min_nodes:
+            per_node = node_span_means(view.snapshot, self.span_prefix,
+                                       self.window_s, view.now)
+            source = f"span:{self.span_prefix}"
+        hits = detect_stragglers(per_node, k=self.k,
+                                 min_nodes=self.min_nodes,
+                                 min_excess=self.min_excess_ms,
+                                 min_ratio=self.min_ratio)
+        return [self._alert(
+            h["node"],
+            f"straggler: {source} ~{h['value']:.1f}ms vs cluster "
+            f"median {h['median']:.1f}ms "
+            f"(threshold {h['threshold']:.1f}ms)",
+            value=h["value"], threshold=h["threshold"],
+            median=h["median"], metric=source) for h in hits]
+
+
+class LossRule(Rule):
+    """Training loss health from the ``train.loss`` gauge series:
+    non-finite pages immediately; a spike over ``spike_factor``× the
+    recent median warns."""
+
+    name = "loss"
+    severity = "warn"
+
+    def __init__(self, metric: str = "train.loss",
+                 spike_factor: float = 3.0, min_points: int = 4):
+        self.metric = metric
+        self.spike_factor = float(spike_factor)
+        self.min_points = int(min_points)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node, pts in view.each_series(self.metric).items():
+            last = pts[-1][1]
+            if not math.isfinite(last):
+                out.append(self._alert(
+                    node, f"training loss is {last} — run is diverged",
+                    severity="page"))
+                continue
+            if len(pts) < self.min_points:
+                continue
+            prev = [v for _, v in pts[:-1] if math.isfinite(v)]
+            if not prev:
+                continue
+            med = statistics.median(prev)
+            if med > 0 and last > self.spike_factor * med:
+                out.append(self._alert(
+                    node,
+                    f"loss spike {last:.3f} vs recent median "
+                    f"{med:.3f} ({self.spike_factor:.1f}x threshold)",
+                    value=last, threshold=self.spike_factor * med))
+        return out
+
+
+class CoordFlapRule(Rule):
+    """Coordinator flap: the ``coord.term`` gauge bumped more than
+    ``max_increases`` times within the window — promotion churn
+    (dueling standbys, a lease TTL racing its keepalive)."""
+
+    name = "coord-flap"
+    severity = "page"
+
+    def __init__(self, metric: str = "coord.term",
+                 max_increases: int = 1, window_s: float = 300.0):
+        self.metric = metric
+        self.max_increases = int(max_increases)
+        self.window_s = float(window_s)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node, pts in view.each_series(self.metric).items():
+            vals = [v for t, v in pts if t >= view.now - self.window_s]
+            # The point just before the window anchors the base term.
+            older = [v for t, v in pts if t < view.now - self.window_s]
+            if older:
+                vals = [older[-1]] + vals
+            bumps = sum(1 for a, b in zip(vals, vals[1:]) if b > a)
+            if bumps > self.max_increases:
+                out.append(self._alert(
+                    node,
+                    f"coordination term bumped {bumps}x in "
+                    f"{self.window_s:.0f}s — promotion flapping",
+                    value=float(bumps),
+                    threshold=float(self.max_increases)))
+        return out
+
+
+class MemoryGrowthRule(Rule):
+    """Sustained memory growth: a watermark series grew by more than
+    ``growth_frac`` across the window while above ``min_bytes`` —
+    the leak signature, not a transient peak. The window is bounded
+    by default: change-driven sampling retains flat points
+    indefinitely, and hours of legitimate slow growth (compilation
+    caches) compared against an ancient baseline is not a leak."""
+
+    name = "memory-growth"
+    severity = "warn"
+
+    def __init__(self,
+                 metric_names: tuple = ("mem.device_bytes_in_use",
+                                        "mem.rss_bytes"),
+                 growth_frac: float = 0.5,
+                 min_bytes: float = 256 * 1024 * 1024,
+                 window_s: float | None = 600.0):
+        self.metric_names = tuple(metric_names)
+        self.growth_frac = float(growth_frac)
+        self.min_bytes = float(min_bytes)
+        self.window_s = window_s
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            for name in self.metric_names:
+                pts = view.series(node, name)
+                if self.window_s is not None:
+                    pts = [p for p in pts
+                           if p[0] >= view.now - self.window_s]
+                if len(pts) < 2:
+                    continue
+                first, last = pts[0][1], pts[-1][1]
+                threshold = first * (1.0 + self.growth_frac)
+                if last >= self.min_bytes and first > 0 \
+                        and last > threshold:
+                    out.append(self._alert(
+                        node,
+                        f"{name} grew {first / 2**20:.0f}MiB → "
+                        f"{last / 2**20:.0f}MiB "
+                        f"(+{100 * (last - first) / first:.0f}%)",
+                        value=last, threshold=threshold, metric=name))
+                    break  # one memory alert per node per pass
+        return out
+
+
+def default_rules(service: str = "llm",
+                  slo_p99_ms: float | None = None) -> list[Rule]:
+    """The stock watchdog set; ``slo_p99_ms`` adds the latency rule."""
+    rules: list[Rule] = [
+        BurnRateRule(service=service),
+        StallRule(),
+        StragglerRule(),
+        LossRule(),
+        CoordFlapRule(),
+        MemoryGrowthRule(),
+    ]
+    if slo_p99_ms is not None:
+        rules.insert(1, P99Rule(service=service, slo_p99_ms=slo_p99_ms))
+    return rules
+
+
+class AlertEngine:
+    """Run a rule set over snapshots; fire, log, count, and dump.
+
+    ``evaluate`` returns only NEWLY fired alerts — a (rule, node) pair
+    re-firing within ``cooldown_s`` is suppressed, so a polling loop
+    does not page once per poll for one ongoing condition. History
+    stays in :attr:`alerts` (bounded) for the top view.
+    """
+
+    def __init__(self, rules: list[Rule] | None = None,
+                 cooldown_s: float = 30.0, dump: bool = True,
+                 registry: metrics_mod.MetricsRegistry | None = None):
+        self.rules = rules if rules is not None else default_rules()
+        self.cooldown_s = float(cooldown_s)
+        self.dump = dump
+        self.registry = (registry if registry is not None
+                         else metrics_mod.metrics)
+        self.alerts: collections.deque = collections.deque(maxlen=256)
+        self._last_fired: dict[tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+
+    def evaluate(self, snapshot: dict,
+                 now: float | None = None) -> list[Alert]:
+        view = ClusterView(snapshot, now)
+        fired: list[Alert] = []
+        for rule in self.rules:
+            try:
+                found = rule.evaluate(view)
+            except Exception as e:  # noqa: BLE001 — one broken rule
+                # must not kill the watchdog that hosts the others.
+                log.warning("health rule failed",
+                            kv={"rule": rule.name, "err": repr(e)})
+                continue
+            fired.extend(found)
+        kept: list[Alert] = []
+        with self._lock:
+            for alert in fired:
+                if not alert.ts:
+                    alert.ts = view.now
+                last = self._last_fired.get(alert.key())
+                if last is not None and \
+                        view.now - last < self.cooldown_s:
+                    continue
+                self._last_fired[alert.key()] = view.now
+                self.alerts.append(alert)
+                kept.append(alert)
+        for alert in kept:
+            self.registry.counter("health.alerts").add(1)
+            self.registry.counter(f"health.alerts.{alert.rule}").add(1)
+            log.warning("health alert", kv=alert.to_dict())
+            if self.dump:
+                trace.maybe_dump(f"alert:{alert.rule}:{alert.node}")
+        return kept
+
+    def recent(self, limit: int = 16) -> list[Alert]:
+        with self._lock:
+            out = list(self.alerts)
+        return out[-limit:]

@@ -1,0 +1,108 @@
+"""``obs top``: the live cluster health view.
+
+:func:`render_top` turns one cluster snapshot + the alert engine's
+history into the operator one-pager (per-node goodput, step
+breakdown, throughput, memory, and the active alert list);
+:func:`run_top` is the refresh loop behind ``python -m ptype_tpu obs
+top`` — snapshot, evaluate the rules, repaint. Pure string rendering
+here; the CLI owns stdout (PT004: framework code never prints).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from ptype_tpu.health.rules import AlertEngine
+
+#: ANSI clear-screen + home, prefixed per repaint by the live loop.
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "?"
+
+
+def _gauge(telem: dict, name: str):
+    return telem.get("metrics", {}).get("gauges", {}).get(name)
+
+
+def render_top(snapshot: dict, alerts=(), max_nodes: int = 32) -> str:
+    """One repaint: header, per-node health table, alert tail."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+    lines = [
+        f"ptype health @ {snapshot.get('ts')} — {len(nodes)} nodes, "
+        f"{len(errors)} unreachable",
+        f"{'node':<28} {'good%':>6} {'step':>8} {'coll':>8} "
+        f"{'stall':>8} {'tok/s':>9} {'mfu':>7} {'mem':>9} {'loss':>8}",
+    ]
+    for key in sorted(nodes)[:max_nodes]:
+        t = nodes[key]
+        good = _gauge(t, "goodput.pct")
+        step = _gauge(t, "goodput.step_ms")
+        coll = _gauge(t, "goodput.collective_ms")
+        stall = _gauge(t, "goodput.stall_ms")
+        tps = _gauge(t, "goodput.tokens_per_sec")
+        mfu = _gauge(t, "goodput.mfu")
+        mem = (_gauge(t, "mem.device_bytes_in_use")
+               or _gauge(t, "mem.rss_bytes"))
+        loss = _gauge(t, "train.loss")
+
+        def num(v, fmt="{:.1f}", dash="-"):
+            return fmt.format(v) if v is not None else dash
+
+        lines.append(
+            f"{key[:28]:<28} {num(good):>6} {num(step):>7}m "
+            f"{num(coll):>7}m {num(stall):>7}m {num(tps):>9} "
+            f"{num(mfu, '{:.3f}'):>7} {_fmt_bytes(mem):>9} "
+            f"{num(loss, '{:.3f}'):>8}")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
+def run_top(registry, iters: int = 0, interval_s: float = 2.0,
+            engine: AlertEngine | None = None,
+            services: list[str] | None = None,
+            include_local: bool = False, out=None,
+            clear: bool = True) -> AlertEngine:
+    """The ``obs top`` loop: pull, evaluate, repaint. ``iters=0``
+    runs until KeyboardInterrupt (the caller catches it); tests pass
+    ``iters=1`` and a capture ``out``. Returns the engine so callers
+    can inspect the alert history."""
+    from ptype_tpu import telemetry as telemetry_mod
+
+    write = out if out is not None else sys.stdout.write
+    engine = engine if engine is not None else AlertEngine()
+    tick = threading.Event()
+    n = 0
+    while True:
+        snap = telemetry_mod.cluster_snapshot(
+            registry, services=services, include_local=include_local)
+        engine.evaluate(snap)
+        prefix = CLEAR if clear else ""
+        write(prefix + render_top(snap, engine.recent()) + "\n")
+        n += 1
+        if iters and n >= iters:
+            return engine
+        tick.wait(interval_s)

@@ -52,16 +52,57 @@ def mfu(tokens_per_sec: float, flops_per_token: float,
     return tokens_per_sec * flops_per_token / peak
 
 
+#: Samples a Counter keeps for its windowed rate() — filled by the
+#: health sampler's cadence (one sample per tick), sized so a minute
+#: of 1 Hz sampling fits.
+COUNTER_RATE_WINDOW = 64
+
+
 @dataclass
 class Counter:
     name: str
     value: float = 0.0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
+    #: (t, cumulative value) samples behind the windowed rate() — the
+    #: hot-path add() never touches this; the health Sampler (or an
+    #: explicit sample() call) stamps it at its cadence.
+    _samples: collections.deque = field(
+        default_factory=lambda: collections.deque(
+            maxlen=COUNTER_RATE_WINDOW),
+        repr=False, compare=False)
 
     def add(self, delta: float = 1.0) -> None:
         with self._lock:
             self.value += delta
+
+    def sample(self, now: float | None = None) -> None:
+        """Stamp (t, value) into the rate window — called by the health
+        sampler at its cadence (time.monotonic clock)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((now, self.value))
+
+    def rate(self, window_s: float | None = None,
+             now: float | None = None) -> float:
+        """Events/sec over the sampled window (the sampler cadence).
+
+        Computed from the stamped samples only — deterministic under
+        explicit sample(now=...) calls. With a single sample the live
+        value at ``now`` closes the interval; with none, 0.0."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            pts = list(self._samples)
+            cur = self.value
+        if window_s is not None:
+            pts = [p for p in pts if p[0] >= now - window_s]
+        if not pts:
+            return 0.0
+        t0, v0 = pts[0]
+        t1, v1 = pts[-1] if len(pts) > 1 else (now, cur)
+        if t1 <= t0:
+            return 0.0
+        return max(0.0, (v1 - v0) / (t1 - t0))
 
 
 #: Recent observations a Timing keeps for its percentile window —
@@ -206,23 +247,47 @@ class MetricsRegistry:
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
         self._lock = threading.Lock()
+        self._version = 0
+
+    def _family(self, fam: dict, name: str, make):
+        with self._lock:
+            obj = fam.get(name)
+            if obj is None:
+                obj = fam[name] = make()
+                # Version bumps let the health Sampler cache its walk
+                # list and stay allocation-free between new families.
+                self._version += 1
+            return obj
 
     def counter(self, name: str) -> Counter:
-        with self._lock:
-            return self._counters.setdefault(name, Counter(name))
+        return self._family(self._counters, name, lambda: Counter(name))
 
     def timing(self, name: str) -> Timing:
-        with self._lock:
-            return self._timings.setdefault(name, Timing(name))
+        return self._family(self._timings, name, lambda: Timing(name))
 
     def gauge(self, name: str) -> Gauge:
-        with self._lock:
-            return self._gauges.setdefault(name, Gauge(name))
+        return self._family(self._gauges, name, lambda: Gauge(name))
 
     def histogram(self, name: str, window: int = 2048) -> Histogram:
+        return self._family(self._histograms, name,
+                            lambda: Histogram(name, window))
+
+    @property
+    def version(self) -> int:
+        """Bumped once per family creation — the sampler's cheap
+        'did the registry grow since my cached walk list' check."""
         with self._lock:
-            return self._histograms.setdefault(name,
-                                               Histogram(name, window))
+            return self._version
+
+    def families(self) -> tuple:
+        """(version, counters, timings, gauges, histograms) — shallow
+        copies of the live family maps, for consumers (the health
+        sampler) that need values-and-counts without the full summary
+        construction :meth:`snapshot` pays."""
+        with self._lock:
+            return (self._version, dict(self._counters),
+                    dict(self._timings), dict(self._gauges),
+                    dict(self._histograms))
 
     def timed(self, name: str):
         """Context manager recording wall time into a Timing."""
@@ -264,6 +329,68 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+def flatten_snapshot(snap: dict) -> dict:
+    """One flat ``{name: scalar}`` view of a registry snapshot — what
+    :meth:`MetricsWriter.emit` merges so the training scalar log and
+    the health-plane series read the same values: counters and gauges
+    as-is, timings as ``<name>.last_s`` (what the sampler stamps into
+    its series) plus ``<name>.mean_s``, histograms as ``<name>.p99``.
+    """
+    flat: dict = {}
+    flat.update(snap.get("counters", {}))
+    flat.update(snap.get("gauges", {}))
+    for name, s in snap.get("timings", {}).items():
+        flat[f"{name}.last_s"] = s.get("last_s", 0.0)
+        flat[f"{name}.mean_s"] = s.get("mean_s", 0.0)
+    for name, s in snap.get("histograms", {}).items():
+        flat[f"{name}.p99"] = s.get("p99", 0.0)
+    return flat
+
+
+# --------------------------------------------------------- memory gauges
+
+
+def memory_watermarks(device=None) -> dict:
+    """Device HBM watermarks where the backend reports them
+    (``device.memory_stats()``: bytes_in_use / peak_bytes_in_use, the
+    PJRT allocator's numbers), plus the process peak RSS fallback via
+    ``resource.getrusage`` — always present, so the health plane can
+    watch memory growth even on backends with no allocator stats."""
+    out: dict = {}
+    try:
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:  # noqa: BLE001 — stats are best-effort per backend
+        stats = {}
+    for src, dst in (("bytes_in_use", "device_bytes_in_use"),
+                     ("peak_bytes_in_use", "device_peak_bytes"),
+                     ("bytes_limit", "device_bytes_limit")):
+        if src in stats:
+            out[dst] = int(stats[src])
+    try:
+        import resource
+
+        # Linux reports ru_maxrss in KiB; it is a peak, i.e. already a
+        # watermark.
+        out["rss_bytes"] = int(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:  # noqa: BLE001 — resource is POSIX-only
+        pass
+    return out
+
+
+def record_memory_gauges(registry: MetricsRegistry | None = None) -> dict:
+    """Refresh the ``mem.*`` gauges from :func:`memory_watermarks` in
+    ``registry`` (default: the process-global one) and return the raw
+    dict — the seam serve.Info(), the telemetry endpoint, and the
+    health sampler share."""
+    reg = registry if registry is not None else metrics
+    wm = memory_watermarks()
+    for key, value in wm.items():
+        reg.gauge(f"mem.{key}").set(value)
+    return wm
+
+
 class MetricsWriter:
     """Append-only JSONL metrics sink for training runs.
 
@@ -281,9 +408,21 @@ class MetricsWriter:
         self._f = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
 
-    def emit(self, step: int, **scalars) -> None:
+    def emit(self, step: int, snapshot: dict | None = None,
+             **scalars) -> None:
+        """Emit one line. ``snapshot`` (a :meth:`MetricsRegistry
+        .snapshot` dict, or a registry to snapshot) merges flattened
+        via :func:`flatten_snapshot` UNDER the explicit scalars — the
+        training log and the health series then agree on one source of
+        truth instead of call sites recomputing rates by hand."""
         import math
 
+        if snapshot is not None:
+            if isinstance(snapshot, MetricsRegistry):
+                snapshot = snapshot.snapshot()
+            merged = flatten_snapshot(snapshot)
+            merged.update(scalars)
+            scalars = merged
         rec = {"ts": round(time.time(), 3), "step": int(step)}
         for k, v in scalars.items():
             try:
@@ -334,22 +473,49 @@ class trace:
         return False
 
 
+#: Observer for finished annotate() regions — ``fn(name, dur_s)``.
+#: The health plane's goodput ledger installs itself here, so every
+#: train.step / store.push_tree / checkpoint region feeds the per-step
+#: breakdown through the one existing seam.
+_annotate_observer = None
+
+
+def set_annotate_observer(fn) -> None:
+    """Install (or clear, with ``None``) the region observer. One
+    observer per process — the goodput ledger; tests that need several
+    ledgers drive them directly via ``GoodputLedger.region``."""
+    global _annotate_observer
+    _annotate_observer = fn
+
+
 class _AnnotatedSpan:
-    """TraceAnnotation + distributed-trace span entered as one scope —
-    profiler timelines and the flight recorder see the same region."""
+    """TraceAnnotation + distributed-trace span + region observer
+    entered as one scope — profiler timelines, the flight recorder,
+    and the goodput ledger see the same region."""
 
-    __slots__ = ("_ann", "_sp")
+    __slots__ = ("_ann", "_sp", "_name", "_obs", "_t0")
 
-    def __init__(self, ann, sp):
+    def __init__(self, ann, sp, name, obs):
         self._ann = ann
         self._sp = sp
+        self._name = name
+        self._obs = obs
 
     def __enter__(self):
         self._ann.__enter__()
         self._sp.__enter__()
+        if self._obs is not None:
+            self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._obs is not None:
+            dt = time.perf_counter() - self._t0
+            try:
+                self._obs(self._name, dt)
+            except Exception:  # noqa: BLE001 — telemetry must never
+                pass           # kill the training step it observes,
+                #                nor leak the span/annotation scopes.
         self._sp.__exit__(*exc)
         return self._ann.__exit__(*exc)
 
@@ -364,13 +530,16 @@ def annotate(name: str, **kwargs):
     When distributed tracing is armed (:mod:`ptype_tpu.trace`), the
     region ALSO opens a span of the same name — store pushes and train
     steps nest inside both the jax profiler trace and the request's
-    distributed trace through this one seam. Disabled tracing costs
-    one ``enabled()`` check.
+    distributed trace through this one seam. When a region observer is
+    installed (:func:`set_annotate_observer` — the goodput ledger),
+    the region's wall time is reported to it on exit. With neither
+    armed the cost stays one ``enabled()`` check + one global load.
     """
     ann = jax.profiler.TraceAnnotation(name, **kwargs)
-    if not trace_mod.enabled():
+    obs = _annotate_observer
+    if obs is None and not trace_mod.enabled():
         return ann
-    return _AnnotatedSpan(ann, trace_mod.span(name))
+    return _AnnotatedSpan(ann, trace_mod.span(name), name, obs)
 
 
 def step_annotation(step: int):

@@ -197,7 +197,6 @@ class TensorStore:
         stored under the key's binding and returned."""
         from ptype_tpu.metrics import annotate
 
-        _store_fault("store.push", key)
         b = self.binding(key)
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
@@ -206,6 +205,10 @@ class TensorStore:
                     and collectives.quantized_all_reduce_eligible(
                         stacked.shape, n, op))
         with annotate(f"store.push/{key}"):
+            # Fault seam INSIDE the region: a chaos straggler delay
+            # must be attributed to the collective leg of the goodput
+            # breakdown, exactly like a real slow allreduce.
+            _store_fault("store.push", key)
             if use_int8:
                 reduced = collectives.quantized_all_reduce(
                     stacked, self.mesh, self.axis, op)
@@ -303,7 +306,6 @@ class TensorStore:
         if not bucketed:
             return {key: self.push(key, leaf, op) for key, leaf in pairs}
 
-        _store_fault("store.push", prefix)
         t0 = _time.perf_counter()
         # Group by resolved reduce op (dtype grouping happens inside
         # the bucket planner); op=None honors each key's binding.
@@ -314,6 +316,10 @@ class TensorStore:
                 (key, jnp.asarray(leaf)))
         reduced: dict[str, jax.Array] = {}
         with annotate(f"store.push_tree/{prefix}"):
+            # Fault seam INSIDE the region (see push): a straggler
+            # delay lands in the collective leg of the goodput ledger
+            # and on the push_tree span, not in untracked step time.
+            _store_fault("store.push", prefix)
             for group_op, items in groups.items():
                 outs = collectives.bucketed_all_reduce(
                     [leaf for _, leaf in items], self.mesh, self.axis,

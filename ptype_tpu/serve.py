@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ptype_tpu import logs
+from ptype_tpu import metrics as metrics_mod
 from ptype_tpu.models import generate as gen
 from ptype_tpu.models import transformer as tfm
 
@@ -109,6 +110,10 @@ class GeneratorActor:
             # serialized actor's backlog is everyone parked on _lock.
             "in_flight": in_flight,
             "queue_depth": max(0, in_flight - 1),
+            # Device HBM watermarks (RSS fallback) — refreshed into the
+            # mem.* gauges as a side effect, so the health plane's
+            # sampler/alerts see the same numbers the probe reads.
+            "memory": metrics_mod.record_memory_gauges(),
         }
 
 

@@ -472,10 +472,15 @@ def telemetry(span_limit: int = 256) -> dict:
     """One node's observability snapshot — what the built-in
     ``ptype.Telemetry`` actor endpoint serves and
     :func:`ptype_tpu.telemetry.cluster_snapshot` aggregates: process
-    identity, the metrics registry snapshot, and the most recent spans
-    from the flight recorder."""
+    identity, the metrics registry snapshot (memory watermark gauges
+    refreshed per pull), recent series when the health sampler is
+    armed (:func:`ptype_tpu.health.series.start` — the history the
+    alert rules evaluate), and the most recent spans from the flight
+    recorder."""
     from ptype_tpu import metrics as metrics_mod  # lazy: jax import
+    from ptype_tpu.health import series as series_mod
 
+    metrics_mod.record_memory_gauges()
     rec = _recorder
     return {
         "pid": os.getpid(),
@@ -483,6 +488,7 @@ def telemetry(span_limit: int = 256) -> dict:
         "tracing": rec is not None,
         "ts": round(time.time(), 3),
         "metrics": metrics_mod.metrics.snapshot(),
+        "series": series_mod.default_snapshot(),
         "spans": rec.to_dicts(limit=span_limit) if rec is not None else [],
         "spans_finished": rec.finished if rec is not None else 0,
     }

@@ -82,26 +82,40 @@ class StoreDPTrainer:
         metrics.annotate seam): one profiler annotation AND — when the
         trace plane is armed — one span whose children are the Store
         push (``store.push_tree/...``) and any coord manifest traffic,
-        so a soak failure shows which step a fault landed in."""
-        from ptype_tpu.metrics import annotate
+        so a soak failure shows which step a fault landed in. The same
+        seam feeds the health plane's goodput ledger (per-step
+        data/compute/collective breakdown) when one is installed."""
+        from ptype_tpu.metrics import annotate, metrics
 
         with annotate("train.step"):
-            return self._step(batch)
+            out = self._step(batch)
+        # The scalar families the health alert rules watch: loss
+        # (NaN/spike) as a gauge, step progress (stall detection) as a
+        # counter — sampled into series by the health Sampler.
+        metrics.gauge("train.loss").set(out["loss"])
+        metrics.counter("train.steps").add(1)
+        return out
 
     def _step(self, batch: dict) -> dict:
+        from ptype_tpu.metrics import annotate
+
         B = batch["tokens"].shape[0]
         if B % self.n_workers:
             raise ValueError(
                 f"batch size {B} not divisible by {self.n_workers} workers"
             )
-        sh = NamedSharding(self.mesh, P(self.axis, None, None))
-        stacked = {
-            k: jax.device_put(
-                jnp.reshape(v, (self.n_workers, B // self.n_workers, -1)),
-                sh,
-            )
-            for k, v in batch.items()
-        }
+        # The data leg of the goodput breakdown: host→device batch
+        # staging, attributed separately from compute/collective.
+        with annotate("train.data"):
+            sh = NamedSharding(self.mesh, P(self.axis, None, None))
+            stacked = {
+                k: jax.device_put(
+                    jnp.reshape(v,
+                                (self.n_workers, B // self.n_workers, -1)),
+                    sh,
+                )
+                for k, v in batch.items()
+            }
         params = self.params()
         losses, grads = self._grads_fn(params, stacked)
 

@@ -436,7 +436,8 @@ class Trainer:
         ``sync_every`` steps, or :meth:`sync`): between drains the
         previous drained rates are reported, so ``mfu``/``tokens_per_sec``
         never credit dispatched-but-unexecuted work."""
-        from ptype_tpu.metrics import StepStats, step_annotation
+        from ptype_tpu.metrics import (StepStats, annotate, metrics,
+                                       step_annotation)
 
         batch = self.shard_batch(batch)
         train_step = self._step_for(batch)
@@ -451,13 +452,22 @@ class Trainer:
             self._pending_tokens = 0
             self._pending_steps = 0
             self._stats.start()
-        with step_annotation(self._host_step):
+        # train.step is the health-plane seam too (goodput ledger /
+        # trace span). NOTE: this trainer dispatches asynchronously —
+        # the region measures dispatch between drains and the whole
+        # queue at a drain boundary; the store-DP trainer is the
+        # per-step-accurate goodput source.
+        with annotate("train.step"), step_annotation(self._host_step):
             self.state, out = train_step(self.state, batch)
         self._host_step += 1
+        metrics.counter("train.steps").add(1)
         self._pending_tokens += batch["tokens"].size
         self._pending_steps += 1
         if self.sync_every and self._host_step % self.sync_every == 0:
             jax.block_until_ready(out["loss"])
+            # loss is materialized at the drain anyway — stamp the
+            # health gauge without adding a sync.
+            metrics.gauge("train.loss").set(float(out["loss"]))
             self._fold_pending()
         return {
             "loss": out["loss"],

@@ -71,6 +71,24 @@ def _disarm_chaos():
     chaos.disarm()
 
 
+@pytest.fixture(autouse=True)
+def _disarm_health():
+    """A test that installed a goodput ledger or armed the default
+    health sampler must not leak either into later tests' metrics."""
+    yield
+    import sys as _sys
+
+    metrics_mod = _sys.modules.get("ptype_tpu.metrics")
+    if metrics_mod is not None:
+        metrics_mod.set_annotate_observer(None)
+    series_mod = _sys.modules.get("ptype_tpu.health.series")
+    if series_mod is not None:
+        series_mod.stop()
+    goodput_mod = _sys.modules.get("ptype_tpu.health.goodput")
+    if goodput_mod is not None:
+        goodput_mod.uninstall()
+
+
 @pytest.fixture
 def coord():
     """A fresh in-process coordination backend (fast lease sweep)."""

@@ -576,3 +576,67 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
             for s in servers:
                 s.close()
             state.close()
+
+
+# --------------------------------------------------- health plane (ISSUE 5)
+
+
+def test_health_clean_soak_raises_zero_alerts():
+    """False-positive guard on the REAL seam: the store-DP trainer
+    runs clean with the goodput ledger installed on metrics.annotate
+    and the default sampler armed — the ledger must attribute every
+    step (collective > 0, goodput > 0) and the full default rule set
+    must stay silent."""
+    import jax
+    import jax.numpy as jnp
+
+    from ptype_tpu import trace as trace_mod
+    from ptype_tpu.health import AlertEngine, default_rules
+    from ptype_tpu.health import goodput as goodput_mod
+    from ptype_tpu.health import series as series_mod
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import TensorStore
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer
+
+    mesh = build_mesh({"data": jax.device_count()})
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    trainer = StoreDPTrainer(cfg, TensorStore(mesh))
+    stream = synthetic_batches(cfg.vocab_size, 8, 32)
+    trainer.step(next(stream))  # compile before the measured window
+
+    ledger = goodput_mod.install(tokens_per_step=8 * 32)
+    sampler = series_mod.start(cadence_s=0.05)
+    try:
+        n_steps = 6
+        for _ in range(n_steps):
+            trainer.step(next(stream))
+        sampler.sample_once()  # flush the final values into series
+        recs = ledger.records()
+        assert len(recs) == n_steps
+        assert all(r["collective_ms"] > 0 for r in recs), recs
+        assert all(r["goodput_pct"] > 0 for r in recs), recs
+        # One local "node": the process's own telemetry (series from
+        # the default sampler ride it, exactly as a remote pull sees).
+        telem = trace_mod.telemetry()
+        assert telem["series"].get("goodput.steps"), telem["series"]
+        snap = {"ts": time.time(), "nodes": {"local": telem},
+                "errors": {}}
+        alerts = AlertEngine(default_rules()).evaluate(snap)
+        assert alerts == [], [a.to_dict() for a in alerts]
+    finally:
+        series_mod.stop()
+        goodput_mod.uninstall()
+
+
+def test_health_straggler_fault_raises_exactly_the_straggler_alert(coord):
+    """True-positive guard: the seeded store.push straggler drill
+    (shared with the fast tier) raises the straggler alert — and ONLY
+    it — naming the afflicted node."""
+    import test_health
+
+    alerts, slow_key, _, _ = test_health.run_straggler_drill(
+        True, coord)
+    assert [a.rule for a in alerts] == ["straggler"], alerts
+    assert alerts[0].node == slow_key

@@ -144,6 +144,28 @@ def test_serving_fleet_walkthrough():
         proc.kill()
 
 
+def test_health_demo():
+    """`make health-demo` (examples/observability/health_demo.py):
+    the simulated 3-worker fleet, a seeded chaos straggler on one
+    worker's store.push, and the closed loop — cluster snapshot →
+    straggler rule → an alert naming the afflicted node → the obs-top
+    view."""
+    proc = subprocess.Popen(
+        [sys.executable,
+         str(EXAMPLES / "observability" / "health_demo.py")],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        lines = _wait_output(
+            proc, "straggler alert names the afflicted node", 240)
+        out = "".join(lines)
+        assert "ptype health @" in out      # the obs-top rendering
+        assert "ALERTS (1 recent)" in out   # exactly the straggler
+        assert "(= w2)" in out              # ... naming the slow node
+    finally:
+        proc.kill()
+
+
 def test_observability_demo(tmp_path):
     """`make obs-demo` (examples/observability/demo.py): a traced
     fleet serves requests (one under a chaos fault), the cluster

@@ -240,3 +240,74 @@ def test_ptype_tpu_package_is_pt004_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt004 = [f for f in findings if "PT004" in f]
     assert not pt004, pt004
+
+
+PT005_DIRECT = (
+    "def make():\n"
+    "    c = Counter('hits')\n"
+    "    return c\n"
+)
+
+
+def test_pt005_flags_direct_family_construction_in_package(tmp_path):
+    for cls in ("Counter", "Timing", "Gauge", "Histogram"):
+        src = PT005_DIRECT.replace("Counter", cls)
+        findings = _check(tmp_path, f"ptype_tpu/{cls.lower()}.py", src)
+        assert any("PT005" in f for f in findings), (cls, findings)
+
+
+def test_pt005_flags_metrics_module_attribute_form(tmp_path):
+    src = ("from ptype_tpu import metrics\n"
+           "def make():\n"
+           "    return metrics.Gauge('depth')\n")
+    findings = _check(tmp_path, "ptype_tpu/attr.py", src)
+    assert any("PT005" in f for f in findings), findings
+
+
+def test_pt005_silent_for_registry_factories(tmp_path):
+    src = ("from ptype_tpu.metrics import metrics\n"
+           "def make():\n"
+           "    return metrics.counter('hits'), metrics.gauge('g')\n")
+    findings = _check(tmp_path, "ptype_tpu/good5.py", src)
+    assert not any("PT005" in f for f in findings), findings
+
+
+def test_pt005_silent_for_other_counters(tmp_path):
+    # collections.Counter is not a metric family.
+    src = ("import collections\n"
+           "def f(xs):\n"
+           "    return collections.Counter(xs)\n")
+    findings = _check(tmp_path, "ptype_tpu/coll.py", src)
+    assert not any("PT005" in f for f in findings), findings
+
+
+def test_pt005_exempts_metrics_module_and_outside_package(tmp_path):
+    # metrics.py IS the factory.
+    findings = _check(tmp_path, "ptype_tpu/metrics.py", PT005_DIRECT)
+    assert not any("PT005" in f for f in findings), findings
+    # Tests construct families deliberately.
+    findings = _check(tmp_path, "tests/t5.py", PT005_DIRECT)
+    assert not any("PT005" in f for f in findings), findings
+
+
+def test_pt005_honors_noqa(tmp_path):
+    src = ("def make():\n"
+           "    return Counter('x')  # noqa: deliberate\n")
+    findings = _check(tmp_path, "ptype_tpu/sup5.py", src)
+    assert not any("PT005" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt005_clean():
+    """Every metric family in the package comes from a MetricsRegistry
+    (the health sampler's visibility contract — ISSUE 5 satellite)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt005 = [f for f in findings if "PT005" in f]
+    assert not pt005, pt005

@@ -47,6 +47,29 @@ def test_snapshot_uniform_across_families():
     assert snap["timings"]["t"]["p99_s"] >= snap["timings"]["t"]["p50_s"]
 
 
+def test_counter_windowed_rate():
+    """events/sec over the sampled window (the health sampler's
+    cadence) — deterministic under explicit sample(now=...) stamps."""
+    from ptype_tpu.metrics import Counter
+
+    c = Counter("req")
+    assert c.rate(now=0.0) == 0.0  # no samples yet: defined, no crash
+    c.add(10)
+    c.sample(now=0.0)
+    c.add(30)
+    c.sample(now=2.0)
+    assert c.rate(now=2.0) == 15.0
+    # A single in-window sample closes against the live value at now.
+    assert c.rate(window_s=1.0, now=2.5) == 0.0  # flat since t=2
+    c.add(5)
+    assert c.rate(window_s=1.0, now=3.0) == 5.0
+    # Monotonic clock going nowhere can't divide by zero.
+    c2 = Counter("x")
+    c2.sample(now=1.0)
+    c2.sample(now=1.0)
+    assert c2.rate(now=1.0) == 0.0
+
+
 def test_metrics_writer_jsonl(tmp_path):
     import json
 

@@ -48,6 +48,13 @@ def test_generate_over_rpc():
         # Load telemetry for the gateway's replica pool: idle here.
         assert info["in_flight"] == 0
         assert info["queue_depth"] == 0
+        # Memory watermarks for the health plane (ISSUE 5): the RSS
+        # fallback is always present; the same numbers land in the
+        # mem.* gauges for the sampler/alert rules.
+        assert info["memory"]["rss_bytes"] > 0
+        from ptype_tpu.metrics import metrics as _m
+
+        assert _m.gauge("mem.rss_bytes").value > 0
 
         logits = client.call("Generator.Logits", prompt)
         assert logits.shape == (2, 4, CFG.vocab_size)

@@ -32,6 +32,11 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   diagnostics must ride the structured logs (trace-correlated via
   logs.KVLogger) or trace events, never stdout; __main__.py is the
   operator CLI whose stdout IS its contract
+- PT005 (ptype_tpu/ except metrics.py): ``Counter(``/``Timing(``/
+  ``Gauge(``/``Histogram(`` constructed directly — a family built
+  outside a ``MetricsRegistry`` is invisible to the health plane's
+  sampler (no series, no alerts); get it from a registry
+  (``metrics.metrics.counter(...)``)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -300,6 +305,46 @@ class _BarePrintCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: Metric family classes that must come from a MetricsRegistry inside
+#: the package: a directly-constructed family is invisible to the
+#: health sampler's registry walk, so it produces no series and no
+#: alert can see it.
+_METRIC_FAMILIES = frozenset({"Counter", "Timing", "Gauge", "Histogram"})
+#: Module aliases under which the repo imports ptype_tpu.metrics —
+#: attribute calls through these are the direct-construction idiom;
+#: other attribute bases (collections.Counter) are NOT flagged.
+_METRICS_ALIASES = frozenset({"metrics", "metrics_mod"})
+
+
+class _DirectMetricCheck(ast.NodeVisitor):
+    """PT005: a metric family instantiated directly in ptype_tpu/
+    (metrics.py itself excepted — it IS the factory). Both the bare
+    name (``Counter("x")``) and the module-attribute form
+    (``metrics.Counter("x")``) are flagged."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name) and fn.id in _METRIC_FAMILIES:
+            name = fn.id
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in _METRIC_FAMILIES
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id in _METRICS_ALIASES):
+            name = fn.attr
+        if name is not None:
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT005 direct {name}() "
+                f"construction bypasses the MetricsRegistry — the "
+                f"health sampler can't see it (no series, no alerts); "
+                f"use registry.{name.lower()}(name)")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -357,6 +402,10 @@ def check_file(path: str, findings: list[str]) -> None:
     if "ptype_tpu" in parts and os.path.basename(path) != "__main__.py":
         # __main__.py is the operator CLI: stdout IS its contract.
         _BarePrintCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and os.path.basename(path) != "metrics.py":
+        # metrics.py IS the family factory; everything else must get
+        # families from a MetricsRegistry so the sampler sees them.
+        _DirectMetricCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
