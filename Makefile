@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
+.PHONY: test test-all bench serve-bench collectives-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -21,6 +21,16 @@ bench:
 # gateway and the round-robin comparison p99.
 serve-bench:
 	JAX_PLATFORMS=cpu python bench.py --serve
+
+# Gradient-wire microbench on the 8-device virtual host mesh
+# (docs/PERF.md "Quantized + overlapped collectives"): bucketed
+# allreduce GB/s per wire format (fp32 / per-chunk int8 / block-scaled
+# int8 sweep), quantized push_tree timing, and the goodput ledger's
+# collective share of store-DP step time with fine-grained overlap
+# off vs on (the ISSUE 6 acceptance numbers).
+collectives-bench:
+	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
+		python bench.py --collectives
 
 # Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
 # fault schedule against the in-process trainer + registry +

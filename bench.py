@@ -203,6 +203,10 @@ def worker_main() -> None:
         "step_breakdown": None,
         "sampler_overhead_pct": None,
         "health_note": None,
+        "store_wire_gbps": None,
+        "store_wire_note": None,
+        "collective_overlap_pct": None,
+        "collective_note": None,
         "final_loss": round(float(out["loss"]), 4),
     }
     # The primary metric is EARNED at this point — print it before the
@@ -359,6 +363,32 @@ def _trace_overhead_hostmesh() -> tuple[dict | None, str]:
         STORE_PROBE_TIMEOUT)
 
 
+def _wire_hostmesh() -> tuple[dict | None, str]:
+    """Bucketed-allreduce bandwidth per wire format (fp32 vs PR 1's
+    per-chunk int8 vs the block-scaled int8 sweep) over the virtual
+    host mesh — fills ``store_wire_gbps`` (ISSUE 6)."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.parallel.collectives import measure_wire_gbps\n"
+        "from ptype_tpu.parallel.mesh import build_mesh\n"
+        "print(json.dumps(measure_wire_gbps(build_mesh({'data': 8}),"
+        " mbytes=16, iters=3)))\n",
+        STORE_PROBE_TIMEOUT)
+
+
+def _overlap_hostmesh() -> tuple[dict | None, str]:
+    """Store-DP collective share, synchronous baseline vs fine-grained
+    overlap — fills ``collective_overlap_pct`` (ISSUE 6 acceptance:
+    the goodput ledger's collective leg shrinks with overlap on)."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.parallel.mesh import build_mesh\n"
+        "from ptype_tpu.train.store_dp import measure_overlap\n"
+        "print(json.dumps(measure_overlap(build_mesh({'data': 8}),"
+        " steps=6)))\n",
+        STORE_PROBE_TIMEOUT)
+
+
 def _health_hostmesh() -> tuple[dict | None, str]:
     """Store-DP step loop with the goodput ledger + sampler armed —
     fills ``goodput_pct`` / ``step_breakdown`` /
@@ -407,6 +437,37 @@ def _patch_store_metric(rec: dict) -> None:
             f"{probe['traced_step_ms']} ms vs untraced "
             f"{probe['untraced_step_ms']} ms); {note}"
             if probe else note)
+    if rec.get("store_wire_gbps") is None:
+        # Quantized-wire sweep: the block-scaled int8 allreduce vs
+        # fp32 and PR 1's per-chunk int8 (ISSUE 6).
+        probe, note = _wire_hostmesh()
+        if probe:
+            rec["store_wire_gbps"] = {
+                "fp32": probe["fp32_gbps"],
+                "int8_chunk": probe["int8_chunk_gbps"],
+                "int8_block": probe["int8_block_gbps"]}
+            sweep = " / ".join(
+                f"{pct}%@{blk}" for blk, pct in
+                probe["int8_block_wire_pct"].items())
+            rec["store_wire_note"] = (
+                f"int8 wire bytes {probe['int8_chunk_wire_pct']}% of "
+                f"fp32 per-chunk, block-scaled {sweep}; "
+                f"{probe['payload_mb']} MiB payload; {note}")
+        else:
+            rec["store_wire_note"] = note
+    if rec.get("collective_overlap_pct") is None:
+        # Fine-grained backward/collective overlap: the goodput
+        # ledger's collective share, drain baseline vs overlap=True.
+        probe, note = _overlap_hostmesh()
+        rec["collective_overlap_pct"] = (
+            probe["collective_overlap_pct"] if probe else None)
+        rec["collective_note"] = (
+            f"collective share "
+            f"{probe['collective_share_drain_pct']}% drained → "
+            f"{probe['collective_share_overlap_pct']}% overlapped "
+            f"(step {probe['drain_step_ms']} → "
+            f"{probe['overlap_step_ms']} ms); {note}"
+            if probe else note)
     if rec.get("goodput_pct") is None:
         # Health plane on the same host-mesh loop: live goodput +
         # breakdown, and the sampler cost alongside trace_overhead_pct
@@ -445,6 +506,54 @@ def _cpu_fallback(errs: list[str]) -> bool:
         return True
     errs.append(f"cpu fallback: {err}")
     return False
+
+
+# ----------------------------------------------------- collectives bench
+
+
+def collectives_main() -> None:
+    """``make collectives-bench``: the ISSUE 6 data-plane probes on
+    the host mesh, in-process (the Make target pins CPU + 8 virtual
+    devices). Emits one labeled JSON line per probe and a combined
+    tail record: the per-wire bucketed-allreduce bandwidth sweep
+    (fp32 / per-chunk int8 / block-scaled int8), the quantized+EF
+    push_tree timing, and the collective-share-of-step-time
+    comparison with fine-grained overlap on."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ptype_tpu.parallel.collectives import (WireConfig,
+                                                measure_wire_gbps)
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import measure_push_tree
+    from ptype_tpu.train.store_dp import measure_overlap
+
+    import jax
+
+    n = len(jax.devices())
+    mesh = build_mesh({"data": n})
+    wires = measure_wire_gbps(mesh, mbytes=16, iters=3)
+    _emit({"probe": "wire_gbps", **wires})
+    push = measure_push_tree(
+        mesh, preset="tiny", iters=2,
+        wire=WireConfig(compress="int8", int8_min_bytes=0))
+    _emit({"probe": "push_tree_int8_block", **push})
+    overlap = measure_overlap(mesh, steps=6)
+    _emit({"probe": "overlap", **overlap})
+    _emit({
+        "metric": "store collectives: block-scaled int8 wire + "
+                  f"overlap ({n}-device host mesh)",
+        "value": overlap["collective_overlap_pct"],
+        "unit": "% of collective share hidden by overlap",
+        "store_wire_gbps": {
+            "fp32": wires["fp32_gbps"],
+            "int8_chunk": wires["int8_chunk_gbps"],
+            "int8_block": wires["int8_block_gbps"]},
+        "store_push_tree_ms": push["bucketed_ms"],
+        "collective_overlap_pct": overlap["collective_overlap_pct"],
+        "collective_share_drain_pct":
+            overlap["collective_share_drain_pct"],
+        "collective_share_overlap_pct":
+            overlap["collective_share_overlap_pct"],
+    })
 
 
 # ------------------------------------------------------------ serve bench
@@ -610,6 +719,9 @@ def main() -> None:
         return
     if "--serve" in sys.argv:
         serve_main()
+        return
+    if "--collectives" in sys.argv:
+        collectives_main()
         return
 
     t_start = time.time()

@@ -174,6 +174,12 @@ class GoodputLedger:
             rec = {
                 "step": self._steps,
                 "t": round(time.time(), 3),
+                # Full wall clock this step accounts for (step + stall
+                # + between-step component time) — the share
+                # denominator; step_ms + stall_ms alone EXCLUDES
+                # between-step components that the component sums
+                # include, which would let a share exceed 100%.
+                "wall_ms": round(wall * 1e3, 3),
                 "step_ms": round(step_s * 1e3, 3),
                 "compute_ms": round(compute * 1e3, 3),
                 "collective_ms": round(coll * 1e3, 3),
@@ -220,13 +226,26 @@ class GoodputLedger:
         def mean(key: str) -> float:
             return round(sum(r.get(key, 0.0) for r in recs) / n, 3)
 
+        breakdown = {
+            k: mean(k) for k in
+            ("step_ms", "compute_ms", "collective_ms", "data_ms",
+             "checkpoint_ms", "stall_ms")}
+        # Share denominator: mean wall over the records that carry it
+        # (averaging absent keys as 0 would deflate the wall and push
+        # the share past 100% — the bound this metric promises).
+        walls = [r["wall_ms"] for r in recs if "wall_ms" in r]
+        wall = (sum(walls) / len(walls) if walls
+                else breakdown["step_ms"] + breakdown["stall_ms"])
         out = {
             "steps": recs[-1]["step"],
             "goodput_pct": round(mean("goodput_pct"), 2),
-            "step_breakdown": {
-                k: mean(k) for k in
-                ("step_ms", "compute_ms", "collective_ms", "data_ms",
-                 "checkpoint_ms", "stall_ms")},
+            "step_breakdown": breakdown,
+            # The ISSUE 6 acceptance metric: how much of the step the
+            # collective leg owns — what quantized wires + fine-grained
+            # overlap (store_dp overlap=True) exist to shrink.
+            "collective_share_pct": round(
+                100.0 * breakdown["collective_ms"] / wall, 2)
+            if wall else 0.0,
         }
         if "tokens_per_sec" in recs[-1]:
             out["tokens_per_sec"] = mean("tokens_per_sec")

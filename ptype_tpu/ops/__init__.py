@@ -1,6 +1,10 @@
 """Pallas TPU kernels for the hot ops (SURVEY.md §7: "performance-
 critical kernels go to Pallas")."""
 
-from ptype_tpu.ops.flash_attention import flash_attention, make_flash_attn_fn
+from ptype_tpu.ops.flash_attention import (check_tpu_lowering,
+                                           flash_attention,
+                                           lowering_block_shapes,
+                                           make_flash_attn_fn)
 
-__all__ = ["flash_attention", "make_flash_attn_fn"]
+__all__ = ["check_tpu_lowering", "flash_attention",
+           "lowering_block_shapes", "make_flash_attn_fn"]

@@ -126,6 +126,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 LANES = 128
 
 
+def _spec_shapes(block_q: int, block_k: int, Dh: int) -> dict:
+    """The three BlockSpec block shapes every kernel in this module
+    declares — the ONE source both the pallas_calls and the lowering
+    checker (:func:`lowering_block_shapes`) consume, so a layout
+    change can't pass the CPU-tier check while failing on Mosaic."""
+    return {"q": (None, None, block_q, Dh),
+            "kv": (None, None, block_k, Dh),
+            "row": (None, None, block_q, LANES)}
+
+
 def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
          interpret: bool, want_lse: bool = True):
     """q: (B, H, S, Dh); k, v: (B, K, S, Dh) → (o like q, lse
@@ -138,11 +148,12 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
 
     qmap = lambda b, h, qi, kb: (b, h, qi, 0)           # noqa: E731
     kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
+    shp = _spec_shapes(block_q, block_k, Dh)
 
-    out_specs = [pl.BlockSpec((None, None, block_q, Dh), qmap)]
+    out_specs = [pl.BlockSpec(shp["q"], qmap)]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if want_lse:
-        out_specs.append(pl.BlockSpec((None, None, block_q, LANES), qmap))
+        out_specs.append(pl.BlockSpec(shp["row"], qmap))
         out_shape.append(
             jax.ShapeDtypeStruct((B, H, S, LANES), jnp.float32))
 
@@ -151,9 +162,9 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
                           want_lse=want_lse),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_k, Dh), kvmap),
-            pl.BlockSpec((None, None, block_k, Dh), kvmap),
+            pl.BlockSpec(shp["q"], qmap),
+            pl.BlockSpec(shp["kv"], kvmap),
+            pl.BlockSpec(shp["kv"], kvmap),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -304,19 +315,20 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
 
     qmap = lambda b, h, qi, kb: (b, h, qi, 0)            # noqa: E731
     kvmap = lambda b, h, qi, kb: (b, h // group, kb, 0)  # noqa: E731
+    shp = _spec_shapes(block_q, block_k, Dh)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
         grid=(B, H, S // block_q, S // block_k),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_k, Dh), kvmap),
-            pl.BlockSpec((None, None, block_k, Dh), kvmap),
-            pl.BlockSpec((None, None, block_q, Dh), qmap),
-            pl.BlockSpec((None, None, block_q, LANES), qmap),
-            pl.BlockSpec((None, None, block_q, LANES), qmap),
+            pl.BlockSpec(shp["q"], qmap),
+            pl.BlockSpec(shp["kv"], kvmap),
+            pl.BlockSpec(shp["kv"], kvmap),
+            pl.BlockSpec(shp["q"], qmap),
+            pl.BlockSpec(shp["row"], qmap),
+            pl.BlockSpec(shp["row"], qmap),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, Dh), qmap),
+        out_specs=pl.BlockSpec(shp["q"], qmap),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, Dh), jnp.float32)],
         interpret=interpret,
@@ -331,16 +343,16 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
         functools.partial(_dkv_kernel, scale=scale, causal=causal),
         grid=(B, K, S // block_k, group, S // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, Dh), bmap_q),
-            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
-            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
-            pl.BlockSpec((None, None, block_q, Dh), bmap_q),
-            pl.BlockSpec((None, None, block_q, LANES), bmap_q),
-            pl.BlockSpec((None, None, block_q, LANES), bmap_q),
+            pl.BlockSpec(shp["q"], bmap_q),
+            pl.BlockSpec(shp["kv"], bmap_kv),
+            pl.BlockSpec(shp["kv"], bmap_kv),
+            pl.BlockSpec(shp["q"], bmap_q),
+            pl.BlockSpec(shp["row"], bmap_q),
+            pl.BlockSpec(shp["row"], bmap_q),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
-            pl.BlockSpec((None, None, block_k, Dh), bmap_kv),
+            pl.BlockSpec(shp["kv"], bmap_kv),
+            pl.BlockSpec(shp["kv"], bmap_kv),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -398,6 +410,66 @@ def flash_attention(q, k, v, causal: bool = True,
     o = _flash(to_hmajor(q), to_hmajor(k), to_hmajor(v),
                block_q, block_k, causal, interpret)
     return jnp.swapaxes(o, 1, 2)
+
+
+def lowering_block_shapes(B: int, H: int, S: int, Dh: int,
+                          K: int | None = None,
+                          block_q: int = 1024, block_k: int = 1024
+                          ) -> list[tuple[str, tuple, tuple]]:
+    """Every (operand name, block shape, array shape) the three
+    pallas_calls declare at these dimensions — the Mosaic tiling
+    contract as data, checkable WITHOUT a TPU.
+
+    The TPU lowering requires the last two dims of every block shape
+    to divide by (8, 128) or equal the array's. BENCH_r02 recorded the
+    violation this guards against: the LSE output was once declared
+    (B, H, S) with a squeezed size-1 dim second-to-last in the block —
+    the fix stores row residuals lane-replicated at (block_q, LANES).
+    ``tests/test_flash_lowering.py`` asserts the rule over every entry
+    here for the bench/train configs, so a spec regression fails tier-1
+    on CPU instead of the next TPU session."""
+    K = K or H
+    block_q, block_k = min(block_q, S), min(block_k, S)
+    q4 = (B, H, S, Dh)
+    kv4 = (B, K, S, Dh)
+    lse4 = (B, H, S, LANES)
+    # The block shapes come from the SAME _spec_shapes the
+    # pallas_calls consume (None = squeezed dim → size 1 here).
+    shp = {k: tuple(1 if d is None else d for d in v)
+           for k, v in _spec_shapes(block_q, block_k, Dh).items()}
+    qb, kvb, lseb = shp["q"], shp["kv"], shp["row"]
+    out = []
+    # forward: q, k, v → o (+ lse when the residual is wanted)
+    out += [("fwd/q", qb, q4), ("fwd/k", kvb, kv4), ("fwd/v", kvb, kv4),
+            ("fwd/o", qb, q4), ("fwd/lse", lseb, lse4)]
+    # backward dq: q, k, v, do, lse, delta → dq
+    out += [("dq/q", qb, q4), ("dq/k", kvb, kv4), ("dq/v", kvb, kv4),
+            ("dq/do", qb, q4), ("dq/lse", lseb, lse4),
+            ("dq/delta", lseb, lse4), ("dq/dq", qb, q4)]
+    # backward dk/dv: same operands → dk, dv
+    out += [("dkv/q", qb, q4), ("dkv/k", kvb, kv4), ("dkv/v", kvb, kv4),
+            ("dkv/do", qb, q4), ("dkv/lse", lseb, lse4),
+            ("dkv/delta", lseb, lse4), ("dkv/dk", kvb, kv4),
+            ("dkv/dv", kvb, kv4)]
+    return out
+
+
+def check_tpu_lowering(B: int, H: int, S: int, Dh: int,
+                       K: int | None = None,
+                       block_q: int = 1024, block_k: int = 1024
+                       ) -> list[str]:
+    """Violations of the Mosaic (8, 128) divisibility rule across
+    :func:`lowering_block_shapes` — empty when the kernels lower."""
+    bad = []
+    for name, block, array in lowering_block_shapes(
+            B, H, S, Dh, K, block_q, block_k):
+        for dim, want in ((-2, 8), (-1, 128)):
+            if block[dim] % want and block[dim] != array[dim]:
+                bad.append(
+                    f"{name}: block {block} dim {dim} = {block[dim]} "
+                    f"not divisible by {want} nor equal to array "
+                    f"{array}")
+    return bad
 
 
 def make_flash_attn_fn(block_q: int = 1024, block_k: int = 1024):

@@ -187,65 +187,115 @@ def all_to_all(stacked: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     return _all_to_all_fn(mesh, axis, stacked.ndim)(stacked)
 
 
-def _q_int8_chunks(x: jax.Array):
-    """Int8-quantize with one absmax scale per dim-0 chunk.
-    ``x: (m, ...)`` → ``(int8 like x, f32 scales (m,))``. Deterministic
-    round-to-nearest — collective results must be reproducible across
-    reruns for the numerics test tier."""
-    amax = jnp.max(jnp.abs(x).reshape(x.shape[0], -1), axis=1)
+#: Default elements per quantization scale block (EQuARX pattern,
+#: PAPERS.md arXiv 2506.17615): small enough that one outlier poisons
+#: ~0.2% of a bucket instead of a whole all_to_all chunk, large enough
+#: that the f32 scale overhead stays <1% of the int8 wire bytes.
+DEFAULT_QUANT_BLOCK = 512
+
+
+def _q_int8_blockwise(chunks: jax.Array, block: int | None):
+    """Int8-quantize ``chunks: (m, c)`` with one absmax scale per
+    ``block`` contiguous elements (``block=None`` → one scale per
+    whole chunk — PR 1's coarse granularity, kept for the wire bench
+    comparison). Each chunk zero-pads to a block multiple internally;
+    zero blocks quantize exactly. Deterministic round-to-nearest —
+    collective results must be reproducible across reruns for the
+    numerics test tier. Returns ``(q (m, nb, block) int8,
+    scales (m, nb) f32)``."""
+    m, c = chunks.shape
+    block = c if block is None else min(int(block), c)
+    pad = (-c) % block
+    if pad:
+        chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+    b = chunks.reshape(m, -1, block)
+    amax = jnp.max(jnp.abs(b), axis=2)
     scale = jnp.where(amax == 0.0, 1.0, amax / 127.0).astype(jnp.float32)
-    sb = scale.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / sb),
+    q = jnp.clip(jnp.round(b.astype(jnp.float32) / scale[:, :, None]),
                  -127, 127).astype(jnp.int8)
     return q, scale
 
 
-def _int8_phase1(x, axis: str, op: str):
+def _dq_int8_blockwise(q: jax.Array, scale: jax.Array, c: int):
+    """Inverse of :func:`_q_int8_blockwise`: ``(m, nb, block)`` int8 +
+    ``(m, nb)`` scales → ``(m, c)`` f32 (internal block pad dropped)."""
+    out = (q.astype(jnp.float32) * scale[:, :, None])
+    return out.reshape(q.shape[0], -1)[:, :c]
+
+
+def _int8_phase1(x, axis: str, op: str, block: int | None):
     """The int8 reduce-scatter leg, shared by the quantized allreduce
     and the standalone quantized reduce_scatter (one implementation so
-    numerics fixes can't drift between them): slice my contribution
-    into n chunks, quantize each with one absmax scale, all_to_all so
-    device j collects everyone's chunk j, dequantize and reduce.
-    Returns this device's reduced f32 chunk ``(rest[0]/n, *tail)``."""
+    numerics fixes can't drift between them): slice my flat
+    contribution into n chunks, quantize each with per-``block``
+    absmax scales, all_to_all so device j collects everyone's chunk j,
+    dequantize and reduce. Returns this device's reduced f32 chunk
+    ``(elems/n,)`` plus the local quantization error ``(n, elems/n)``
+    (what error feedback carries to the next step)."""
     n = axis_size(axis)
     c = x.shape[0] // n
-    chunks = x.reshape((n, c) + x.shape[1:])
-    q, scale = _q_int8_chunks(chunks)
+    chunks = x.astype(jnp.float32).reshape(n, c)
+    q, scale = _q_int8_blockwise(chunks, block)
+    err = chunks - _dq_int8_blockwise(q, scale, c)
     q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
     scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
                            tiled=True)
-    q = q.reshape((n, c) + x.shape[1:])
-    red = jnp.sum(
-        q.astype(jnp.float32) * scale.reshape((n,) + (1,) * x.ndim),
-        axis=0)
+    red = jnp.sum(_dq_int8_blockwise(q, scale, c), axis=0)
     if op == "mean":
         red = red / n
-    return red
+    return red, err
 
 
-def _int8_all_reduce_body(x, axis: str, op: str):
-    """Both wire legs of the int8 allreduce on one device's
-    contribution ``x`` (shape ``rest`` with ``rest[0] % n == 0``):
-    phase 1 (:func:`_int8_phase1`), then the all_gather leg —
-    re-quantize my reduced chunk with one scale, gather, dequantize —
-    so every device reassembles the full f32 reduction. Shared by the
-    per-leaf quantized allreduce and the bucketed tree path."""
+def _int8_all_reduce_body(x, axis: str, op: str,
+                          block: int | None = DEFAULT_QUANT_BLOCK,
+                          res=None):
+    """Both wire legs of the block-scaled int8 allreduce on one
+    device's flat contribution ``x`` (``len(x) % n == 0``): phase 1
+    (:func:`_int8_phase1` in sum space), then the all_gather leg —
+    re-quantize my reduced chunk with per-block scales, gather,
+    dequantize — so every device reassembles the full f32 reduction
+    (mean divided at the very end, so both wire legs and the error
+    terms live in one space).
+
+    ``res`` arms **error feedback** (EQuARX/EF-SGD): the residual is
+    added to the contribution before quantizing, and the returned
+    residual carries BOTH legs' quantization error — phase 1's error
+    across my whole contribution, plus phase 2's error on the chunk I
+    own, folded in at my chunk's offset (I re-own the same chunk next
+    step, so adding it to my next contribution cancels it in the
+    reduction). Returns ``(out shaped like x, new_res | None)``."""
     n = axis_size(axis)
-    red = _int8_phase1(x, axis, op)
-    q2, s2 = _q_int8_chunks(red[None])  # one chunk → one scale
-    qg = lax.all_gather(jnp.squeeze(q2, 0), axis)   # (n, c, *tail)
-    sg = lax.all_gather(s2[0], axis)                # (n,)
-    out = qg.astype(jnp.float32) * sg.reshape((n,) + (1,) * x.ndim)
-    return out.reshape(x.shape)
+    c = x.shape[0] // n
+    xf = x.astype(jnp.float32)
+    if res is not None:
+        xf = xf + res.astype(jnp.float32)
+    red, err1 = _int8_phase1(xf, axis, "sum", block)
+    q2, s2 = _q_int8_blockwise(red[None], block)
+    err2 = red - _dq_int8_blockwise(q2, s2, c)[0]
+    qg = lax.all_gather(q2[0], axis)                # (n, nb, block)
+    sg = lax.all_gather(s2[0], axis)                # (n, nb)
+    out = _dq_int8_blockwise(qg, sg, c).reshape(x.shape)
+    if op == "mean":
+        out = out / n
+    if res is None:
+        return out, None
+    new_res = err1.reshape(x.shape)
+    idx = lax.axis_index(axis)
+    mine = lax.dynamic_slice(new_res, (idx * c,), (c,)) + err2
+    new_res = lax.dynamic_update_slice(new_res, mine, (idx * c,))
+    return out, new_res.astype(res.dtype)
 
 
 @functools.lru_cache(maxsize=256)
-def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
+def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str,
+                             block: int | None):
     in_spec = P(axis, *_rest(ndim))
     out_spec = P(*_rest(ndim))
 
     def f(local):
-        return _int8_all_reduce_body(jnp.squeeze(local, axis=0), axis, op)
+        x = jnp.squeeze(local, axis=0)
+        out, _ = _int8_all_reduce_body(x.reshape(-1), axis, op, block)
+        return out.reshape(x.shape).astype(x.dtype)
 
     return jax.jit(
         shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
@@ -255,12 +305,16 @@ def _quantized_all_reduce_fn(mesh: Mesh, axis: str, ndim: int, op: str):
 
 @functools.lru_cache(maxsize=256)
 def _quantized_reduce_scatter_fn(mesh: Mesh, axis: str, ndim: int,
-                                 op: str):
+                                 op: str, block: int | None):
     in_spec = P(axis, *_rest(ndim))
     out_spec = P(axis, *_rest(ndim - 1))
 
     def f(local):
-        return _int8_phase1(jnp.squeeze(local, axis=0), axis, op)
+        x = jnp.squeeze(local, axis=0)
+        red, _ = _int8_phase1(x.reshape(-1), axis, op, block)
+        n = axis_size(axis)
+        return red.reshape((x.shape[0] // n,) + x.shape[1:]).astype(
+            x.dtype)
 
     return jax.jit(shard_map(f, mesh=mesh, in_specs=in_spec,
                              out_specs=out_spec))
@@ -268,7 +322,9 @@ def _quantized_reduce_scatter_fn(mesh: Mesh, axis: str, ndim: int,
 
 def quantized_reduce_scatter(stacked: jax.Array, mesh: Mesh,
                              axis: str = "data",
-                             op: str = "sum") -> jax.Array:
+                             op: str = "sum", *,
+                             q_block: int | None = DEFAULT_QUANT_BLOCK
+                             ) -> jax.Array:
     """Phase 1 of :func:`quantized_all_reduce` alone: int8-quantized
     all_to_all + local dequant-reduce — each device keeps ONE f32
     shard of the reduced tensor (the bandwidth-optimal int8 grad
@@ -284,8 +340,8 @@ def quantized_reduce_scatter(stacked: jax.Array, mesh: Mesh,
             f"(got {stacked.shape[1:]})")
     stacked = jax.device_put(
         stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim))))
-    return _quantized_reduce_scatter_fn(mesh, axis, stacked.ndim,
-                                        op)(stacked)
+    return _quantized_reduce_scatter_fn(mesh, axis, stacked.ndim, op,
+                                        q_block)(stacked)
 
 
 def quantized_all_reduce_eligible(shape: tuple, n: int,
@@ -299,16 +355,21 @@ def quantized_all_reduce_eligible(shape: tuple, n: int,
 
 def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,
                          axis: str = "data",
-                         op: str = "sum") -> jax.Array:
-    """Int8-quantized allreduce — the EQuARX pattern (PAPERS.md): both
-    wire phases of the bandwidth-optimal allreduce decomposition
+                         op: str = "sum", *,
+                         q_block: int | None = DEFAULT_QUANT_BLOCK
+                         ) -> jax.Array:
+    """Block-scaled int8 allreduce — the EQuARX pattern (PAPERS.md):
+    both wire phases of the bandwidth-optimal allreduce decomposition
     (all_to_all reduce-scatter, then all_gather) carry int8 payloads
-    with f32 blockwise absmax scales, ≈4× fewer ICI bytes than f32 at
-    a bounded relative error (two round-to-nearest quantizations of
-    ≤ absmax/254 each). Lossy: for gradients, not parameters.
+    with one f32 absmax scale per ``q_block`` elements, ≈4× fewer ICI
+    bytes than f32 at a bounded relative error (two round-to-nearest
+    quantizations of ≤ block-absmax/254 each — an outlier poisons one
+    block, not the whole chunk). ``q_block=None`` falls back to one
+    scale per all_to_all chunk (the PR 1 wire, kept for comparison).
+    Lossy: for gradients, not parameters.
 
     ``stacked``: ``(axis_size, *rest)`` with ``rest[0] % axis_size
-    == 0``; returns ``rest`` in f32, replicated.
+    == 0``; returns ``rest``, replicated.
     """
     n = int(mesh.shape[axis])
     if not quantized_all_reduce_eligible(stacked.shape, n, op):
@@ -319,7 +380,8 @@ def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,
             f"(got {stacked.shape[1:]})")
     stacked = jax.device_put(
         stacked, NamedSharding(mesh, P(axis, *_rest(stacked.ndim))))
-    return _quantized_all_reduce_fn(mesh, axis, stacked.ndim, op)(stacked)
+    return _quantized_all_reduce_fn(mesh, axis, stacked.ndim, op,
+                                    q_block)(stacked)
 
 
 def broadcast(value: jax.Array, mesh: Mesh) -> jax.Array:
@@ -349,6 +411,45 @@ DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
 #: under compress="int8": at small sizes the quantize/dequantize math
 #: and the second collective leg cost more than the wire bytes saved.
 INT8_MIN_BUCKET_BYTES = 64 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class WireConfig:
+    """One place for the gradient-wire policy, plumbed from the
+    trainers through :class:`~ptype_tpu.parallel.tensorstore.
+    TensorStore` down to the bucketed collectives.
+
+    ``compress``: None (exact) | "bf16" | "int8" (block-scaled).
+    ``q_block``: elements per int8 scale block (None = one scale per
+    all_to_all chunk — the PR 1 wire, kept for benches).
+    ``error_feedback``: carry a per-leaf residual of the quantization
+    error into the next push (int8 wire only) so error does not
+    accumulate across steps.
+    """
+
+    compress: str | None = None
+    q_block: int | None = DEFAULT_QUANT_BLOCK
+    error_feedback: bool = True
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    int8_min_bytes: int = INT8_MIN_BUCKET_BYTES
+
+    def __post_init__(self):
+        if self.compress not in (None, "bf16", "int8"):
+            raise ValueError(
+                f"WireConfig: unknown compression {self.compress!r}")
+        # Floor of 8: below that the 4-byte f32 scale per block costs
+        # more than the 3 bytes/element int8 saves (at q_block=1 the
+        # "compressed" wire is 5 bytes/elem vs fp32's 4 — lossy AND
+        # bigger). A config typo must fail here, not ship that.
+        if self.q_block is not None and self.q_block < 8:
+            raise ValueError(
+                f"WireConfig: q_block must be None or >= 8 (the f32 "
+                f"scale overhead is 4/q_block bytes per element), got "
+                f"{self.q_block!r}")
+
+    @property
+    def feedback_armed(self) -> bool:
+        return self.compress == "int8" and self.error_feedback
 
 
 @dataclasses.dataclass(frozen=True)
@@ -437,7 +538,10 @@ def _bucket_wire(bucket: Bucket, op: str, compress: str | None,
         return None
     if compress == "bf16":
         return "bf16"
-    if op in ("sum", "mean") and bucket.payload_bytes >= int8_min_bytes:
+    # max(..., 1): a zero-element bucket must never quantize — the
+    # blockwise kernel's chunk math divides by the block size.
+    if op in ("sum", "mean") and \
+            bucket.payload_bytes >= max(int8_min_bytes, 1):
         return "int8"
     return None
 
@@ -449,19 +553,42 @@ def _unpack(red, slots):
                  for s in slots)
 
 
+def _pack_flat(locals_, pad: int):
+    """Squeeze the stacked dim off each per-device leaf, flatten,
+    concatenate, and zero-pad to the bucket's padded length — the ONE
+    packing both fused bucket programs (allreduce and reduce-scatter)
+    share, so the wire layouts cannot drift."""
+    parts = [jnp.squeeze(x, axis=0).reshape(-1) for x in locals_]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
 @functools.lru_cache(maxsize=512)
 def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
                           dtype: str, pad: int, wire: str | None,
-                          restore: bool):
+                          restore: bool,
+                          q_block: int | None = DEFAULT_QUANT_BLOCK,
+                          ef: bool = False):
     """One fused program: pack → (quantize?) → allreduce → unpack.
 
     ``shapes``: per-device payload shapes of the bucket's leaves, in
     slot order. The whole thing is a single jit'd shard_map, so the
     bucket costs ONE collective launch (two wire legs under int8)
     regardless of leaf count.
+
+    ``ef`` (int8 wire only): the program takes a second set of stacked
+    per-leaf residual operands, adds them into the contribution before
+    quantizing, and returns updated residuals (stacked layout) after
+    the reduced leaves — error feedback fused into the same launch.
     """
     in_specs = tuple(P(axis, *(None,) * len(s)) for s in shapes)
     out_specs = tuple(P(*(None,) * len(s)) for s in shapes)
+    if ef:
+        in_specs = in_specs + in_specs
+        out_specs = out_specs + tuple(
+            P(axis, *(None,) * len(s)) for s in shapes)
     offs = []
     off = 0
     for s in shapes:
@@ -472,14 +599,13 @@ def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
         off += size
 
     def f(*locals_):
-        parts = [jnp.squeeze(x, axis=0).reshape(-1) for x in locals_]
-        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((pad,), flat.dtype)])
+        flat = _pack_flat(locals_[:len(shapes)], pad)
         if wire == "int8":
-            red = _int8_all_reduce_body(flat, axis, op)
+            res = _pack_flat(locals_[len(shapes):], pad) if ef else None
+            red, new_res = _int8_all_reduce_body(flat, axis, op,
+                                                 q_block, res)
         else:
+            new_res = None
             w = flat.astype(jnp.bfloat16) if wire == "bf16" else flat
             if op == "sum":
                 red = lax.psum(w, axis)
@@ -494,7 +620,15 @@ def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
         # whatever the lax op produces (pmean promotes ints to float).
         if restore:
             red = red.astype(jnp.dtype(dtype))
-        return _unpack(red, offs)
+        out = _unpack(red, offs)
+        if not ef:
+            return out
+        # ef is armed only for int8 buckets (the stream layer's
+        # contract) — the body always produced a residual. Zeroing a
+        # missing one here would silently WIPE carried error, so fail
+        # loudly at trace time instead.
+        assert new_res is not None, "ef requires the int8 wire"
+        return out + tuple(r[None] for r in _unpack(new_res, offs))
 
     return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False))
@@ -503,19 +637,16 @@ def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
 @functools.lru_cache(maxsize=512)
 def _bucket_reduce_scatter_fn(mesh: Mesh, axis: str, op: str,
                               shapes: tuple, dtype: str, pad: int,
-                              wire: str | None, restore: bool):
+                              wire: str | None, restore: bool,
+                              q_block: int | None = DEFAULT_QUANT_BLOCK):
     """Pack → (quantize?) → reduce-scatter; each device keeps one flat
     ``elems/n`` shard of the bucket (half the allreduce's ICI bytes)."""
     in_specs = tuple(P(axis, *(None,) * len(s)) for s in shapes)
 
     def f(*locals_):
-        parts = [jnp.squeeze(x, axis=0).reshape(-1) for x in locals_]
-        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((pad,), flat.dtype)])
+        flat = _pack_flat(locals_, pad)
         if wire == "int8":
-            shard = _int8_phase1(flat, axis, op)
+            shard, _ = _int8_phase1(flat, axis, op, q_block)
         else:
             w = flat.astype(jnp.bfloat16) if wire == "bf16" else flat
             shard = lax.psum_scatter(w, axis, scatter_dimension=0,
@@ -542,19 +673,25 @@ def _place_stacked(leaves, mesh: Mesh, axis: str):
     return jax.device_put(leaves, shardings)
 
 
-def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
-                        op: str = "sum", *,
-                        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                        compress: str | None = None,
-                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES) -> list:
-    """Allreduce a flat list of stacked leaves through dtype buckets.
+def bucketed_all_reduce_stream(leaves, mesh: Mesh, axis: str = "data",
+                               op: str = "sum", *,
+                               bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                               compress: str | None = None,
+                               int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
+                               q_block: int | None = DEFAULT_QUANT_BLOCK,
+                               residuals: list | None = None):
+    """Generator core of :func:`bucketed_all_reduce`: dispatches one
+    fused collective per bucket and yields
+    ``(bucket, reduced_by_slot, new_residuals_by_slot | None)`` right
+    after that bucket's dispatch — the T3-style consumption surface
+    (PAPERS.md arXiv 2401.16677): a caller can commit / apply the
+    optimizer on bucket i while buckets i+1.. are still reducing.
+    All results are async jax arrays; nothing here blocks.
 
-    Numerically identical to per-leaf :func:`all_reduce` on the exact
-    path (same psum, different operand fusion); under ``compress`` the
-    wire format resolves per bucket (:func:`_bucket_wire`). Buckets
-    dispatch without any intervening sync, so every bucket's collective
-    is in flight before the first result is consumed. Returns reduced
-    leaves (shape ``rest``) in input order.
+    ``residuals``: per-leaf stacked error-feedback residuals aligned
+    with ``leaves`` (entries may be None → zeros). Residuals engage
+    only on buckets whose wire resolves to int8; other buckets yield
+    ``None`` and the caller keeps its residuals untouched.
     """
     if op not in _REDUCERS:
         raise ValueError(f"bucketed_all_reduce: op must be one of "
@@ -566,31 +703,75 @@ def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
     n = int(mesh.shape[axis])
     buckets = plan_buckets(leaves, n, bucket_bytes)
     placed = _place_stacked(leaves, mesh, axis)
-    out: list = [None] * len(leaves)
     for b in buckets:
+        wire = _bucket_wire(b, op, compress, int8_min_bytes)
+        ef = wire == "int8" and residuals is not None
         fn = _bucket_all_reduce_fn(
             mesh, axis, op, tuple(s.shape for s in b.slots), b.dtype,
-            b.pad, _bucket_wire(b, op, compress, int8_min_bytes),
-            compress is not None)
-        reduced = fn(*[placed[s.index] for s in b.slots])
+            b.pad, wire, compress is not None, q_block, ef)
+        args = [placed[s.index] for s in b.slots]
+        if ef:
+            args += _place_stacked(
+                [residuals[s.index]
+                 if residuals[s.index] is not None
+                 and tuple(residuals[s.index].shape)
+                 == tuple(leaves[s.index].shape)
+                 else jnp.zeros_like(leaves[s.index])
+                 for s in b.slots], mesh, axis)
+        outs = fn(*args)
         _count_launch()
-        for s, r in zip(b.slots, reduced):
+        L = len(b.slots)
+        yield b, list(outs[:L]), (list(outs[L:]) if ef else None)
+
+
+def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
+                        op: str = "sum", *,
+                        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                        compress: str | None = None,
+                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
+                        q_block: int | None = DEFAULT_QUANT_BLOCK,
+                        residuals: list | None = None):
+    """Allreduce a flat list of stacked leaves through dtype buckets.
+
+    Numerically identical to per-leaf :func:`all_reduce` on the exact
+    path (same psum, different operand fusion); under ``compress`` the
+    wire format resolves per bucket (:func:`_bucket_wire`) and int8
+    payloads carry one scale per ``q_block`` elements. Buckets
+    dispatch without any intervening sync, so every bucket's
+    collective is in flight before the first result is consumed.
+
+    Returns reduced leaves (shape ``rest``) in input order; when
+    ``residuals`` is given, returns ``(reduced, new_residuals)`` where
+    ``new_residuals[i]`` is the updated error-feedback residual for
+    leaves that rode an int8 bucket and the input residual otherwise.
+    """
+    out: list = [None] * len(leaves)
+    new_res = list(residuals) if residuals is not None else None
+    for b, reduced, res in bucketed_all_reduce_stream(
+            leaves, mesh, axis, op, bucket_bytes=bucket_bytes,
+            compress=compress, int8_min_bytes=int8_min_bytes,
+            q_block=q_block, residuals=residuals):
+        for i, (s, r) in enumerate(zip(b.slots, reduced)):
             out[s.index] = r
-    return out
+            if res is not None:
+                new_res[s.index] = res[i]
+    return out if residuals is None else (out, new_res)
 
 
 def tree_all_reduce(stacked_tree, mesh: Mesh, axis: str = "data",
                     op: str = "sum", *,
                     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                     compress: str | None = None,
-                    int8_min_bytes: int = INT8_MIN_BUCKET_BYTES):
+                    int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
+                    q_block: int | None = DEFAULT_QUANT_BLOCK):
     """Bucketed allreduce over a whole pytree of stacked contributions
     — the fused lowering of "push every leaf" (one collective per
     bucket, not per leaf). Returns the tree of reduced leaves."""
     leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
     reduced = bucketed_all_reduce(
         leaves, mesh, axis, op, bucket_bytes=bucket_bytes,
-        compress=compress, int8_min_bytes=int8_min_bytes)
+        compress=compress, int8_min_bytes=int8_min_bytes,
+        q_block=q_block)
     return jax.tree_util.tree_unflatten(treedef, reduced)
 
 
@@ -628,7 +809,8 @@ def tree_reduce_scatter(stacked_tree, mesh: Mesh, axis: str = "data",
                         op: str = "sum", *,
                         bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                         compress: str | None = None,
-                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES
+                        int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
+                        q_block: int | None = DEFAULT_QUANT_BLOCK
                         ) -> ScatteredTree:
     """Bucketed reduce-scatter over a pytree: half the allreduce's ICI
     bytes, each device left holding one flat shard per bucket. Pad to
@@ -651,10 +833,84 @@ def tree_reduce_scatter(stacked_tree, mesh: Mesh, axis: str = "data",
         fn = _bucket_reduce_scatter_fn(
             mesh, axis, op, tuple(s.shape for s in b.slots), b.dtype,
             b.pad, _bucket_wire(b, op, compress, int8_min_bytes),
-            compress is not None)
+            compress is not None, q_block)
         shards.append((b, fn(*[placed[s.index] for s in b.slots])))
         _count_launch()
     return ScatteredTree(treedef, shards, mesh, axis, len(leaves))
+
+
+# ------------------------------------------------ host-side wire codec
+#
+# The same block-scaled int8 + error-feedback wire, applied per leaf on
+# the HOST side — for gradients that ride a TCP RPC instead of an ICI
+# collective (the async param-server push, train/param_server.py).
+# Format is codec-marshallable (dicts + arrays), ~4× fewer wire bytes.
+
+_Q8_KEY = "__ptype_q8__"
+
+
+def quantize_leaf(x, q_block: int | None = DEFAULT_QUANT_BLOCK,
+                  residual=None, *, want_residual: bool = True):
+    """Block-scaled int8 encoding of one array (+ optional EF residual
+    added in before quantizing). Returns ``(wire_dict, new_residual)``;
+    non-float arrays pass through unquantized (``new_residual=None``).
+    ``want_residual=False`` skips the dequantize+subtract entirely —
+    a feedback-disarmed caller must not pay for a residual it
+    discards."""
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating) or x.size == 0:
+        return {_Q8_KEY: 0, "raw": x}, None
+    flat = x.astype(jnp.float32).reshape(1, -1)
+    if residual is not None and residual.size == x.size:
+        flat = flat + residual.reshape(1, -1).astype(jnp.float32)
+    q, scale = _q_int8_blockwise(flat, q_block)
+    new_res = None
+    if want_residual:
+        new_res = (flat - _dq_int8_blockwise(q, scale, flat.shape[1])
+                   ).reshape(x.shape).astype(x.dtype)
+    return {_Q8_KEY: 1, "q": q[0], "s": scale[0],
+            "shape": list(x.shape), "dtype": str(x.dtype)}, new_res
+
+
+def dequantize_leaf(wire: dict):
+    """Inverse of :func:`quantize_leaf`."""
+    if not wire.get(_Q8_KEY):
+        return wire["raw"]
+    n = 1
+    for d in wire["shape"]:
+        n *= int(d)
+    out = _dq_int8_blockwise(jnp.asarray(wire["q"])[None],
+                             jnp.asarray(wire["s"])[None], n)
+    return out.reshape(wire["shape"]).astype(jnp.dtype(wire["dtype"]))
+
+
+def quantize_tree(tree, q_block: int | None = DEFAULT_QUANT_BLOCK,
+                  residuals: list | None = None, *,
+                  want_residuals: bool = True):
+    """Encode a pytree for the RPC wire: ``({"__ptype_q8_tree__":
+    [leaf wires in tree_flatten order]}, new_residuals)``. The
+    receiver reassembles with its own treedef
+    (:func:`dequantize_tree`) — both ends of a param-server push
+    already share the parameter structure."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    wires, new_res = [], []
+    for i, leaf in enumerate(leaves):
+        r = residuals[i] if residuals is not None else None
+        w, nr = quantize_leaf(leaf, q_block, r,
+                              want_residual=want_residuals)
+        wires.append(w)
+        new_res.append(nr)
+    return {"__ptype_q8_tree__": wires}, new_res
+
+
+def is_quantized_tree(obj) -> bool:
+    return isinstance(obj, dict) and "__ptype_q8_tree__" in obj
+
+
+def dequantize_tree(obj, treedef):
+    """Decode :func:`quantize_tree` output back into ``treedef``."""
+    leaves = [dequantize_leaf(w) for w in obj["__ptype_q8_tree__"]]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def measure_allreduce_gbps(mesh: Mesh, axis: str = "data",
@@ -681,3 +937,54 @@ def measure_allreduce_gbps(mesh: Mesh, axis: str = "data",
     # Ring allreduce moves 2*(n-1)/n of the buffer per device.
     bytes_moved = 2 * (n - 1) / n * elems * 4
     return bytes_moved / dt / 1e9
+
+
+def measure_wire_gbps(mesh: Mesh, axis: str = "data", mbytes: int = 32,
+                      iters: int = 5,
+                      blocks: tuple = (256, 512, 1024)) -> dict:
+    """Algorithmic bandwidth of one bucketed allreduce under each wire
+    format — fp32 (exact) vs PR 1's per-chunk-scale int8 vs the
+    block-scaled int8 wire at several block sizes. The bench.py
+    ``store_wire_gbps`` probe and the PERF.md block-size sweep.
+
+    GB/s is app-level (f32 payload bytes reduced per second, ring
+    convention 2(n-1)/n), so a wire that spends less time on the same
+    payload scores higher whatever bytes it moved. ``wire_bytes_pct``
+    is the analytic wire footprint of each int8 format vs fp32."""
+    import time
+
+    n = int(mesh.shape[axis])
+    elems = mbytes * 1024 * 1024 // 4
+    leaf = jax.device_put(
+        jnp.ones((n, elems), jnp.float32) * 0.5,
+        NamedSharding(mesh, P(axis, None)))
+    app_bytes = 2 * (n - 1) / n * elems * 4
+
+    def timed(compress, q_block):
+        def run():
+            return bucketed_all_reduce(
+                [leaf], mesh, axis, "sum", compress=compress,
+                int8_min_bytes=0, q_block=q_block)[0]
+
+        run().block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run()
+        out.block_until_ready()
+        return round(app_bytes / ((time.perf_counter() - t0) / iters)
+                     / 1e9, 3)
+
+    def wire_pct(q_block):
+        if q_block is None:
+            q_block = elems // n
+        return round(100.0 * (elems + elems / q_block * 4)
+                     / (elems * 4), 2)
+
+    return {
+        "payload_mb": mbytes,
+        "fp32_gbps": timed(None, None),
+        "int8_chunk_gbps": timed("int8", None),
+        "int8_chunk_wire_pct": wire_pct(None),
+        "int8_block_gbps": {str(b): timed("int8", b) for b in blocks},
+        "int8_block_wire_pct": {str(b): wire_pct(b) for b in blocks},
+    }

@@ -86,6 +86,35 @@ class _Entry:
     value: jax.Array
     epoch: int = 0
     binding: Binding = field(default_factory=Binding)
+    #: Store-wide monotonic write stamp — lets a caller that itself
+    #: wrote the key detect EXTERNAL mutations without re-pulling
+    #: (epoch can't: put() resets it, so two writers look identical).
+    seq: int = 0
+
+
+@dataclass
+class BucketPush:
+    """One dispatched bucket of a streamed :meth:`TensorStore.
+    push_tree_stream`: the committed per-key views (async jax arrays),
+    plus a :meth:`wait` that blocks on them inside a
+    ``store.push_wait`` region — so the time a consumer actually
+    spends waiting on this bucket's collective lands in the goodput
+    ledger's collective leg, not in untracked compute."""
+
+    prefix: str
+    keys: list
+    values: list
+
+    def items(self):
+        return zip(self.keys, self.values)
+
+    def wait(self) -> "BucketPush":
+        from ptype_tpu.metrics import annotate
+
+        with annotate(f"store.push_wait/{self.prefix}"):
+            for v in self.values:
+                v.block_until_ready()
+        return self
 
 
 class TensorStore:
@@ -93,18 +122,33 @@ class TensorStore:
 
     def __init__(self, mesh: Mesh, axis: str = "data",
                  kv: KVStore | None = None, namespace: str = "params",
-                 compress: str | None = None):
-        if compress not in (None, "bf16", "int8"):
-            raise ValueError(f"TensorStore: unknown compression {compress!r}")
+                 compress: str | None = None,
+                 wire: collectives.WireConfig | None = None):
+        if (wire is not None and compress is not None
+                and compress != wire.compress):
+            raise ValueError(
+                f"TensorStore: conflicting compress={compress!r} and "
+                f"wire.compress={wire.compress!r} — pass one")
+        self.wire = (wire if wire is not None
+                     else collectives.WireConfig(compress=compress))
         self.mesh = mesh
         self.axis = axis
         self.namespace = namespace
-        self.compress = compress
+        self.compress = self.wire.compress
         self._kv = kv
         self._entries: dict[str, _Entry] = {}
         self._bindings: dict[str, Binding] = {}
         self._lock = threading.RLock()
         self._manifest_failed: set[str] = set()
+        #: Per-key error-feedback residuals (stacked layout) for the
+        #: int8 wire — each pushing process carries its own local
+        #: quantization error into its next contribution.
+        self._residuals: dict[str, jax.Array] = {}
+        self._seq = 0
+        #: prefix → highest write stamp under it (every "/"-ancestor
+        #: of each written key) — tree_seq in O(1) instead of an
+        #: all-entries scan under the lock on every cache check.
+        self._prefix_seq: dict[str, int] = {}
 
     # ---------------------------------------------------------- bindings
 
@@ -140,7 +184,8 @@ class TensorStore:
         with self._lock:
             if spec is not None:
                 self._bindings[key] = b
-            self._entries[key] = _Entry(arr, epoch, b)
+            self._entries[key] = _Entry(arr, epoch, b,
+                                        self._stamp(key))
         self._publish(key)
         return arr
 
@@ -171,6 +216,8 @@ class TensorStore:
             if key not in self._entries:
                 raise NoKeyError(key)
             del self._entries[key]
+            self._stamp(key)  # a deletion is a mutation: cached
+            #                   readers must notice and re-pull
         if self._kv is not None:
             try:
                 self._kv.delete(self._manifest_key(key))
@@ -188,43 +235,65 @@ class TensorStore:
             raise NoKeyError(key)
         return entry.epoch
 
+    def tree_seq(self, prefix: str) -> int:
+        """Highest store-wide write stamp under ``prefix/`` (0 when
+        never written; deletions bump it too — they are mutations). A
+        caller that recorded this after its own put_tree can cheaply
+        detect whether ANY other writer has since touched the
+        namespace — the re-pull guard train/store_dp.py uses instead
+        of a full get_tree every step."""
+        with self._lock:
+            return self._prefix_seq.get(prefix, 0)
+
+    def _stamp(self, key: str) -> int:
+        """Bump the store write stamp and index it under every
+        "/"-ancestor of ``key``; callers hold the lock."""
+        self._seq += 1
+        parts = key.split("/")
+        for i in range(1, len(parts)):
+            self._prefix_seq["/".join(parts[:i])] = self._seq
+        return self._seq
+
     # ------------------------------------------------------------- push
 
     def push(self, key: str, stacked, op: str | None = None) -> jax.Array:
         """Reduce per-worker contributions into the key — the allreduce
         lowering of Store.Put (north star). ``stacked``'s leading dim is
         the contribution axis (== mesh axis size); the reduced tensor is
-        stored under the key's binding and returned."""
+        stored under the key's binding and returned.
+
+        Rides the same single-bucket fused program as the tree pushes,
+        so the wire policy is uniform across every push path: the int8
+        wire is block-scaled, the bucket pad removes the per-leaf
+        ``rest[0] % n`` eligibility lottery (the size floor
+        ``int8_min_bytes`` still routes small leaves exact), and an
+        armed error-feedback residual is carried per key here too —
+        EF must not silently vanish because a caller used the per-key
+        API instead of push_tree."""
         from ptype_tpu.metrics import annotate
 
         b = self.binding(key)
         op = op or b.reduce_op
         stacked = jnp.asarray(stacked)
-        n = int(self.mesh.shape[self.axis])
-        use_int8 = (self.compress == "int8"
-                    and collectives.quantized_all_reduce_eligible(
-                        stacked.shape, n, op))
         with annotate(f"store.push/{key}"):
             # Fault seam INSIDE the region: a chaos straggler delay
             # must be attributed to the collective leg of the goodput
             # breakdown, exactly like a real slow allreduce.
             _store_fault("store.push", key)
-            if use_int8:
-                reduced = collectives.quantized_all_reduce(
-                    stacked, self.mesh, self.axis, op)
-            else:
-                # int8-ineligible leaves (scalars, short vectors,
-                # max/min ops) ride the EXACT allreduce — the caller
-                # opted into int8 loss, not into bf16 loss.
-                wire = (stacked.astype(jnp.bfloat16)
-                        if self.compress == "bf16" else stacked)
-                reduced = collectives.all_reduce(
-                    wire, self.mesh, self.axis, op)
-        if self.compress:
-            reduced = reduced.astype(stacked.dtype)
-        if b.spec != P():
-            reduced = jax.device_put(reduced, NamedSharding(self.mesh, b.spec))
-        return self._commit(key, reduced, b)
+            items = [(key, stacked)]
+            res = self._group_residuals(items)
+            try:
+                outs = collectives.bucketed_all_reduce(
+                    [stacked], self.mesh, self.axis, op, residuals=res,
+                    **self._wire_kwargs(None))
+            except BaseException:
+                self._restore_residuals(items, res)
+                raise
+            if res is not None:
+                outs, new_res = outs
+                self._store_residuals(items, new_res)
+            reduced = outs[0]
+        return self._commit_reduced(key, reduced)
 
     def push_scatter(self, key: str, stacked, op: str | None = None) -> jax.Array:
         """Reduce-scatter variant: each device keeps one shard of the
@@ -239,7 +308,8 @@ class TensorStore:
                 and collectives.quantized_all_reduce_eligible(
                     stacked.shape, n, b.reduce_op)):
             reduced = collectives.quantized_reduce_scatter(
-                stacked, self.mesh, self.axis, b.reduce_op)
+                stacked, self.mesh, self.axis, b.reduce_op,
+                q_block=self.wire.q_block)
         else:
             # int8-ineligible leaves ride the exact allreduce — the
             # caller opted into int8 loss, not bf16 loss.
@@ -255,20 +325,25 @@ class TensorStore:
         with self._lock:
             prev = self._entries.get(key)
             epoch = (prev.epoch + 1) if prev else 1
-            self._entries[key] = _Entry(value, epoch, b)
+            self._entries[key] = _Entry(value, epoch, b,
+                                        self._stamp(key))
         self._publish(key)
         chaos.note_ok("store.push", key)
         return value
 
     # -------------------------------------------------------------- tree
 
-    def put_tree(self, prefix: str, tree) -> None:
+    def put_tree(self, prefix: str, tree) -> int:
         """Place every leaf under its path-derived key (no collective).
 
         All host→device transfers dispatch through ONE batched
         device_put instead of a per-leaf loop, then each key commits
         with the same epoch-0/binding/manifest semantics as
-        :meth:`put`."""
+        :meth:`put`. Returns the highest write stamp THIS call
+        assigned — the stamp a caller records to detect external
+        writers via :meth:`tree_seq` (re-reading the global max after
+        the fact would absorb a concurrent writer's stamp and hide
+        their write)."""
         pairs = _flatten(prefix, tree)
         bindings = [self.binding(key) for key, _ in pairs]
         arrs = jax.device_put(
@@ -276,9 +351,11 @@ class TensorStore:
             [NamedSharding(self.mesh, b.spec) for b in bindings])
         with self._lock:
             for (key, _), b, arr in zip(pairs, bindings, arrs):
-                self._entries[key] = _Entry(arr, 0, b)
+                self._entries[key] = _Entry(arr, 0, b, self._stamp(key))
+            assigned = self._seq
         for key, _ in pairs:
             self._publish(key)
+        return assigned
 
     def push_tree(self, prefix: str, stacked_tree, op: str | None = None,
                   *, bucketed: bool = True,
@@ -307,13 +384,7 @@ class TensorStore:
             return {key: self.push(key, leaf, op) for key, leaf in pairs}
 
         t0 = _time.perf_counter()
-        # Group by resolved reduce op (dtype grouping happens inside
-        # the bucket planner); op=None honors each key's binding.
-        groups: dict[str, list[tuple[str, jax.Array]]] = {}
-        for key, leaf in pairs:
-            resolved = op or self.binding(key).reduce_op
-            groups.setdefault(resolved, []).append(
-                (key, jnp.asarray(leaf)))
+        groups = self._push_groups(pairs, op)
         reduced: dict[str, jax.Array] = {}
         with annotate(f"store.push_tree/{prefix}"):
             # Fault seam INSIDE the region (see push): a straggler
@@ -321,12 +392,18 @@ class TensorStore:
             # and on the push_tree span, not in untracked step time.
             _store_fault("store.push", prefix)
             for group_op, items in groups.items():
-                outs = collectives.bucketed_all_reduce(
-                    [leaf for _, leaf in items], self.mesh, self.axis,
-                    group_op,
-                    bucket_bytes=(bucket_bytes
-                                  or collectives.DEFAULT_BUCKET_BYTES),
-                    compress=self.compress)
+                res = self._group_residuals(items)
+                try:
+                    outs = collectives.bucketed_all_reduce(
+                        [leaf for _, leaf in items], self.mesh,
+                        self.axis, group_op, residuals=res,
+                        **self._wire_kwargs(bucket_bytes))
+                except BaseException:
+                    self._restore_residuals(items, res)
+                    raise
+                if res is not None:
+                    outs, new_res = outs
+                    self._store_residuals(items, new_res)
                 for (key, _), out in zip(items, outs):
                     reduced[key] = out
         # Commit the unpacked views: reshard keys with non-replicated
@@ -346,6 +423,150 @@ class TensorStore:
         metrics.counter("store.push_tree.leaves").add(len(pairs))
         chaos.note_ok("store.push", prefix)
         return out
+
+    def _push_groups(self, pairs, op: str | None):
+        """Group (key, leaf) pairs by resolved reduce op (dtype
+        grouping happens inside the bucket planner); op=None honors
+        each key's binding — shared by the barrier and streamed push
+        paths so key/op resolution cannot drift between them."""
+        groups: dict[str, list[tuple[str, jax.Array]]] = {}
+        for key, leaf in pairs:
+            resolved = op or self.binding(key).reduce_op
+            groups.setdefault(resolved, []).append(
+                (key, jnp.asarray(leaf)))
+        return groups
+
+    def _wire_kwargs(self, bucket_bytes: int | None) -> dict:
+        return {
+            "bucket_bytes": bucket_bytes or self.wire.bucket_bytes,
+            "compress": self.compress,
+            "int8_min_bytes": self.wire.int8_min_bytes,
+            "q_block": self.wire.q_block,
+        }
+
+    def _commit_reduced(self, key: str, out: jax.Array) -> jax.Array:
+        """Reshard to the key's binding (if any) and commit — the
+        per-key tail both push paths share."""
+        kb = self.binding(key)
+        if kb.spec != P():
+            out = jax.device_put(out, NamedSharding(self.mesh, kb.spec))
+        return self._commit(key, out, kb)
+
+    def _group_residuals(self, items) -> list | None:
+        """Per-leaf EF residuals for one push group (None when the
+        wire doesn't carry feedback). Missing/stale-shape entries stay
+        None — the collectives layer seeds zeros.
+
+        Residuals are POPPED, not read: taking ownership under the
+        lock means a concurrent pusher of the same key folds zeros
+        instead of double-applying the same accumulated error (each
+        concurrent push then writes back its own fresh residual)."""
+        if not self.wire.feedback_armed:
+            return None
+        with self._lock:
+            return [self._residuals.pop(key, None) for key, _ in items]
+
+    def _store_residuals(self, items, new_res: list) -> None:
+        with self._lock:
+            for (key, _), r in zip(items, new_res):
+                if r is not None:
+                    self._residuals[key] = r
+
+    def _restore_residuals(self, items, popped: list | None) -> None:
+        """Put popped-but-unconsumed residuals back (a push that
+        failed between pop and store-back must not drop the
+        accumulated error). setdefault: never clobber a fresher
+        residual a concurrent pusher wrote meanwhile."""
+        if popped is None:
+            return
+        with self._lock:
+            for (key, _), r in zip(items, popped):
+                if r is not None:
+                    self._residuals.setdefault(key, r)
+
+    def push_tree_iter(self, prefix: str, stacked_tree,
+                       op: str | None = None, *,
+                       bucket_bytes: int | None = None):
+        """The fine-grained-overlap variant of :meth:`push_tree`
+        (T3 pattern, PAPERS.md): a generator that dispatches ONE
+        bucket's fused collective per iteration, commits its keys, and
+        yields the :class:`BucketPush` — so a consumer can interleave
+        its own dispatches (per-bucket optimizer apply) and waits with
+        the remaining buckets' dispatches, putting reduce i+1 on the
+        wire while bucket i is being consumed. Same per-key
+        epoch/manifest/residual semantics as push_tree."""
+        from ptype_tpu.metrics import annotate, metrics
+
+        pairs = _flatten(prefix, stacked_tree)
+        t0 = _time.perf_counter()
+        groups = self._push_groups(pairs, op)
+        # Each bucket's dispatch+commit runs in its OWN annotate region
+        # (not one region held open across yields): the consumer's
+        # work between buckets — optimizer applies, waits — must land
+        # in its own legs of the goodput breakdown, not inflate the
+        # collective leg here.
+        first = True
+        for group_op, items in groups.items():
+            res = self._group_residuals(items)
+            # The pop in _group_residuals took ownership of every
+            # carried residual in the group — track the ones no int8
+            # bucket has consumed yet, and RESTORE them when the
+            # stream ends (or is abandoned mid-way): a bucket whose
+            # wire resolved exact, or one the consumer never drained,
+            # must not silently lose its accumulated error.
+            pending = ({i: r for i, r in enumerate(res)
+                        if r is not None} if res is not None else {})
+            try:
+                it = collectives.bucketed_all_reduce_stream(
+                    [leaf for _, leaf in items], self.mesh,
+                    self.axis, group_op, residuals=res,
+                    **self._wire_kwargs(bucket_bytes))
+                while True:
+                    with annotate(f"store.push_tree/{prefix}"):
+                        if first:
+                            # Fault seam INSIDE the region (see push):
+                            # a straggler delay lands in the
+                            # collective leg.
+                            _store_fault("store.push", prefix)
+                            first = False
+                        try:
+                            b, outs, new_res = next(it)
+                        except StopIteration:
+                            break
+                        keys, vals = [], []
+                        for i, (s, out) in enumerate(zip(b.slots, outs)):
+                            key = items[s.index][0]
+                            vals.append(self._commit_reduced(key, out))
+                            keys.append(key)
+                            if new_res is not None:
+                                pending.pop(s.index, None)
+                                if new_res[i] is not None:
+                                    with self._lock:
+                                        self._residuals[key] = new_res[i]
+                        handle = BucketPush(prefix, keys, vals)
+                    yield handle
+            finally:
+                if pending:
+                    with self._lock:
+                        for i, r in pending.items():
+                            # setdefault: never clobber a fresher
+                            # residual a concurrent pusher wrote.
+                            self._residuals.setdefault(items[i][0], r)
+        metrics.timing("store.push_tree").observe(
+            _time.perf_counter() - t0)
+        metrics.counter("store.push_tree.leaves").add(len(pairs))
+        chaos.note_ok("store.push", prefix)
+
+    def push_tree_stream(self, prefix: str, stacked_tree,
+                         op: str | None = None, *,
+                         bucket_bytes: int | None = None
+                         ) -> list[BucketPush]:
+        """:meth:`push_tree_iter` drained eagerly: every bucket
+        dispatched and committed, handles returned in bucket order —
+        for consumers that want all collectives in flight before the
+        first wait."""
+        return list(self.push_tree_iter(prefix, stacked_tree, op,
+                                        bucket_bytes=bucket_bytes))
 
     def get_tree(self, prefix: str,
                  gather: bool = False) -> dict[str, jax.Array]:
@@ -472,7 +693,8 @@ def _path_part(p) -> str:
 def measure_push_tree(mesh: Mesh, axis: str = "data",
                       preset: str = "tiny", iters: int = 3,
                       compress: str | None = None,
-                      bucket_bytes: int | None = None) -> dict:
+                      bucket_bytes: int | None = None,
+                      wire: collectives.WireConfig | None = None) -> dict:
     """Wall-clock a full param-tree gradient push, bucketed vs
     per-leaf — the BENCH ``store_push_tree_ms`` metric.
 
@@ -493,7 +715,7 @@ def measure_push_tree(mesh: Mesh, axis: str = "data",
             jnp.broadcast_to(p[None], (n, *p.shape)),
             NamedSharding(mesh, P(axis, *(None,) * p.ndim))),
         params)
-    store = TensorStore(mesh, axis, compress=compress)
+    store = TensorStore(mesh, axis, compress=compress, wire=wire)
     leaves = jax.tree_util.tree_leaves(params)
     nbytes = sum(v.size * v.dtype.itemsize for v in leaves)
 

@@ -29,6 +29,7 @@ from typing import Any
 import jax
 
 from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel import collectives
 from ptype_tpu.parallel.tensorstore import TensorStore
 from ptype_tpu.train.trainer import default_optimizer, make_apply_fn
 
@@ -55,11 +56,19 @@ class ParamServer:
 
     def __init__(self, cfg: tfm.TransformerConfig, store: TensorStore,
                  optimizer=None, rng: jax.Array | None = None,
-                 max_staleness: int = 8):
+                 max_staleness: int = 8,
+                 wire: collectives.WireConfig | None = None):
         self.cfg = cfg
         self.store = store
         self.optimizer = optimizer or default_optimizer()
         self.max_staleness = max_staleness
+        #: Wire policy for grad pushes over the RPC tier: when int8,
+        #: Push accepts block-scaled quantized trees
+        #: (collectives.quantize_tree — ≈4× fewer TCP bytes) and
+        #: dequantizes before the optimizer. Defaults to the store's
+        #: wire, so one config covers collective AND RPC gradients.
+        self.wire = wire if wire is not None else store.wire
+        self._quantized = 0
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         params = jax.jit(lambda r: tfm.init_params(r, cfg))(rng)
@@ -84,21 +93,43 @@ class ParamServer:
 
     def Push(self, grads: Any, version: int) -> dict:
         """Apply one worker's grads (the un-barriered Put). ``version``
-        is the parameter version the grads were computed against."""
+        is the parameter version the grads were computed against.
+        ``grads`` may be a plain pytree or a quantized wire tree
+        (:func:`collectives.quantize_tree`) — the worker opted into
+        the int8 RPC wire; the server reassembles against its own
+        parameter structure."""
+        quantized = collectives.is_quantized_tree(grads)
+        if quantized:
+            # Staleness needs only the version integer — reject BEFORE
+            # paying the full-tree dequant (rejections cluster exactly
+            # when the server is hot). The authoritative check re-runs
+            # under the lock below; the version only grows, so this
+            # early verdict can never un-reject.
+            with self._lock:
+                self._check_staleness(version)
+            grads = collectives.dequantize_tree(grads, self._treedef)
         with self._lock:
-            staleness = self._version - int(version)
-            if staleness > self.max_staleness:
-                self._rejected += 1
-                raise StalePushError(
-                    f"push at version {version} is {staleness} behind "
-                    f"(max_staleness={self.max_staleness})"
-                )
+            staleness = self._check_staleness(version)
             self._params, self._opt_state = self._apply_fn(
                 self._params, grads, self._opt_state
             )
             self._version += 1
             self._applied += 1
+            if quantized:  # count APPLIED quantized pushes only —
+                self._quantized += 1  # rejected ones never trained
             return {"version": self._version, "staleness": staleness}
+
+    def _check_staleness(self, version: int) -> int:
+        """Raise (and count) when ``version`` is too far behind;
+        callers hold the lock. Returns the staleness."""
+        staleness = self._version - int(version)
+        if staleness > self.max_staleness:
+            self._rejected += 1
+            raise StalePushError(
+                f"push at version {version} is {staleness} behind "
+                f"(max_staleness={self.max_staleness})"
+            )
+        return staleness
 
     def Sync(self) -> dict:
         """Publish current params into the TensorStore namespace (for
@@ -116,6 +147,8 @@ class ParamServer:
                 "version": self._version,
                 "applied": self._applied,
                 "rejected": self._rejected,
+                "quantized": self._quantized,
+                "wire": self.wire.compress,
             }
 
 
@@ -126,12 +159,25 @@ class AsyncWorker:
     a balanced RPC client proxy (``client.call("ParamServer.Pull")``).
     """
 
-    def __init__(self, cfg: tfm.TransformerConfig, server, worker_id: int = 0):
+    def __init__(self, cfg: tfm.TransformerConfig, server, worker_id: int = 0,
+                 wire: collectives.WireConfig | None = None):
         self.cfg = cfg
         self.server = server
         self.worker_id = worker_id
         self.steps = 0
         self.stale_rejections = 0
+        #: Int8 wire for the grad push over RPC: block-scaled
+        #: quantization with a local error-feedback residual per leaf
+        #: (same EF contract as the collective wire — the quantization
+        #: error rides into the NEXT push instead of accumulating).
+        #: Only int8 is implemented on this tier — reject other
+        #: compressions loudly rather than silently pushing raw fp32.
+        if wire is not None and wire.compress not in (None, "int8"):
+            raise ValueError(
+                f"AsyncWorker: wire compress {wire.compress!r} is not "
+                f"implemented on the RPC tier (use 'int8' or None)")
+        self.wire = wire
+        self._residuals: list | None = None
         self._grads_fn = jax.jit(
             lambda params, batch: jax.value_and_grad(tfm.loss_fn)(
                 params, batch, cfg
@@ -141,9 +187,23 @@ class AsyncWorker:
     def step(self, batch: dict) -> dict:
         snap = self.server.Pull()
         loss, grads = self._grads_fn(snap["params"], batch)
+        prev_residuals = self._residuals
+        if self.wire is not None and self.wire.compress == "int8":
+            grads, res = collectives.quantize_tree(
+                grads, self.wire.q_block,
+                self._residuals if self.wire.error_feedback else None,
+                want_residuals=self.wire.error_feedback)
+            if self.wire.error_feedback:
+                self._residuals = res
         try:
             out = self.server.Push(grads, snap["version"])
         except Exception as e:  # noqa: BLE001 — see _is_stale
+            # ANY failed push dropped the wire that carried the
+            # accumulated EF error — restore the pre-push residual
+            # (stale rejections AND transport faults alike) or the
+            # carryover degrades to naive per-step quantization under
+            # exactly the churn that produces failures.
+            self._residuals = prev_residuals
             if not _is_stale(e):
                 raise
             self.stale_rejections += 1

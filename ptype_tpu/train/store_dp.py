@@ -16,6 +16,28 @@ semantics stay observable (epochs bump per push, manifests publish to the
 KV tier). The fully-fused GSPMD path in trainer.py is the throughput
 choice; this mode exists for Store-semantics parity + the async
 param-server family built on it (train/param_server.py).
+
+Gradient-exchange modes (``overlap``):
+
+- ``False`` (default): the legacy fully-async barrier step — push_tree
+  dispatches every bucket, the whole-tree optimizer apply consumes the
+  results, and nothing on the host blocks until the loss readback.
+- ``"drain"``: the synchronous-DDP accounting baseline — same step,
+  but the host waits out the collectives (``store.push_wait`` region)
+  before the apply, so the goodput ledger's collective leg carries the
+  reduce wall time. This is the honest "before" for the overlap
+  comparison.
+- ``True``: T3-style fine-grained overlap (PAPERS.md arXiv
+  2401.16677): buckets dispatch lazily through
+  ``TensorStore.push_tree_iter``, each bucket's wait interleaves with
+  the next bucket's dispatch + commit + the per-bucket optimizer
+  bookkeeping, and the optimizer applies per BUCKET (the default AdamW
+  recipe decomposed via ``trainer.default_optimizer_pieces``; the
+  global-norm clip — the recipe's one cross-bucket coupling — is
+  coordinated through per-bucket partial norms as a device value, so
+  the host never syncs for it). A custom ``optimizer`` falls back to
+  the whole-tree apply with streamed waits (an arbitrary optax chain
+  can't be split per bucket safely).
 """
 
 from __future__ import annotations
@@ -26,25 +48,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
-from ptype_tpu.train.trainer import default_optimizer, make_apply_fn
+from ptype_tpu.train.trainer import (_decay_mask, default_optimizer,
+                                     default_optimizer_pieces,
+                                     make_apply_fn)
+
+_OVERLAP_MODES = (False, "drain", True)
 
 
 class StoreDPTrainer:
     """Data-parallel trainer whose gradient exchange IS the Store."""
 
     def __init__(self, cfg: tfm.TransformerConfig, store: TensorStore,
-                 optimizer=None, rng: jax.Array | None = None):
+                 optimizer=None, rng: jax.Array | None = None,
+                 overlap=False):
+        if overlap not in _OVERLAP_MODES:
+            raise ValueError(
+                f"StoreDPTrainer: overlap must be one of "
+                f"{_OVERLAP_MODES}, got {overlap!r}")
         self.cfg = cfg
         self.store = store
         self.mesh: Mesh = store.mesh
         self.axis = store.axis
         self.n_workers = int(self.mesh.shape[self.axis])
+        self.overlap = overlap
+        self._custom_opt = optimizer is not None
         self.optimizer = optimizer or default_optimizer()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         params = jax.jit(lambda r: tfm.init_params(r, cfg))(rng)
-        self.opt_state = self.optimizer.init(params)
-        self.store.put_tree("params", params)
+        # overlap=True with the default recipe trains through
+        # _bucket_states, NOT this whole-tree state — leave it None so
+        # a consumer (checkpoint, mode switch) fails loudly instead of
+        # silently reading never-updated init moments.
+        self.opt_state = (None if overlap is True and not self._custom_opt
+                          else self.optimizer.init(params))
+        seed_seq = self.store.put_tree("params", params)
         self._treedef = jax.tree_util.tree_structure(params)
         # Keys in TREEDEF leaf order (tree_flatten_with_path order), NOT
         # the Store's string-sorted order — string sort permutes numeric
@@ -54,7 +92,21 @@ class StoreDPTrainer:
             "params/" + "/".join(_path_part(p) for p in path)
             for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]
         ]
+        self._key_index = {k: i for i, k in enumerate(self._keys)}
+        # The committed device views, kept locally: the trainer itself
+        # wrote them, so re-pulling the whole tree from the store every
+        # step is a pure round trip. tree_seq guards external mutation.
+        self._param_leaves = list(jax.tree_util.tree_leaves(params))
+        self._params_seq = seed_seq
         self.step_count = 0
+
+        # Per-bucket apply machinery (overlap=True, default recipe) —
+        # built lazily on the first step, when the bucket plan is known.
+        self._buckets: list[list[int]] | None = None
+        self._bucket_states: list | None = None
+        self._apply_fns: list | None = None
+        self._sqnorm_fns: list | None = None
+        self._scale_fn = None
 
         # Per-worker grad fn, vmapped over the stacked worker batch dim —
         # one compiled program computing every worker's local grads, laid
@@ -69,10 +121,19 @@ class StoreDPTrainer:
         self._apply_fn = make_apply_fn(self.optimizer)
 
     def params(self) -> dict:
+        """The current parameter tree. Served from the locally-kept
+        committed views — the store is only re-pulled when its write
+        stamp says some OTHER writer touched the namespace since this
+        trainer's own last put (external mutation / epoch mismatch)."""
+        seq = self.store.tree_seq("params")
+        if seq == self._params_seq and self._param_leaves is not None:
+            return jax.tree_util.tree_unflatten(
+                self._treedef, self._param_leaves)
         flat = self.store.get_tree("params")
+        self._param_leaves = [flat[k] for k in self._keys]
+        self._params_seq = seq
         return jax.tree_util.tree_unflatten(
-            self._treedef, [flat[k] for k in self._keys]
-        )
+            self._treedef, self._param_leaves)
 
     def step(self, batch: dict) -> dict:
         """One DP step. ``batch`` leaves are (B, S); B splits evenly into
@@ -96,7 +157,7 @@ class StoreDPTrainer:
         metrics.counter("train.steps").add(1)
         return out
 
-    def _step(self, batch: dict) -> dict:
+    def _stage(self, batch: dict):
         from ptype_tpu.metrics import annotate
 
         B = batch["tokens"].shape[0]
@@ -108,7 +169,7 @@ class StoreDPTrainer:
         # staging, attributed separately from compute/collective.
         with annotate("train.data"):
             sh = NamedSharding(self.mesh, P(self.axis, None, None))
-            stacked = {
+            return {
                 k: jax.device_put(
                     jnp.reshape(v,
                                 (self.n_workers, B // self.n_workers, -1)),
@@ -116,25 +177,51 @@ class StoreDPTrainer:
                 )
                 for k, v in batch.items()
             }
+
+    def _step(self, batch: dict) -> dict:
+        stacked = self._stage(batch)
         params = self.params()
         losses, grads = self._grads_fn(params, stacked)
 
-        # The gather: Store push == pmean allreduce over the data axis,
-        # bucketed — the whole grad tree reduces in ceil(bytes/bucket)
-        # fused launches per dtype group, all in flight before the
-        # optimizer consumes the first leaf. push_tree returns the
-        # committed views, so no second get_tree round trip.
-        reduced_flat = self.store.push_tree("grads", grads, op="mean")
-        reduced = jax.tree_util.tree_unflatten(
-            self._treedef,
-            [reduced_flat[k.replace("params/", "grads/", 1)]
-             for k in self._keys],
-        )
+        if self.overlap is True:
+            self._reduce_apply_overlapped(params, grads)
+        elif self.overlap == "drain":
+            # Synchronous-DDP accounting: every bucket dispatched, then
+            # waited out through BucketPush.wait (the one
+            # collective-attribution contract), so the goodput ledger's
+            # collective leg is the reduce wall time — the honest
+            # baseline the overlap mode shrinks.
+            handles = self.store.push_tree_stream("grads", grads,
+                                                  op="mean")
+            for h in handles:
+                h.wait()
+            reduced = self._tree_from_handles(handles)
+            new_params, self.opt_state = self._apply_fn(
+                params, reduced, self.opt_state)
+            self._param_leaves = list(
+                jax.tree_util.tree_leaves(new_params))
+            self._params_seq = self.store.put_tree("params", new_params)
+        else:
+            # The gather: Store push == pmean allreduce over the data
+            # axis, bucketed — the whole grad tree reduces in
+            # ceil(bytes/bucket) fused launches per dtype group, all in
+            # flight before the optimizer consumes the first leaf.
+            # push_tree returns the committed views directly.
+            reduced_flat = self.store.push_tree("grads", grads, op="mean")
+            reduced = jax.tree_util.tree_unflatten(
+                self._treedef,
+                [reduced_flat[k.replace("params/", "grads/", 1)]
+                 for k in self._keys])
+            new_params, self.opt_state = self._apply_fn(
+                params, reduced, self.opt_state
+            )
+            self._param_leaves = list(
+                jax.tree_util.tree_leaves(new_params))
+            # Stamp from the seqs OUR put assigned (not a re-read of
+            # the global max, which would absorb a concurrent external
+            # write into the cache stamp and hide it).
+            self._params_seq = self.store.put_tree("params", new_params)
 
-        new_params, self.opt_state = self._apply_fn(
-            params, reduced, self.opt_state
-        )
-        self.store.put_tree("params", new_params)
         self.step_count += 1
         return {
             "loss": float(jnp.mean(losses)),
@@ -142,5 +229,172 @@ class StoreDPTrainer:
             "grad_epoch": self.store.epoch(self._grad_key0()),
         }
 
+    # ---------------------------------------------- fine-grained overlap
+
+    def _reduce_apply_overlapped(self, params, grads) -> None:
+        """Consume the lazy bucket stream: bucket i's wait interleaves
+        with bucket i+1's dispatch/commit, then the optimizer applies
+        per bucket. The global-norm clip scale is a device value built
+        from per-bucket partial norms — no host sync on the clip."""
+        handles = []
+        sub_grads = []
+        sqs = []
+        prev = None
+        for h in self.store.push_tree_iter("grads", grads, op="mean"):
+            handles.append(h)
+            if self._sqnorm_fns is not None:
+                bi = len(handles) - 1
+                g = self._sub_grads(bi, h)
+                sub_grads.append(g)
+                sqs.append(self._sqnorm_fns[bi](g))
+            if prev is not None:
+                # Wait out the PREVIOUS bucket while this one (and its
+                # partial-norm compute) is in flight — the measured
+                # collective wait shrinks by exactly the overlapped
+                # host+device work.
+                prev.wait()
+            prev = h
+        if self._buckets is None:
+            # First step: the bucket plan is now known — build the
+            # per-bucket sub-optimizers, then redo the cheap bookkeeping.
+            self._init_bucket_apply(handles)
+            if self._sqnorm_fns is not None:
+                sub_grads = [self._sub_grads(bi, h)
+                             for bi, h in enumerate(handles)]
+                sqs = [fn(g) for fn, g in
+                       zip(self._sqnorm_fns, sub_grads)]
+        if prev is not None:
+            prev.wait()
+        if self._custom_opt:
+            # Arbitrary optimizer: whole-tree apply (streamed waits
+            # above still gave the ledger its collective attribution).
+            reduced = self._tree_from_handles(handles)
+            new_params, self.opt_state = self._apply_fn(
+                params, reduced, self.opt_state)
+            self._param_leaves = list(
+                jax.tree_util.tree_leaves(new_params))
+        else:
+            scale = self._scale_fn(jnp.stack(sqs))
+            for bi in range(len(handles)):
+                subp = {str(i): self._param_leaves[i]
+                        for i in self._buckets[bi]}
+                newp, self._bucket_states[bi] = self._apply_fns[bi](
+                    subp, sub_grads[bi], self._bucket_states[bi], scale)
+                for i in self._buckets[bi]:
+                    self._param_leaves[i] = newp[str(i)]
+        new_params = jax.tree_util.tree_unflatten(
+            self._treedef, self._param_leaves)
+        self._params_seq = self.store.put_tree("params", new_params)
+
+    def _grad_index(self, grad_key: str) -> int:
+        return self._key_index[grad_key.replace("grads/", "params/", 1)]
+
+    def _sub_grads(self, bi: int, h) -> dict:
+        return {str(self._grad_index(k)): v for k, v in h.items()}
+
+    def _tree_from_handles(self, handles):
+        leaves = [None] * len(self._keys)
+        for h in handles:
+            for k, v in h.items():
+                leaves[self._grad_index(k)] = v
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _init_bucket_apply(self, handles) -> None:
+        """Build the per-bucket optimizer machinery from the first
+        step's bucket plan: each bucket gets the default AdamW recipe
+        over its own param sub-tree (same schedule/decay-mask
+        semantics as ``default_optimizer`` — assembled from the same
+        pieces), plus a jitted partial-sqnorm fn; one jitted scale fn
+        coordinates the global-norm clip across buckets."""
+        self._buckets = [[self._grad_index(k) for k in h.keys]
+                         for h in handles]
+        if self._custom_opt:
+            return
+        import optax
+
+        clip, make_inner = default_optimizer_pieces()
+        mask_leaves = jax.tree_util.tree_leaves(
+            _decay_mask(jax.tree_util.tree_unflatten(
+                self._treedef, self._param_leaves)))
+        self._bucket_states, self._apply_fns, self._sqnorm_fns = [], [], []
+        for idxs in self._buckets:
+            subp = {str(i): self._param_leaves[i] for i in idxs}
+            inner = make_inner({str(i): mask_leaves[i] for i in idxs})
+            self._bucket_states.append(inner.init(subp))
+
+            def apply(p, g, s, scale, _inner=inner):
+                g = jax.tree_util.tree_map(
+                    lambda t: (t.astype(jnp.float32) * scale).astype(
+                        t.dtype), g)
+                updates, s = _inner.update(g, s, p)
+                return optax.apply_updates(p, updates), s
+
+            self._apply_fns.append(jax.jit(apply))
+            self._sqnorm_fns.append(jax.jit(
+                lambda g: sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                              for x in jax.tree_util.tree_leaves(g))))
+
+        clip_f = float(clip)
+
+        def scale_of(sq_stack):
+            gnorm = jnp.sqrt(jnp.sum(sq_stack))
+            return jnp.where(gnorm < clip_f, 1.0, clip_f / gnorm)
+
+        self._scale_fn = jax.jit(scale_of)
+
     def _grad_key0(self) -> str:
         return self._keys[0].replace("params/", "grads/", 1)
+
+
+# ----------------------------------------------------------- benching
+
+
+def measure_overlap(mesh: Mesh, preset: str = "tiny", steps: int = 6,
+                    batch: int = 16, bucket_bytes: int = 64 * 1024,
+                    compress: str | None = "int8") -> dict:
+    """Collective share of store-DP step time, synchronous baseline vs
+    fine-grained overlap — the bench.py ``collective_overlap_pct``
+    probe and the ISSUE 6 acceptance metric. Runs the same training
+    loop twice (``overlap="drain"`` then ``overlap=True``) with a
+    private goodput ledger each, and reports how much of the measured
+    collective leg the overlap hides."""
+    from ptype_tpu.health.goodput import GoodputLedger
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.parallel.collectives import WireConfig
+    from ptype_tpu.train.data import synthetic_batches
+
+    cfg = tfm.preset(preset)
+    seq = min(cfg.max_seq, 128)
+
+    def run(overlap):
+        wire = WireConfig(compress=compress, bucket_bytes=bucket_bytes,
+                          int8_min_bytes=0)
+        store = TensorStore(mesh, wire=wire)
+        trainer = StoreDPTrainer(cfg, store, overlap=overlap)
+        stream = synthetic_batches(cfg.vocab_size, batch, seq)
+        trainer.step(next(stream))  # compile + warm outside the ledger
+        ledger = GoodputLedger(registry=MetricsRegistry()).install()
+        try:
+            for _ in range(steps):
+                out = trainer.step(next(stream))
+        finally:
+            ledger.uninstall()
+        assert jnp.isfinite(out["loss"])
+        return ledger.summary()
+
+    base = run("drain")
+    over = run(True)
+    share_base = base["collective_share_pct"]
+    share_over = over["collective_share_pct"]
+    return {
+        "collective_share_drain_pct": round(share_base, 2),
+        "collective_share_overlap_pct": round(share_over, 2),
+        "collective_overlap_pct": round(
+            100.0 * (1.0 - share_over / share_base), 2)
+        if share_base else 0.0,
+        "drain_step_ms": base["step_breakdown"]["step_ms"],
+        "overlap_step_ms": over["step_breakdown"]["step_ms"],
+        "steps": steps,
+        "bucket_bytes": bucket_bytes,
+        "compress": compress,
+    }

@@ -60,19 +60,39 @@ def _decay_mask(params) -> Any:
     return jax.tree_util.tree_map_with_path(mask_leaf, params)
 
 
+def default_optimizer_pieces(lr: float = 3e-4, weight_decay: float = 0.1,
+                             warmup: int = 100, decay_steps: int = 100_000,
+                             clip: float = 1.0):
+    """The default recipe split at its one cross-leaf coupling: the
+    global-norm clip. Returns ``(clip, make_inner)`` where
+    ``make_inner(mask)`` builds the AdamW-with-schedule transform for
+    any (sub)tree — per-leaf independent, so the overlap trainer can
+    run it per gradient BUCKET as each bucket's collective lands,
+    coordinating only the clip scale across buckets
+    (train/store_dp.py). :func:`default_optimizer` is assembled from
+    the same pieces, so the two paths cannot drift."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup, decay_steps=decay_steps, end_value=lr * 0.1
+    )
+
+    def make_inner(mask):
+        return optax.adamw(sched, b1=0.9, b2=0.95,
+                           weight_decay=weight_decay, mask=mask)
+
+    return clip, make_inner
+
+
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
                       warmup: int = 100, decay_steps: int = 100_000,
                       clip: float = 1.0):
     """AdamW + cosine schedule + global-norm clip — the standard recipe.
     Weight decay applies to matmul weights only (mask exempts norm
     scales), matching common practice."""
-    sched = optax.warmup_cosine_decay_schedule(
-        0.0, lr, warmup, decay_steps=decay_steps, end_value=lr * 0.1
-    )
+    clip, make_inner = default_optimizer_pieces(
+        lr, weight_decay, warmup, decay_steps, clip)
     return optax.chain(
         optax.clip_by_global_norm(clip),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
-                    mask=_decay_mask),
+        make_inner(_decay_mask),
     )
 
 

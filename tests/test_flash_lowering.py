@@ -1,0 +1,79 @@
+"""Mosaic lowering contract for the Pallas flash-attention kernels,
+checked on CPU (tier-1): the (8, 128) block-shape divisibility rule
+over every BlockSpec the three pallas_calls declare, for the configs
+the bench/train paths actually run — the BENCH_r02 regression (an LSE
+output block with a squeezed size-1 dim second-to-last) stays dead.
+Plus a minimal interpreter-mode fwd+bwd so the kernel path itself (not
+just the spec table) is exercised in the fast tier."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ptype_tpu.ops.flash_attention import (LANES, _fwd,
+                                           check_tpu_lowering,
+                                           flash_attention,
+                                           lowering_block_shapes)
+
+
+def test_bench_configs_lower_clean():
+    # (B, H, S, Dh, K) — optimus-125m (6×128 heads), the GPT-2-shaped
+    # 12×64 variant BENCH_r02 failed on, llama-3-8b GQA, tiny.
+    for B, H, S, Dh, K in ((16, 6, 1024, 128, None),
+                           (8, 12, 1024, 64, None),
+                           (1, 32, 8192, 128, 8),
+                           (2, 4, 128, 16, None)):
+        bad = check_tpu_lowering(B, H, S, Dh, K)
+        assert not bad, bad
+        # Smaller block plans from the PERF sweep must lower too.
+        for bq, bk in ((512, 1024), (512, 512), (256, 512)):
+            bad = check_tpu_lowering(B, H, S, Dh, K,
+                                     block_q=bq, block_k=bk)
+            assert not bad, bad
+
+
+def test_rule_catches_bad_blocks():
+    # A 12-row block: not a multiple of 8, not the array dim — the
+    # class of violation the checker exists to flag.
+    bad = check_tpu_lowering(8, 12, 1024, 64, block_q=12)
+    assert bad and any("not divisible by 8" in b for b in bad)
+
+
+def test_lse_output_is_lane_replicated():
+    """The BENCH_r02 fix as a shape contract: the forward's LSE
+    residual is (B, H, S, LANES) — 128-lane replicated, never a
+    squeezed (B, H, S) row layout."""
+    specs = dict(
+        (name, (block, array)) for name, block, array in
+        lowering_block_shapes(8, 12, 1024, 64))
+    block, array = specs["fwd/lse"]
+    assert array[-1] == LANES and block[-1] == LANES
+    assert block[-2] % 8 == 0
+
+
+def test_interpret_mode_forward_emits_lse_and_grads_flow():
+    """Exercise the real kernels (interpret mode) in the fast tier:
+    forward with the LSE residual, then a backward through the
+    custom VJP — the full path a TPU session compiles."""
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    B, S, H, Dh = 1, 64, 2, 16
+    q = jax.random.normal(kq, (B, H, S, Dh), jnp.float32)
+    k = jax.random.normal(kk, (B, H, S, Dh), jnp.float32)
+    v = jax.random.normal(kv, (B, H, S, Dh), jnp.float32)
+    o, lse = _fwd(q, k, v, block_q=32, block_k=32, causal=True,
+                  interpret=True)
+    assert o.shape == (B, H, S, Dh)
+    assert lse.shape == (B, H, S, LANES)
+    # Lane-replication is real: every lane carries the row's LSE.
+    np.testing.assert_array_equal(np.asarray(lse[..., 0]),
+                                  np.asarray(lse[..., LANES - 1]))
+
+    def loss(q, k, v):
+        out = flash_attention(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), block_q=32, block_k=32)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads)

@@ -311,3 +311,78 @@ def test_ptype_tpu_package_is_pt005_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt005 = [f for f in findings if "PT005" in f]
     assert not pt005, pt005
+
+
+INT8_CAST = ("import jax.numpy as jnp\n"
+             "def ship(x):\n"
+             "    return x.astype(jnp.int8)\n")
+
+
+def test_pt006_flags_raw_int8_cast_in_parallel(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/parallel/bad.py", INT8_CAST)
+    assert any("PT006" in f for f in findings), findings
+
+
+def test_pt006_flags_string_dtype_form(tmp_path):
+    src = ("def ship(x):\n"
+           "    return x.astype('int8')\n")
+    findings = _check(tmp_path, "ptype_tpu/parallel/bad2.py", src)
+    assert any("PT006" in f for f in findings), findings
+
+
+def test_pt006_exempts_quantize_helpers(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def _q_int8_blockwise(x):\n"
+           "    return x.astype(jnp.int8)\n"
+           "def quantize_leaf(x):\n"
+           "    return x.astype(jnp.int8)\n")
+    findings = _check(tmp_path, "ptype_tpu/parallel/quant.py", src)
+    assert not any("PT006" in f for f in findings), findings
+
+
+def test_pt006_silent_outside_parallel(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/models/ok.py", INT8_CAST)
+    assert not any("PT006" in f for f in findings), findings
+    findings = _check(tmp_path, "other/parallel/ok.py", INT8_CAST)
+    assert not any("PT006" in f for f in findings), findings
+
+
+def test_pt006_ignores_other_dtypes(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def ship(x):\n"
+           "    return x.astype(jnp.bfloat16)\n")
+    findings = _check(tmp_path, "ptype_tpu/parallel/ok.py", src)
+    assert not any("PT006" in f for f in findings), findings
+
+
+def test_pt006_honors_noqa(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def ship(x):\n"
+           "    return x.astype(jnp.int8)  # noqa: deliberate\n")
+    findings = _check(tmp_path, "ptype_tpu/parallel/sup6.py", src)
+    assert not any("PT006" in f for f in findings), findings
+
+
+def test_parallel_package_is_pt006_clean():
+    """Every int8 narrowing in the data plane rides the scaled
+    quantize helpers (ISSUE 6 satellite)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "parallel")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt006 = [f for f in findings if "PT006" in f]
+    assert not pt006, pt006
+
+
+def test_pt006_flags_keyword_dtype_form(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def ship(x):\n"
+           "    return x.astype(dtype=jnp.int8)\n")
+    findings = _check(tmp_path, "ptype_tpu/parallel/kw.py", src)
+    assert any("PT006" in f for f in findings), findings

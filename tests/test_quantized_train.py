@@ -1,0 +1,205 @@
+"""Training-loop tier for ISSUE 6: convergence parity of the
+block-scaled int8 + error-feedback wire vs fp32, numeric parity of the
+fine-grained-overlap step vs the barrier step, the goodput ledger's
+collective share shrinking with overlap on, the store-DP params cache,
+and the quantized RPC push through a real ParamServer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel import mesh as M
+from ptype_tpu.parallel.collectives import WireConfig
+from ptype_tpu.parallel.tensorstore import TensorStore
+from ptype_tpu.train.store_dp import StoreDPTrainer, measure_overlap
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return M.build_mesh({"data": 8})
+
+
+TINY = tfm.preset("tiny")
+
+
+def _batches(batch=16, seq=64, seed=0):
+    from ptype_tpu.train.data import synthetic_batches
+
+    return synthetic_batches(TINY.vocab_size, batch, seq, seed=seed)
+
+
+def test_quantized_ef_tracks_fp32_loss_curve(mesh8):
+    """N store-DP steps with the block-scaled int8 + error-feedback
+    wire: the loss curve must track the fp32 run within tolerance —
+    the EQuARX claim (quantized wire accurate enough for training)."""
+    from ptype_tpu.train.trainer import default_optimizer
+
+    steps = 10
+    # warmup=0 so the schedule is live inside the short test horizon —
+    # otherwise the first 100 steps train at lr≈0 and "tracks the fp32
+    # curve" would be vacuously true.
+    a = StoreDPTrainer(TINY, TensorStore(mesh8),
+                       optimizer=default_optimizer(lr=1e-3, warmup=0),
+                       rng=jax.random.PRNGKey(2))
+    b = StoreDPTrainer(
+        TINY, TensorStore(mesh8, wire=WireConfig(
+            compress="int8", int8_min_bytes=0)),
+        optimizer=default_optimizer(lr=1e-3, warmup=0),
+        rng=jax.random.PRNGKey(2))
+    batch = next(_batches())  # one batch, memorized: loss must fall
+    la = [a.step(batch)["loss"] for _ in range(steps)]
+    lb = [b.step(batch)["loss"] for _ in range(steps)]
+    np.testing.assert_allclose(la, lb, rtol=5e-3)
+    # Both learn (sanity that the tolerance isn't hiding a flatline).
+    assert lb[-1] < lb[0]
+
+
+def test_overlap_step_matches_barrier_bitwise(mesh8):
+    """overlap=True (lazy bucket stream + per-bucket AdamW with the
+    coordinated clip) is the SAME algorithm as the barrier step — loss
+    and parameter trajectories must match to float tolerance."""
+    steps = 4
+    a = StoreDPTrainer(TINY, TensorStore(mesh8),
+                       rng=jax.random.PRNGKey(1))
+    b = StoreDPTrainer(
+        TINY, TensorStore(mesh8, wire=WireConfig(bucket_bytes=32 * 1024)),
+        rng=jax.random.PRNGKey(1), overlap=True)
+    ia, ib = _batches(seed=1), _batches(seed=1)
+    la = [a.step(next(ia))["loss"] for _ in range(steps)]
+    lb = [b.step(next(ib))["loss"] for _ in range(steps)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    for x, y in zip(jax.tree_util.tree_leaves(a.params()),
+                    jax.tree_util.tree_leaves(b.params())):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-5, atol=1e-6)
+    # Several buckets actually streamed (the 32 KiB target splits the
+    # tiny tree), and epochs advanced per push as usual.
+    assert b._buckets is not None and len(b._buckets) > 1
+    assert b.step(next(ib))["grad_epoch"] == steps + 1
+
+
+def test_overlap_custom_optimizer_falls_back_whole_tree(mesh8):
+    import optax
+
+    opt = optax.sgd(1e-2)
+    a = StoreDPTrainer(TINY, TensorStore(mesh8), optimizer=opt,
+                       rng=jax.random.PRNGKey(3))
+    b = StoreDPTrainer(TINY, TensorStore(mesh8), optimizer=optax.sgd(1e-2),
+                       rng=jax.random.PRNGKey(3), overlap=True)
+    ia, ib = _batches(seed=2), _batches(seed=2)
+    la = [a.step(next(ia))["loss"] for _ in range(3)]
+    lb = [b.step(next(ib))["loss"] for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+
+def test_params_cache_skips_store_round_trip(mesh8, monkeypatch):
+    """Satellite: the trainer keeps its own committed views — steps
+    must not get_tree the params it just put; an EXTERNAL write makes
+    the next params() re-pull."""
+    store = TensorStore(mesh8)
+    tr = StoreDPTrainer(TINY, store, rng=jax.random.PRNGKey(0))
+    calls = []
+    orig = TensorStore.get_tree
+
+    def spy(self, prefix, gather=False):
+        calls.append(prefix)
+        return orig(self, prefix, gather)
+
+    monkeypatch.setattr(TensorStore, "get_tree", spy)
+    it = _batches()
+    tr.step(next(it))
+    tr.step(next(it))
+    assert calls == [], f"steps re-pulled the param tree: {calls}"
+    # External mutation: another writer touches the namespace.
+    new_w = jnp.zeros_like(store.get(tr._keys[0]))
+    store.put(tr._keys[0], new_w)
+    params = tr.params()
+    assert calls == ["params"]
+    leaf0 = jax.tree_util.tree_leaves(params)[0]
+    np.testing.assert_array_equal(np.asarray(leaf0), np.asarray(new_w))
+    # And the re-pulled view is cached again.
+    tr.params()
+    assert calls == ["params"]
+
+
+def test_collective_share_shrinks_with_overlap(mesh8):
+    """The ISSUE 6 acceptance metric on the host mesh: the goodput
+    ledger's collective share of store-DP step time shrinks when
+    fine-grained overlap is enabled (drain baseline vs overlap=True),
+    at comparable step time. The drain and overlap loops run as
+    separate timed windows on a noisy shared host, so one retry is
+    allowed — a persistent inversion is the real regression signal."""
+    last = None
+    for _ in range(2):
+        r = measure_overlap(mesh8, steps=5)
+        last = r
+        if (r["collective_share_overlap_pct"]
+                < r["collective_share_drain_pct"]
+                and r["overlap_step_ms"] < r["drain_step_ms"] * 1.25):
+            break
+    else:
+        raise AssertionError(
+            f"overlap did not shrink the collective share in two "
+            f"independent measurements: {last}")
+    assert last["collective_overlap_pct"] > 0
+
+
+def test_param_server_quantized_push(mesh8):
+    """The RPC wire plumb-through: an AsyncWorker with an int8
+    WireConfig pushes quantized trees; the server dequantizes, counts
+    them, and training still converges on par with the raw-tree
+    worker."""
+    from ptype_tpu.train.param_server import AsyncWorker, ParamServer
+
+    wire = WireConfig(compress="int8", q_block=256)
+    ps = ParamServer(TINY, TensorStore(mesh8), rng=jax.random.PRNGKey(0),
+                     wire=wire)
+    raw = AsyncWorker(TINY, ps, worker_id=0)
+    q = AsyncWorker(TINY, ps, worker_id=1, wire=wire)
+    it = _batches(seed=3)
+    out_raw = raw.step(next(it))
+    out_q = q.step(next(it))
+    assert out_raw["applied"] and out_q["applied"]
+    stats = ps.Stats()
+    assert stats["quantized"] == 1 and stats["applied"] == 2
+    assert stats["wire"] == "int8"
+    # EF residuals carried on the worker.
+    assert q._residuals is not None
+    losses = [q.step(next(it))["loss"] for _ in range(4)]
+    assert all(np.isfinite(losses))
+
+    # Server side: a stale QUANTIZED push is rejected cheaply and must
+    # not count toward the applied-quantized stat.
+    from ptype_tpu.parallel import collectives as C
+    from ptype_tpu.train.param_server import StalePushError
+
+    stats_before = ps.Stats()
+    stale_wire, _ = C.quantize_tree(
+        jax.tree_util.tree_map(jnp.zeros_like, ps._params))
+    with pytest.raises(StalePushError):
+        ps.Push(stale_wire, -100)  # far behind: guaranteed rejection
+    stats_after = ps.Stats()
+    assert stats_after["quantized"] == stats_before["quantized"]
+    assert stats_after["rejected"] == stats_before["rejected"] + 1
+
+    # Worker side: a rejected push must RESTORE the carried residual —
+    # the rejected wire held the accumulated EF error and was dropped.
+    class _RejectingServer:
+        def Pull(self):
+            return ps.Pull()
+
+        def Push(self, grads, version):
+            raise StalePushError("forced rejection")
+
+    w = AsyncWorker(TINY, _RejectingServer(), worker_id=2, wire=wire)
+    w._residuals = [np.float32(1.0) + jnp.zeros_like(p)
+                    for p in jax.tree_util.tree_leaves(ps._params)]
+    before = [np.asarray(r) for r in w._residuals]
+    out = w.step(next(it))
+    assert not out["applied"] and w.stale_rejections == 1
+    for b, r in zip(before, w._residuals):
+        np.testing.assert_array_equal(b, np.asarray(r))

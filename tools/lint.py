@@ -37,6 +37,12 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   outside a ``MetricsRegistry`` is invisible to the health plane's
   sampler (no series, no alerts); get it from a registry
   (``metrics.metrics.counter(...)``)
+- PT006 (ptype_tpu/parallel/ only): a raw ``.astype(jnp.int8)`` /
+  ``.astype("int8")`` narrowing outside the quantize helpers — an
+  unscaled int8 cast silently destroys gradients (values outside
+  ±127 saturate, sub-1 magnitudes round to zero); int8 wires must go
+  through the block-scaled quantizers (``_q_int8_blockwise`` /
+  ``quantize_leaf``), which pair every payload with its absmax scales
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -345,6 +351,65 @@ class _DirectMetricCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: Function-name prefixes sanctioned to narrow to int8 in
+#: ptype_tpu/parallel/: the quantize helpers, which always pair the
+#: cast with per-block absmax scales.
+_QUANT_HELPER_PREFIXES = ("_q_", "quantize", "dequantize")
+
+
+def _is_int8_arg(node: ast.expr) -> bool:
+    """True for jnp.int8 / np.int8 / "int8" / dtype("int8")-shaped
+    astype arguments."""
+    if isinstance(node, ast.Constant):
+        return node.value == "int8"
+    if isinstance(node, ast.Attribute) and node.attr == "int8":
+        return True
+    if (isinstance(node, ast.Call) and node.args
+            and isinstance(node.args[0], ast.Constant)):
+        return node.args[0].value == "int8"
+    return False
+
+
+class _RawInt8CastCheck(ast.NodeVisitor):
+    """PT006: ``.astype(int8)`` in ptype_tpu/parallel/ outside the
+    quantize helpers. A bare int8 cast has no scale: gradient values
+    saturate at ±127 and magnitudes below 1 round to zero — exactly
+    the silent corruption the block-scaled quantizers
+    (collectives._q_int8_blockwise / quantize_leaf) exist to prevent.
+    """
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        self.fn_stack: list[str] = []
+
+    def _fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def _sanctioned(self) -> bool:
+        return any(name.startswith(_QUANT_HELPER_PREFIXES)
+                   for name in self.fn_stack)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        dtype_args = list(node.args[:1]) + [
+            kw.value for kw in node.keywords if kw.arg == "dtype"]
+        if (isinstance(fn, ast.Attribute) and fn.attr == "astype"
+                and any(_is_int8_arg(a) for a in dtype_args)
+                and not self._sanctioned()):
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT006 raw .astype(int8) "
+                f"narrowing outside the quantize helpers — an unscaled "
+                f"int8 cast destroys gradients (saturation + underflow); "
+                f"use collectives._q_int8_blockwise / quantize_leaf, "
+                f"which carry per-block absmax scales")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -406,6 +471,10 @@ def check_file(path: str, findings: list[str]) -> None:
         # metrics.py IS the family factory; everything else must get
         # families from a MetricsRegistry so the sampler sees them.
         _DirectMetricCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and "parallel" in parts:
+        # The data plane's int8 narrowings must ride the scaled
+        # quantize helpers — a bare cast is silent gradient loss.
+        _RawInt8CastCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
