@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench collectives-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
+.PHONY: test test-all bench serve-bench collectives-bench zero-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -31,6 +31,15 @@ serve-bench:
 collectives-bench:
 	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
 		python bench.py --collectives
+
+# ZeRO-1 sharded-optimizer microbench on the 8-device virtual host
+# mesh (docs/PERF.md "Sharded optimizer update (ZeRO-1)"): per-replica
+# optimizer-state bytes and step time for zero=True vs the replicated
+# store-DP baseline (exact + int8/EF wires), plus the goodput ledger's
+# optimizer_ms leg — the ISSUE 7 acceptance numbers.
+zero-bench:
+	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
+		python bench.py --zero
 
 # Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
 # fault schedule against the in-process trainer + registry +

@@ -207,6 +207,9 @@ def worker_main() -> None:
         "store_wire_note": None,
         "collective_overlap_pct": None,
         "collective_note": None,
+        "zero_opt_mem_mb": None,
+        "zero_step_ms": None,
+        "zero_note": None,
         "final_loss": round(float(out["loss"]), 4),
     }
     # The primary metric is EARNED at this point — print it before the
@@ -389,6 +392,20 @@ def _overlap_hostmesh() -> tuple[dict | None, str]:
         STORE_PROBE_TIMEOUT)
 
 
+def _zero_hostmesh() -> tuple[dict | None, str]:
+    """ZeRO-1 sharded optimizer update vs the replicated store-DP
+    baseline — fills ``zero_opt_mem_mb`` / ``zero_step_ms`` (ISSUE 7
+    acceptance: per-replica optimizer bytes shrink ~N× at matched
+    loss)."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.parallel.mesh import build_mesh\n"
+        "from ptype_tpu.train.store_dp import measure_zero\n"
+        "print(json.dumps(measure_zero(build_mesh({'data': 8}),"
+        " steps=6)))\n",
+        STORE_PROBE_TIMEOUT)
+
+
 def _health_hostmesh() -> tuple[dict | None, str]:
     """Store-DP step loop with the goodput ledger + sampler armed —
     fills ``goodput_pct`` / ``step_breakdown`` /
@@ -467,6 +484,22 @@ def _patch_store_metric(rec: dict) -> None:
             f"{probe['collective_share_overlap_pct']}% overlapped "
             f"(step {probe['drain_step_ms']} → "
             f"{probe['overlap_step_ms']} ms); {note}"
+            if probe else note)
+    if rec.get("zero_opt_mem_mb") is None:
+        # Sharded optimizer update (ZeRO-1): per-replica moment bytes
+        # + step time vs the replicated store-DP baseline (ISSUE 7).
+        probe, note = _zero_hostmesh()
+        rec["zero_opt_mem_mb"] = (
+            probe["zero_opt_mem_mb"] if probe else None)
+        rec["zero_step_ms"] = probe["zero_step_ms"] if probe else None
+        rec["zero_note"] = (
+            f"replicated {probe['repl_opt_mem_mb']} MB → sharded "
+            f"{probe['zero_opt_mem_mb']} MB per replica "
+            f"({probe['opt_mem_ratio']}x, {probe['n_replicas']} "
+            f"replicas); step {probe['repl_step_ms']} → "
+            f"{probe['zero_step_ms']} ms; loss "
+            f"{probe['final_loss_repl']} vs {probe['final_loss_zero']}"
+            f"; {note}"
             if probe else note)
     if rec.get("goodput_pct") is None:
         # Health plane on the same host-mesh loop: live goodput +
@@ -553,6 +586,65 @@ def collectives_main() -> None:
             overlap["collective_share_drain_pct"],
         "collective_share_overlap_pct":
             overlap["collective_share_overlap_pct"],
+    })
+
+
+# ------------------------------------------------------------- zero bench
+
+
+def zero_main() -> None:
+    """``make zero-bench``: the ISSUE 7 acceptance numbers on the host
+    mesh, in-process. Emits one labeled JSON line per probe and a
+    combined tail record: per-replica optimizer-state bytes and step
+    time for the ZeRO-1 sharded update vs the replicated store-DP
+    baseline (exact wire AND the int8+EF wire), with the goodput
+    ledger's new ``optimizer_ms`` leg from a short instrumented run."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ptype_tpu.health.goodput import GoodputLedger
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.tensorstore import TensorStore
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer, measure_zero
+
+    import jax
+    from ptype_tpu.models import transformer as tfm
+
+    n = len(jax.devices())
+    mesh = build_mesh({"data": n})
+    exact = measure_zero(mesh, steps=6)
+    _emit({"probe": "zero_exact", **exact})
+    int8 = measure_zero(mesh, steps=6, compress="int8")
+    _emit({"probe": "zero_int8_ef", **int8})
+
+    # The optimizer leg of the goodput breakdown under zero=True.
+    cfg = tfm.preset("tiny")
+    trainer = StoreDPTrainer(cfg, TensorStore(mesh),
+                             rng=jax.random.PRNGKey(0), zero=True)
+    stream = synthetic_batches(cfg.vocab_size, 16, 128, seed=9)
+    trainer.step(next(stream))  # compile + warm outside the ledger
+    ledger = GoodputLedger(registry=MetricsRegistry()).install()
+    try:
+        for _ in range(6):
+            trainer.step(next(stream))
+    finally:
+        ledger.uninstall()
+    breakdown = ledger.summary()["step_breakdown"]
+    _emit({"probe": "zero_breakdown", "step_breakdown": breakdown})
+
+    _emit({
+        "metric": "zero-1 sharded optimizer update "
+                  f"({n}-device host mesh)",
+        "value": exact["opt_mem_ratio"],
+        "unit": "x less optimizer memory per replica",
+        "zero_opt_mem_mb": exact["zero_opt_mem_mb"],
+        "repl_opt_mem_mb": exact["repl_opt_mem_mb"],
+        "zero_step_ms": exact["zero_step_ms"],
+        "repl_step_ms": exact["repl_step_ms"],
+        "zero_int8_step_ms": int8["zero_step_ms"],
+        "optimizer_ms": breakdown.get("optimizer_ms"),
+        "final_loss_zero": exact["final_loss_zero"],
+        "final_loss_repl": exact["final_loss_repl"],
     })
 
 
@@ -722,6 +814,9 @@ def main() -> None:
         return
     if "--collectives" in sys.argv:
         collectives_main()
+        return
+    if "--zero" in sys.argv:
+        zero_main()
         return
 
     t_start = time.time()

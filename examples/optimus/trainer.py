@@ -16,6 +16,9 @@ and train. Three modes:
 Env knobs: PRESET (optimus-125m), STEPS, BATCH, SEQ, MODE,
 LR/WARMUP/WEIGHT_DECAY/DECAY_STEPS (optimizer), METRICS_PATH (JSONL sink),
 COMPRESS (store mode: bf16|int8 gradient-push wire compression),
+ZERO=1 (store mode: ZeRO-1 sharded weight update — reduce-scatter
+grads, shard-local AdamW with 1/N moments per replica, allgather
+params; sharded checkpoints reshard on restore),
 SHARD_UPDATE=1 (gspmd mode: ZeRO-1 weight-update sharding — Adam
 moments shard over the data axis, 1/N optimizer HBM, same math).
 """
@@ -133,14 +136,34 @@ def main() -> None:
             store = TensorStore(mesh, kv=cluster.store,
                                 compress=os.environ.get("COMPRESS")
                                 or None)
-            trainer = StoreDPTrainer(model_cfg, store,
-                                     optimizer=optimizer)
+            # ZERO=1: ZeRO-1 sharded weight update (parallel/zero.py)
+            # — gradients reduce-scatter, AdamW applies shard-locally
+            # (1/N moments per replica), params allgather back. The
+            # same LR/WARMUP/... knobs feed the shard-local recipe
+            # through OptHParams.
+            zero = os.environ.get("ZERO") == "1"
+            if zero:
+                from ptype_tpu.train.trainer import \
+                    default_optimizer_hparams
+
+                trainer = StoreDPTrainer(
+                    model_cfg, store, zero=True,
+                    zero_hparams=default_optimizer_hparams(
+                        lr=float(os.environ.get("LR", "3e-4")),
+                        weight_decay=float(
+                            os.environ.get("WEIGHT_DECAY", "0.1")),
+                        warmup=int(os.environ.get("WARMUP", "100")),
+                        decay_steps=int(
+                            os.environ.get("DECAY_STEPS", "100000"))))
+            else:
+                trainer = StoreDPTrainer(model_cfg, store,
+                                         optimizer=optimizer)
             # CKPT_DIR persists the Store's parameter space (the
             # durability etcd's data-dir gave the reference Store).
             # Resume restores params INTO the store after the trainer
             # seeded it — optimizer moments restart, the Store-tier
             # "resume = join + Store pull" semantic (SURVEY.md §5).
-            sc = None
+            sc = zc = None
             ckpt_every = int(os.environ.get("CKPT_EVERY", "50"))
             if os.environ.get("CKPT_DIR"):
                 from ptype_tpu.checkpoint import StoreCheckpoint
@@ -149,6 +172,14 @@ def main() -> None:
                 # whose bytes equal the params' — don't double saves.
                 sc = StoreCheckpoint(store, os.environ["CKPT_DIR"],
                                      keys_prefix="params/")
+                if zero:
+                    from ptype_tpu.checkpoint import ZeroCheckpoint
+
+                    # Sharded moments alongside the params: per-replica
+                    # crc32 shards + the plan manifest, reshardable if
+                    # the device count changed since the save.
+                    zc = ZeroCheckpoint(os.path.join(
+                        os.environ["CKPT_DIR"], "zero_opt"))
                 # Probe emptiness explicitly so a CORRUPT checkpoint
                 # still fails loudly instead of silently restarting
                 # from step 0.
@@ -162,6 +193,16 @@ def main() -> None:
                     trainer.step_count = resumed_step
                     print(f"resumed {len(restored)} Store keys at "
                           f"step {resumed_step}", flush=True)
+                    if zc is not None:
+                        # Pin to the params' step: a crash between the
+                        # Store save and the zero save must fail loudly
+                        # here, never silently pair newer params with
+                        # stale moments / schedule count.
+                        zc.restore_into(trainer.zero_state(),
+                                        step=resumed_step)
+                        print("resumed sharded optimizer state "
+                              f"(count {trainer.zero_state().count})",
+                              flush=True)
             saved_i = -1
             for i in range(steps):
                 out = trainer.step(next(stream))
@@ -177,10 +218,14 @@ def main() -> None:
                     # on put() (resume semantics pin them), so the
                     # derived step would always be 0.
                     sc.save(step=out["step"])
+                    if zc is not None:
+                        zc.save(out["step"], trainer.zero_state())
                     saved_i = i
             if sc is not None and saved_i != steps - 1:
                 print(f"store checkpoint: {sc.save(step=out['step'])}",
                       flush=True)
+                if zc is not None:
+                    zc.save(out["step"], trainer.zero_state())
         elif mode == "async":
             from ptype_tpu.parallel.tensorstore import TensorStore
             from ptype_tpu.train.param_server import AsyncWorker, ParamServer

@@ -641,6 +641,67 @@ def _check_tiling(key: str, shards: list[dict], shape: list[int]) -> None:
         )
 
 
+class ZeroCheckpoint:
+    """Checkpoint tier for ZeRO-1 sharded optimizer state
+    (parallel/zero.ZeroState): the per-bucket flat Adam moments are
+    jax Arrays sharded over the data axis, so :class:`Checkpointer`
+    already writes them as per-replica shard files with a crc32 each
+    (the existing per-shard machinery, reused verbatim) — one
+    ``bucketNNNNN.{mu,nu}.shard<r>.npy`` per replica shard. The shard
+    PLAN rides the step's atomic commit as ``zero_plan.json`` (written
+    before ``.complete``), which is what makes restore **reshardable**:
+    bucket slots are replica-count-independent, only the tail pads
+    depend on N, so a state saved from 8 replicas restores onto 4 (or
+    4 onto 8) by strip-pad → re-pad → re-place (ZeroState.
+    load_state_tree). A corrupt shard surfaces as
+    :class:`~ptype_tpu.errors.CheckpointError` naming the file, same
+    contract as every other restore path."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self._ckpt = Checkpointer(directory, keep=keep)
+
+    def latest_step(self) -> int | None:
+        return self._ckpt.latest_step()
+
+    def save(self, step: int, zero_state) -> str:
+        """Persist the sharded moments + schedule count + plan
+        manifest as one committed step dir."""
+        return self._ckpt.save(
+            step, zero_state.state_tree(),
+            extras={"zero_plan.json": json.dumps(
+                zero_state.plan.manifest())})
+
+    def restore_into(self, zero_state, step: int | None = None) -> int:
+        """Load a saved step INTO an existing ZeroState (whose plan
+        defines the restoring replica count), resharding when the
+        saved N differs. Returns the restored step. Raises
+        CheckpointError on plan mismatch or shard corruption,
+        ClusterError when there is nothing to restore."""
+        step = step if step is not None else self._ckpt.latest_step()
+        if step is None:
+            raise ClusterError(
+                f"ZeroCheckpoint: no complete step under "
+                f"{self._ckpt.directory}")
+        sdir = self._ckpt._step_dir(step)
+        try:
+            with open(os.path.join(sdir, "zero_plan.json")) as f:
+                saved_plan = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"ZeroCheckpoint: step {step} has no readable "
+                f"zero_plan.json ({e}) — not a sharded-optimizer "
+                f"checkpoint") from e
+        n_buckets = len(saved_plan.get("buckets", []))
+        skeleton = {
+            "buckets": {f"{i:05d}": {"mu": 0, "nu": 0}
+                        for i in range(n_buckets)},
+            "count": 0,
+        }
+        tree = self._ckpt.restore(skeleton, step=step)
+        zero_state.load_state_tree(tree, saved_plan)
+        return step
+
+
 class StoreCheckpoint:
     """Persist / resume a TensorStore namespace (the Store tier).
 

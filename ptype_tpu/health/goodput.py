@@ -10,7 +10,9 @@ both a live, always-on account:
   already run their regions through — and folds every finished region
   into a per-step record: ``data`` (``train.data``), ``collective``
   (``store.push*`` / ``store.pull*``), ``checkpoint``
-  (``checkpoint.*``), ``compute`` (the step remainder), and ``stall``
+  (``checkpoint.*``), ``optimizer`` (``train.opt*`` — the apply leg,
+  split out so the ZeRO-1 sharded update's ~N× FLOP saving is a
+  visible number), ``compute`` (the step remainder), and ``stall``
   (the wall-clock gap between consecutive steps). Each closed step
   publishes ``goodput.*`` gauges into the node's registry, which the
   health :class:`~ptype_tpu.health.series.Sampler` turns into the
@@ -50,6 +52,12 @@ def _component(name: str) -> str | None:
         return "checkpoint"
     if fam == "train.data":
         return "data"
+    if fam == "train.opt":
+        # The optimizer apply — its own leg since the ZeRO-1 sharded
+        # update (train.opt/zero) exists precisely to shrink it ~N×;
+        # the replicated apply paths ride the same region name so the
+        # comparison is apples-to-apples in `obs top` and the bench.
+        return "optimizer"
     return None
 
 
@@ -155,7 +163,8 @@ class GoodputLedger:
             # save after the previous step) — counted in their
             # component and deducted from stall, never from compute.
             step_start = end - step_s
-            inside = {"data": 0.0, "collective": 0.0, "checkpoint": 0.0}
+            inside = {"data": 0.0, "collective": 0.0,
+                      "checkpoint": 0.0, "optimizer": 0.0}
             between = dict(inside)
             for comp, dur, t in events:
                 (inside if t >= step_start else between)[comp] += dur
@@ -166,6 +175,7 @@ class GoodputLedger:
             data = inside["data"] + between["data"]
             coll = inside["collective"] + between["collective"]
             ckpt = inside["checkpoint"] + between["checkpoint"]
+            opt = inside["optimizer"] + between["optimizer"]
             # Clamp so a mis-nested caller can't drive compute negative.
             compute = max(0.0, step_s - min(step_s,
                                             sum(inside.values())))
@@ -185,6 +195,7 @@ class GoodputLedger:
                 "collective_ms": round(coll * 1e3, 3),
                 "data_ms": round(data * 1e3, 3),
                 "checkpoint_ms": round(ckpt * 1e3, 3),
+                "optimizer_ms": round(opt * 1e3, 3),
                 "stall_ms": round(stall * 1e3, 3),
                 "goodput_pct": round(goodput, 2),
             }
@@ -198,8 +209,8 @@ class GoodputLedger:
             self._records.append(rec)
         reg = self.registry
         for key in ("step_ms", "compute_ms", "collective_ms", "data_ms",
-                    "checkpoint_ms", "stall_ms", "goodput_pct",
-                    "tokens_per_sec", "mfu"):
+                    "checkpoint_ms", "optimizer_ms", "stall_ms",
+                    "goodput_pct", "tokens_per_sec", "mfu"):
             if key in rec:
                 name = "goodput.pct" if key == "goodput_pct" \
                     else f"goodput.{key}"
@@ -229,7 +240,7 @@ class GoodputLedger:
         breakdown = {
             k: mean(k) for k in
             ("step_ms", "compute_ms", "collective_ms", "data_ms",
-             "checkpoint_ms", "stall_ms")}
+             "checkpoint_ms", "optimizer_ms", "stall_ms")}
         # Share denominator: mean wall over the records that carry it
         # (averaging absent keys as 0 would deflate the wall and push
         # the share past 100% — the bound this metric promises).

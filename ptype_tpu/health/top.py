@@ -43,13 +43,15 @@ def render_top(snapshot: dict, alerts=(), max_nodes: int = 32) -> str:
         f"ptype health @ {snapshot.get('ts')} — {len(nodes)} nodes, "
         f"{len(errors)} unreachable",
         f"{'node':<28} {'good%':>6} {'step':>8} {'coll':>8} "
-        f"{'stall':>8} {'tok/s':>9} {'mfu':>7} {'mem':>9} {'loss':>8}",
+        f"{'opt':>8} {'stall':>8} {'tok/s':>9} {'mfu':>7} {'mem':>9} "
+        f"{'loss':>8}",
     ]
     for key in sorted(nodes)[:max_nodes]:
         t = nodes[key]
         good = _gauge(t, "goodput.pct")
         step = _gauge(t, "goodput.step_ms")
         coll = _gauge(t, "goodput.collective_ms")
+        opt = _gauge(t, "goodput.optimizer_ms")
         stall = _gauge(t, "goodput.stall_ms")
         tps = _gauge(t, "goodput.tokens_per_sec")
         mfu = _gauge(t, "goodput.mfu")
@@ -62,9 +64,9 @@ def render_top(snapshot: dict, alerts=(), max_nodes: int = 32) -> str:
 
         lines.append(
             f"{key[:28]:<28} {num(good):>6} {num(step):>7}m "
-            f"{num(coll):>7}m {num(stall):>7}m {num(tps):>9} "
-            f"{num(mfu, '{:.3f}'):>7} {_fmt_bytes(mem):>9} "
-            f"{num(loss, '{:.3f}'):>8}")
+            f"{num(coll):>7}m {num(opt):>7}m {num(stall):>7}m "
+            f"{num(tps):>9} {num(mfu, '{:.3f}'):>7} "
+            f"{_fmt_bytes(mem):>9} {num(loss, '{:.3f}'):>8}")
     for key in sorted(errors)[:8]:
         lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
     lines.append("")

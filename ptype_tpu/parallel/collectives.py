@@ -553,6 +553,22 @@ def _unpack(red, slots):
                  for s in slots)
 
 
+def _slot_offsets(shapes: tuple) -> list:
+    """Contiguous :class:`LeafSlot` layout for per-device payload
+    ``shapes`` — the ONE offset computation every fused bucket program
+    (allreduce, reduce-scatter, the zero shard-apply) unpacks with, so
+    the flat layout cannot drift between them."""
+    offs = []
+    off = 0
+    for s in shapes:
+        size = 1
+        for d in s:
+            size *= int(d)
+        offs.append(LeafSlot(0, off, size, s))
+        off += size
+    return offs
+
+
 def _pack_flat(locals_, pad: int):
     """Squeeze the stacked dim off each per-device leaf, flatten,
     concatenate, and zero-pad to the bucket's padded length — the ONE
@@ -589,14 +605,7 @@ def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
         in_specs = in_specs + in_specs
         out_specs = out_specs + tuple(
             P(axis, *(None,) * len(s)) for s in shapes)
-    offs = []
-    off = 0
-    for s in shapes:
-        size = 1
-        for d in s:
-            size *= int(d)
-        offs.append(LeafSlot(0, off, size, s))
-        off += size
+    offs = _slot_offsets(shapes)
 
     def f(*locals_):
         flat = _pack_flat(locals_[:len(shapes)], pad)
@@ -638,25 +647,110 @@ def _bucket_all_reduce_fn(mesh: Mesh, axis: str, op: str, shapes: tuple,
 def _bucket_reduce_scatter_fn(mesh: Mesh, axis: str, op: str,
                               shapes: tuple, dtype: str, pad: int,
                               wire: str | None, restore: bool,
-                              q_block: int | None = DEFAULT_QUANT_BLOCK):
+                              q_block: int | None = DEFAULT_QUANT_BLOCK,
+                              ef: bool = False):
     """Pack → (quantize?) → reduce-scatter; each device keeps one flat
-    ``elems/n`` shard of the bucket (half the allreduce's ICI bytes)."""
+    ``elems/n`` shard of the bucket (half the allreduce's ICI bytes).
+
+    ``ef`` (int8 wire only): the program takes stacked per-leaf
+    error-feedback residual operands, folds them into the contribution
+    before quantizing, and returns the new residuals (the phase-1
+    quantization error — the scatter has no all_gather leg, so each
+    replica owns the error of its WHOLE contribution and cancels it in
+    the next step's reduction) after the scattered shard."""
     in_specs = tuple(P(axis, *(None,) * len(s)) for s in shapes)
+    out_specs = P(axis)
+    if ef:
+        in_specs = in_specs + in_specs
+        out_specs = (P(axis),) + tuple(
+            P(axis, *(None,) * len(s)) for s in shapes)
+    offs = _slot_offsets(shapes)
 
     def f(*locals_):
-        flat = _pack_flat(locals_, pad)
+        flat = _pack_flat(locals_[:len(shapes)], pad)
         if wire == "int8":
-            shard, _ = _int8_phase1(flat, axis, op, q_block)
+            if ef:
+                flat = flat.astype(jnp.float32) + _pack_flat(
+                    locals_[len(shapes):], pad).astype(jnp.float32)
+            shard, err = _int8_phase1(flat, axis, op, q_block)
         else:
+            err = None
             w = flat.astype(jnp.bfloat16) if wire == "bf16" else flat
             shard = lax.psum_scatter(w, axis, scatter_dimension=0,
                                      tiled=True)
             if op == "mean":
                 shard = shard / axis_size(axis)
-        return shard.astype(jnp.dtype(dtype)) if restore else shard
+        if restore:
+            shard = shard.astype(jnp.dtype(dtype))
+        if not ef:
+            return shard
+        # ef is armed only on int8 buckets (the stream layer's
+        # contract): a missing residual here would mean carried error
+        # silently wiped — fail loudly at trace time.
+        assert err is not None, "ef requires the int8 wire"
+        new_res = err.reshape(flat.shape).astype(jnp.dtype(dtype))
+        return (shard,) + tuple(r[None] for r in _unpack(new_res, offs))
 
     return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=P(axis), check_vma=False))
+                             out_specs=out_specs, check_vma=False))
+
+
+def bucketed_reduce_scatter_stream(leaves, mesh: Mesh,
+                                   axis: str = "data", op: str = "sum",
+                                   *,
+                                   bucket_bytes: int =
+                                   DEFAULT_BUCKET_BYTES,
+                                   compress: str | None = None,
+                                   int8_min_bytes: int =
+                                   INT8_MIN_BUCKET_BYTES,
+                                   q_block: int | None =
+                                   DEFAULT_QUANT_BLOCK,
+                                   residuals: list | None = None):
+    """Reduce-scatter counterpart of :func:`bucketed_all_reduce_stream`
+    — the gradient leg of the ZeRO-style sharded weight update
+    (parallel/zero.py): one fused reduce-scatter per bucket, yielding
+    ``(bucket, flat_shard, new_residuals_by_slot | None)`` right after
+    the dispatch. ``flat_shard`` is the bucket's reduced flat
+    ``(elems,)`` buffer sharded ``P(axis)`` — each device holds its
+    contiguous ``elems/n`` shard, half the allreduce's wire bytes and
+    exactly the resident form the shard-local optimizer consumes.
+
+    ``residuals``: per-leaf stacked error-feedback residuals aligned
+    with ``leaves`` (None entries seed zeros); they engage only on
+    buckets whose wire resolves to int8, like the allreduce stream.
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"bucketed_reduce_scatter: op must be 'sum' or 'mean', "
+            f"got {op!r}")
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(
+            f"bucketed_reduce_scatter: unknown compression {compress!r}")
+    leaves = [jnp.asarray(x) for x in leaves]
+    n = int(mesh.shape[axis])
+    buckets = plan_buckets(leaves, n, bucket_bytes)
+    placed = _place_stacked(leaves, mesh, axis)
+    for b in buckets:
+        wire = _bucket_wire(b, op, compress, int8_min_bytes)
+        ef = wire == "int8" and residuals is not None
+        fn = _bucket_reduce_scatter_fn(
+            mesh, axis, op, tuple(s.shape for s in b.slots), b.dtype,
+            b.pad, wire, compress is not None, q_block, ef)
+        args = [placed[s.index] for s in b.slots]
+        if ef:
+            args += _place_stacked(
+                [residuals[s.index]
+                 if residuals[s.index] is not None
+                 and tuple(residuals[s.index].shape)
+                 == tuple(leaves[s.index].shape)
+                 else jnp.zeros_like(leaves[s.index])
+                 for s in b.slots], mesh, axis)
+        outs = fn(*args)
+        _count_launch()
+        if ef:
+            yield b, outs[0], list(outs[1:])
+        else:
+            yield b, outs, None
 
 
 def _count_launch(n: int = 1) -> None:

@@ -117,6 +117,30 @@ class BucketPush:
         return self
 
 
+@dataclass
+class ShardPush:
+    """One dispatched bucket of :meth:`TensorStore.push_tree_scatter_
+    iter`: the committed flat reduction, sharded ``P(axis)`` — each
+    replica holds its contiguous ``elems/n`` shard (the ZeRO resident
+    form). ``keys`` are the leaf keys packed into the bucket, in slot
+    order; :meth:`wait` blocks inside a ``store.push_wait`` region so
+    consumer wait time lands in the goodput ledger's collective leg."""
+
+    prefix: str
+    index: int
+    key: str
+    bucket: object          # collectives.Bucket
+    keys: list
+    flat: jax.Array
+
+    def wait(self) -> "ShardPush":
+        from ptype_tpu.metrics import annotate
+
+        with annotate(f"store.push_wait/{self.prefix}"):
+            self.flat.block_until_ready()
+        return self
+
+
 class TensorStore:
     """Device-resident tensor KV over a mesh (the Store push/pull lowering)."""
 
@@ -544,6 +568,85 @@ class TensorStore:
                                     with self._lock:
                                         self._residuals[key] = new_res[i]
                         handle = BucketPush(prefix, keys, vals)
+                    yield handle
+            finally:
+                if pending:
+                    with self._lock:
+                        for i, r in pending.items():
+                            # setdefault: never clobber a fresher
+                            # residual a concurrent pusher wrote.
+                            self._residuals.setdefault(items[i][0], r)
+        metrics.timing("store.push_tree").observe(
+            _time.perf_counter() - t0)
+        metrics.counter("store.push_tree.leaves").add(len(pairs))
+        chaos.note_ok("store.push", prefix)
+
+    def push_tree_scatter_iter(self, prefix: str, stacked_tree,
+                               op: str | None = None, *,
+                               bucket_bytes: int | None = None):
+        """The ZeRO gradient leg (parallel/zero.py): reduce-SCATTER
+        every bucket of a stacked pytree instead of allreducing it —
+        half the wire bytes, each device left holding one contiguous
+        flat shard per bucket, committed under
+        ``<prefix>/bucketNNNNN`` with a ``P(axis)`` binding (the Store
+        contract at bucket granularity: epoch bump + manifest publish
+        per scatter, pullable with ``gather=True``). A generator like
+        :meth:`push_tree_iter`: one fused collective dispatched per
+        iteration, yielding :class:`ShardPush` handles so the consumer
+        (the shard-local optimizer apply) interleaves with the
+        remaining buckets' dispatches.
+
+        Error-feedback residuals ride the int8 wire exactly like the
+        allreduce paths, keyed per LEAF (ownership is uniform across
+        push_tree/push_tree_iter/scatter — a trainer switching modes
+        carries its accumulated error along); with no all_gather leg,
+        the residual is the phase-1 error of this replica's whole
+        contribution.
+        """
+        from ptype_tpu.metrics import annotate, metrics
+
+        pairs = _flatten(prefix, stacked_tree)
+        t0 = _time.perf_counter()
+        groups = self._push_groups(pairs, op)
+        first = True
+        bucket_no = 0
+        for group_op, items in groups.items():
+            res = self._group_residuals(items)
+            pending = ({i: r for i, r in enumerate(res)
+                        if r is not None} if res is not None else {})
+            try:
+                it = collectives.bucketed_reduce_scatter_stream(
+                    [leaf for _, leaf in items], self.mesh,
+                    self.axis, group_op, residuals=res,
+                    **self._wire_kwargs(bucket_bytes))
+                while True:
+                    with annotate(f"store.push_tree/{prefix}"):
+                        if first:
+                            # Fault seam INSIDE the region (see push):
+                            # a straggler delay lands in the
+                            # collective leg.
+                            _store_fault("store.push", prefix)
+                            first = False
+                        try:
+                            b, flat, new_res = next(it)
+                        except StopIteration:
+                            break
+                        key = f"{prefix}/bucket{bucket_no:05d}"
+                        leaf_keys = [items[s.index][0]
+                                     for s in b.slots]
+                        flat = self._commit(
+                            key, flat, Binding(P(self.axis), group_op))
+                        if new_res is not None:
+                            for i, s in enumerate(b.slots):
+                                pending.pop(s.index, None)
+                                if new_res[i] is not None:
+                                    with self._lock:
+                                        self._residuals[
+                                            items[s.index][0]
+                                        ] = new_res[i]
+                        handle = ShardPush(prefix, bucket_no, key, b,
+                                           leaf_keys, flat)
+                        bucket_no += 1
                     yield handle
             finally:
                 if pending:

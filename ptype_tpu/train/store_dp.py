@@ -38,6 +38,18 @@ Gradient-exchange modes (``overlap``):
   the host never syncs for it). A custom ``optimizer`` falls back to
   the whole-tree apply with streamed waits (an arbitrary optax chain
   can't be split per bucket safely).
+
+``zero=True`` replaces the gradient allreduce entirely with the
+ZeRO-1 sharded weight update (parallel/zero.py, PAPERS.md arXiv
+2004.13336): gradients reduce-SCATTER bucket-by-bucket
+(``TensorStore.push_tree_scatter_iter`` — half the wire bytes, same
+int8+EF wire, residuals owned per shard), the default AdamW applies
+shard-locally (each replica materializes 1/N of the moments and does
+1/N of the update FLOPs), and the updated params allgather back —
+fused into the per-bucket apply program — before committing to the
+Store. The allgathers dispatch asynchronously, so they overlap the
+next step's data staging the same way the push_tree_iter stream
+overlaps the reduce.
 """
 
 from __future__ import annotations
@@ -48,7 +60,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
+from ptype_tpu.parallel.zero import ShardPlan, ZeroState
 from ptype_tpu.train.trainer import (_decay_mask, default_optimizer,
+                                     default_optimizer_hparams,
                                      default_optimizer_pieces,
                                      make_apply_fn)
 
@@ -60,27 +74,49 @@ class StoreDPTrainer:
 
     def __init__(self, cfg: tfm.TransformerConfig, store: TensorStore,
                  optimizer=None, rng: jax.Array | None = None,
-                 overlap=False):
+                 overlap=False, zero: bool = False,
+                 zero_hparams=None):
         if overlap not in _OVERLAP_MODES:
             raise ValueError(
                 f"StoreDPTrainer: overlap must be one of "
                 f"{_OVERLAP_MODES}, got {overlap!r}")
+        if zero and optimizer is not None:
+            raise ValueError(
+                "StoreDPTrainer: zero=True shards the DEFAULT AdamW "
+                "recipe (parallel/zero.py); an arbitrary optimizer "
+                "cannot be decomposed into shard-local flat applies — "
+                "tune it via zero_hparams (trainer.OptHParams) or "
+                "pass zero=False")
+        if zero_hparams is not None and not zero:
+            raise ValueError(
+                "StoreDPTrainer: zero_hparams only applies with "
+                "zero=True")
+        if zero and overlap is not False:
+            raise ValueError(
+                "StoreDPTrainer: zero=True has its own streamed "
+                "reduce-scatter pipeline; combine it with "
+                "overlap=False")
         self.cfg = cfg
         self.store = store
         self.mesh: Mesh = store.mesh
         self.axis = store.axis
         self.n_workers = int(self.mesh.shape[self.axis])
         self.overlap = overlap
+        self.zero = bool(zero)
         self._custom_opt = optimizer is not None
         self.optimizer = optimizer or default_optimizer()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         params = jax.jit(lambda r: tfm.init_params(r, cfg))(rng)
         # overlap=True with the default recipe trains through
-        # _bucket_states, NOT this whole-tree state — leave it None so
-        # a consumer (checkpoint, mode switch) fails loudly instead of
-        # silently reading never-updated init moments.
-        self.opt_state = (None if overlap is True and not self._custom_opt
+        # _bucket_states — and zero=True through the 1/N-resident
+        # ZeroState — NOT this whole-tree state: leave it None so a
+        # consumer (checkpoint, mode switch) fails loudly instead of
+        # silently reading never-updated init moments. PT007 enforces
+        # the converse: nothing in train/ may build full-tree state
+        # outside these init helpers.
+        self.opt_state = (None if zero
+                          or (overlap is True and not self._custom_opt)
                           else self.optimizer.init(params))
         seed_seq = self.store.put_tree("params", params)
         self._treedef = jax.tree_util.tree_structure(params)
@@ -107,6 +143,27 @@ class StoreDPTrainer:
         self._apply_fns: list | None = None
         self._sqnorm_fns: list | None = None
         self._scale_fn = None
+
+        # ZeRO-1 sharded update state (zero=True): the shard plan is
+        # known AT INIT (it is a pure function of the param shapes and
+        # the wire's bucket_bytes), so the moments materialize sharded
+        # from step 0 — no replica ever holds the full optimizer state.
+        self._zero: ZeroState | None = None
+        self._zero_order: list[int] | None = None
+        if self.zero:
+            # Slot order is the gradient stream's: store-sorted keys
+            # ("grads/..." sorts like "params/..." — same suffixes).
+            order = sorted(range(len(self._keys)),
+                           key=lambda i: self._keys[i])
+            self._zero_order = order
+            mask_leaves = jax.tree_util.tree_leaves(_decay_mask(params))
+            plan = ShardPlan.for_leaves(
+                [self._param_leaves[i] for i in order],
+                self.n_workers, self.store.wire.bucket_bytes)
+            self._zero = ZeroState.create(
+                plan, self.mesh, self.axis,
+                zero_hparams or default_optimizer_hparams(),
+                [mask_leaves[i] for i in order])
 
         # Per-worker grad fn, vmapped over the stacked worker batch dim —
         # one compiled program computing every worker's local grads, laid
@@ -179,11 +236,15 @@ class StoreDPTrainer:
             }
 
     def _step(self, batch: dict) -> dict:
+        from ptype_tpu.metrics import annotate
+
         stacked = self._stage(batch)
         params = self.params()
         losses, grads = self._grads_fn(params, stacked)
 
-        if self.overlap is True:
+        if self.zero:
+            self._reduce_apply_zero(grads)
+        elif self.overlap is True:
             self._reduce_apply_overlapped(params, grads)
         elif self.overlap == "drain":
             # Synchronous-DDP accounting: every bucket dispatched, then
@@ -196,8 +257,9 @@ class StoreDPTrainer:
             for h in handles:
                 h.wait()
             reduced = self._tree_from_handles(handles)
-            new_params, self.opt_state = self._apply_fn(
-                params, reduced, self.opt_state)
+            with annotate("train.opt"):
+                new_params, self.opt_state = self._apply_fn(
+                    params, reduced, self.opt_state)
             self._param_leaves = list(
                 jax.tree_util.tree_leaves(new_params))
             self._params_seq = self.store.put_tree("params", new_params)
@@ -212,9 +274,10 @@ class StoreDPTrainer:
                 self._treedef,
                 [reduced_flat[k.replace("params/", "grads/", 1)]
                  for k in self._keys])
-            new_params, self.opt_state = self._apply_fn(
-                params, reduced, self.opt_state
-            )
+            with annotate("train.opt"):
+                new_params, self.opt_state = self._apply_fn(
+                    params, reduced, self.opt_state
+                )
             self._param_leaves = list(
                 jax.tree_util.tree_leaves(new_params))
             # Stamp from the seqs OUR put assigned (not a re-read of
@@ -228,6 +291,57 @@ class StoreDPTrainer:
             "step": self.step_count,
             "grad_epoch": self.store.epoch(self._grad_key0()),
         }
+
+    # ------------------------------------------- ZeRO-1 sharded update
+
+    def _reduce_apply_zero(self, grads) -> None:
+        """The sharded weight update: stream the per-bucket gradient
+        reduce-SCATTER (bucket i's wait interleaves bucket i+1's
+        dispatch, like the overlap mode's allreduce stream), coordinate
+        the global-norm clip through per-bucket partial sqnorms, then
+        run the fused shard-local-AdamW + param-allgather program per
+        bucket. Everything dispatches async — the final put_tree's
+        arrays are still in flight while the next step stages data."""
+        from ptype_tpu.metrics import annotate
+
+        handles = []
+        sqs = []
+        prev = None
+        for h in self.store.push_tree_scatter_iter("grads", grads,
+                                                   op="mean"):
+            handles.append(h)
+            sqs.append(self._zero.partial_sqnorm(h.flat))
+            if prev is not None:
+                prev.wait()
+            prev = h
+        if prev is not None:
+            prev.wait()
+        # The shard-local optimizer leg — its own component in the
+        # goodput breakdown (health/goodput.py), so ZeRO's update-FLOP
+        # savings are visible in `obs top` and the bench tail.
+        with annotate("train.opt/zero"):
+            scale = self._zero.clip_scale(sqs)
+            for bi, h in enumerate(handles):
+                idxs = [self._zero_order[s.index]
+                        for s in h.bucket.slots]
+                newp = self._zero.apply_bucket(
+                    bi, [self._param_leaves[i] for i in idxs],
+                    h.flat, scale)
+                for i, leaf in zip(idxs, newp):
+                    self._param_leaves[i] = leaf
+            self._zero.finish_step()
+        new_params = jax.tree_util.tree_unflatten(
+            self._treedef, self._param_leaves)
+        self._params_seq = self.store.put_tree("params", new_params)
+
+    def zero_state(self) -> ZeroState:
+        """The 1/N-resident sharded optimizer state (zero=True only) —
+        what checkpoint.ZeroCheckpoint saves and restores."""
+        if self._zero is None:
+            raise ValueError(
+                "StoreDPTrainer: no ZeRO state — construct with "
+                "zero=True")
+        return self._zero
 
     # ---------------------------------------------- fine-grained overlap
 
@@ -265,23 +379,28 @@ class StoreDPTrainer:
                        zip(self._sqnorm_fns, sub_grads)]
         if prev is not None:
             prev.wait()
+        from ptype_tpu.metrics import annotate
+
         if self._custom_opt:
             # Arbitrary optimizer: whole-tree apply (streamed waits
             # above still gave the ledger its collective attribution).
             reduced = self._tree_from_handles(handles)
-            new_params, self.opt_state = self._apply_fn(
-                params, reduced, self.opt_state)
+            with annotate("train.opt"):
+                new_params, self.opt_state = self._apply_fn(
+                    params, reduced, self.opt_state)
             self._param_leaves = list(
                 jax.tree_util.tree_leaves(new_params))
         else:
-            scale = self._scale_fn(jnp.stack(sqs))
-            for bi in range(len(handles)):
-                subp = {str(i): self._param_leaves[i]
-                        for i in self._buckets[bi]}
-                newp, self._bucket_states[bi] = self._apply_fns[bi](
-                    subp, sub_grads[bi], self._bucket_states[bi], scale)
-                for i in self._buckets[bi]:
-                    self._param_leaves[i] = newp[str(i)]
+            with annotate("train.opt"):
+                scale = self._scale_fn(jnp.stack(sqs))
+                for bi in range(len(handles)):
+                    subp = {str(i): self._param_leaves[i]
+                            for i in self._buckets[bi]}
+                    newp, self._bucket_states[bi] = self._apply_fns[bi](
+                        subp, sub_grads[bi], self._bucket_states[bi],
+                        scale)
+                    for i in self._buckets[bi]:
+                        self._param_leaves[i] = newp[str(i)]
         new_params = jax.tree_util.tree_unflatten(
             self._treedef, self._param_leaves)
         self._params_seq = self.store.put_tree("params", new_params)
@@ -343,6 +462,9 @@ class StoreDPTrainer:
         self._scale_fn = jax.jit(scale_of)
 
     def _grad_key0(self) -> str:
+        if self.zero:
+            # The scatter path commits per BUCKET, not per leaf.
+            return "grads/bucket00000"
         return self._keys[0].replace("params/", "grads/", 1)
 
 
@@ -396,5 +518,61 @@ def measure_overlap(mesh: Mesh, preset: str = "tiny", steps: int = 6,
         "overlap_step_ms": over["step_breakdown"]["step_ms"],
         "steps": steps,
         "bucket_bytes": bucket_bytes,
+        "compress": compress,
+    }
+
+
+def measure_zero(mesh: Mesh, preset: str = "tiny", steps: int = 6,
+                 batch: int = 16, compress: str | None = None) -> dict:
+    """Per-replica optimizer-state bytes and step time, ZeRO-1 sharded
+    update vs the replicated store-DP baseline — the bench.py
+    ``zero_opt_mem_mb`` / ``zero_step_ms`` probe and the ISSUE 7
+    acceptance numbers. Runs the same loop twice with the same seed and
+    reports measured resident bytes (``addressable_shards``, not a
+    formula) plus the loss gap."""
+    from ptype_tpu.parallel.collectives import WireConfig
+    from ptype_tpu.train.data import synthetic_batches
+    import time as _t
+
+    cfg = tfm.preset(preset)
+    seq = min(cfg.max_seq, 128)
+
+    def opt_bytes(tree) -> int:
+        total = 0
+        for x in jax.tree_util.tree_leaves(tree):
+            shards = getattr(x, "addressable_shards", None)
+            total += (shards[0].data.nbytes if shards
+                      else getattr(x, "nbytes", 0))
+        return total
+
+    def run(zero: bool):
+        wire = WireConfig(compress=compress, int8_min_bytes=0)
+        trainer = StoreDPTrainer(cfg, TensorStore(mesh, wire=wire),
+                                 rng=jax.random.PRNGKey(0), zero=zero)
+        stream = synthetic_batches(cfg.vocab_size, batch, seq, seed=5)
+        trainer.step(next(stream))  # compile + warm
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            out = trainer.step(next(stream))
+        dt = (_t.perf_counter() - t0) / steps
+        if zero:
+            nbytes = trainer.zero_state().moment_bytes_per_replica()
+        else:
+            nbytes = opt_bytes(trainer.opt_state)
+        return dt, nbytes, out["loss"]
+
+    repl_dt, repl_bytes, repl_loss = run(False)
+    zero_dt, zero_bytes, zero_loss = run(True)
+    return {
+        "zero_opt_mem_mb": round(zero_bytes / 2**20, 3),
+        "repl_opt_mem_mb": round(repl_bytes / 2**20, 3),
+        "opt_mem_ratio": round(repl_bytes / zero_bytes, 2)
+        if zero_bytes else None,
+        "zero_step_ms": round(zero_dt * 1e3, 2),
+        "repl_step_ms": round(repl_dt * 1e3, 2),
+        "final_loss_zero": round(float(zero_loss), 5),
+        "final_loss_repl": round(float(repl_loss), 5),
+        "n_replicas": int(mesh.shape["data"]),
+        "steps": steps,
         "compress": compress,
     }

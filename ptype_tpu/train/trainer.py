@@ -60,6 +60,36 @@ def _decay_mask(params) -> Any:
     return jax.tree_util.tree_map_with_path(mask_leaf, params)
 
 
+@dataclass(frozen=True)
+class OptHParams:
+    """The default recipe's hyperparameters as ONE hashable record —
+    the single source every materialization of the recipe reads:
+    :func:`default_optimizer` (whole-tree optax chain),
+    :func:`default_optimizer_pieces` (per-bucket optax, overlap mode),
+    and the flat shard-local AdamW in :mod:`ptype_tpu.parallel.zero`
+    (ZeRO-1). Three copies of ``b1=0.9`` would silently drift; one
+    frozen dataclass cannot."""
+
+    lr: float = 3e-4
+    weight_decay: float = 0.1
+    warmup: int = 100
+    decay_steps: int = 100_000
+    clip: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+
+    def schedule(self):
+        return optax.warmup_cosine_decay_schedule(
+            0.0, self.lr, self.warmup, decay_steps=self.decay_steps,
+            end_value=self.lr * 0.1)
+
+
+def default_optimizer_hparams(**overrides) -> OptHParams:
+    """The default :class:`OptHParams` (overridable per field)."""
+    return OptHParams(**overrides)
+
+
 def default_optimizer_pieces(lr: float = 3e-4, weight_decay: float = 0.1,
                              warmup: int = 100, decay_steps: int = 100_000,
                              clip: float = 1.0):
@@ -71,15 +101,15 @@ def default_optimizer_pieces(lr: float = 3e-4, weight_decay: float = 0.1,
     coordinating only the clip scale across buckets
     (train/store_dp.py). :func:`default_optimizer` is assembled from
     the same pieces, so the two paths cannot drift."""
-    sched = optax.warmup_cosine_decay_schedule(
-        0.0, lr, warmup, decay_steps=decay_steps, end_value=lr * 0.1
-    )
+    hp = OptHParams(lr=lr, weight_decay=weight_decay, warmup=warmup,
+                    decay_steps=decay_steps, clip=clip)
+    sched = hp.schedule()
 
     def make_inner(mask):
-        return optax.adamw(sched, b1=0.9, b2=0.95,
-                           weight_decay=weight_decay, mask=mask)
+        return optax.adamw(sched, b1=hp.b1, b2=hp.b2,
+                           weight_decay=hp.weight_decay, mask=mask)
 
-    return clip, make_inner
+    return hp.clip, make_inner
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,

@@ -43,7 +43,7 @@ SLOW_FILES = {
     "test_quantized_train.py",
     "test_race.py", "test_resnet.py", "test_ring_attention.py",
     "test_scale.py", "test_serve.py", "test_store_bench.py",
-    "test_tpu_smoke.py", "test_train.py",
+    "test_tpu_smoke.py", "test_train.py", "test_zero_train.py",
 }
 
 

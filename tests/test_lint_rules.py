@@ -386,3 +386,77 @@ def test_pt006_flags_keyword_dtype_form(tmp_path):
            "    return x.astype(dtype=jnp.int8)\n")
     findings = _check(tmp_path, "ptype_tpu/parallel/kw.py", src)
     assert any("PT006" in f for f in findings), findings
+
+
+PT007_HOT_PATH = (
+    "class T:\n"
+    "    def step(self, params, grads):\n"
+    "        state = self.optimizer.init(params)\n"
+    "        return state\n"
+)
+
+
+def test_pt007_flags_full_tree_opt_state_in_step_path(tmp_path):
+    findings = _check(tmp_path, "train/hot.py", PT007_HOT_PATH)
+    assert any("PT007" in f for f in findings), findings
+
+
+def test_pt007_flags_bare_and_call_receivers(tmp_path):
+    src = ("def step(optimizer, params):\n"
+           "    return optimizer.init(params)\n")
+    findings = _check(tmp_path, "train/bare.py", src)
+    assert any("PT007" in f for f in findings), findings
+    src = ("from x import default_optimizer\n"
+           "def refresh(params):\n"
+           "    return default_optimizer().init(params)\n")
+    findings = _check(tmp_path, "train/call.py", src)
+    assert any("PT007" in f for f in findings), findings
+
+
+def test_pt007_sanctions_init_helpers(tmp_path):
+    src = ("class T:\n"
+           "    def __init__(self, params):\n"
+           "        self.opt_state = self.optimizer.init(params)\n"
+           "def init_state(optimizer, params):\n"
+           "    return optimizer.init(params)\n"
+           "def _init_bucket_apply(opt, params):\n"
+           "    return opt.init(params)\n")
+    findings = _check(tmp_path, "train/ok.py", src)
+    assert not any("PT007" in f for f in findings), findings
+
+
+def test_pt007_ignores_non_optimizer_inits(tmp_path):
+    src = ("def step(sampler, params):\n"
+           "    return sampler.init(params)\n")
+    findings = _check(tmp_path, "train/other.py", src)
+    assert not any("PT007" in f for f in findings), findings
+
+
+def test_pt007_silent_outside_train(tmp_path):
+    findings = _check(tmp_path, "parallel/hot.py", PT007_HOT_PATH)
+    assert not any("PT007" in f for f in findings), findings
+
+
+def test_pt007_honors_noqa(tmp_path):
+    src = ("def step(optimizer, params):\n"
+           "    return optimizer.init(params)  # noqa: test fixture\n")
+    findings = _check(tmp_path, "train/sup7.py", src)
+    assert not any("PT007" in f for f in findings), findings
+
+
+def test_train_package_is_pt007_clean():
+    """Every full-tree optimizer-state construction in train/ lives in
+    an init helper — the seam the ZeRO-1 sharded update replaces
+    (ISSUE 7 satellite)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "train")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt007 = [f for f in findings if "PT007" in f]
+    assert not pt007, pt007

@@ -59,23 +59,51 @@ def test_trainer_dp_loss_decreases(tiny):
     assert 0 <= rates["mfu"] < 1
 
 
-def test_trainer_fsdp_tp_matches_dp(tiny):
-    """Same rng + data ⇒ same loss trajectory under any sharding —
-    the GSPMD-inserted collectives must not change the math."""
+def test_trainer_fsdp_tp_matches_dp():
+    """Same model + data ⇒ same loss trajectory under any sharding —
+    the GSPMD-inserted collectives must not change the math.
+
+    Deflaked (it used to fail identically on the pristine tree) by
+    pinning the two things that made it compare different COMPUTATIONS
+    instead of different shardings of one computation:
+
+    - **One shared init.** With ``jax_threefry_partitionable=False``
+      (this jax), a jit'd init with sharded ``out_shardings`` draws
+      DIFFERENT random values per mesh — the dp and tp runs were
+      different models, so no tolerance was meaningful. The dp init is
+      device_put into every other mesh's shardings instead.
+    - **f32 compute.** bf16 matmuls under different partitionings
+      reduce in different orders; that noise (~3e-3 relative on this
+      model) is a dtype property, not a collectives bug. In f32 the
+      cross-sharding agreement is ~1e-6, asserted at rtol=1e-4.
+    """
+    from ptype_tpu.train.trainer import TrainState
+
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
     losses = {}
+    host_params = None
     for name, axes in (
         ("dp", {"data": 8}),
         ("fsdp", {"data": 2, "fsdp": 4}),
         ("tp", {"data": 2, "fsdp": 2, "model": 2}),
     ):
         mesh = build_mesh(axes)
-        tr = Trainer(tiny, mesh, optimizer=default_optimizer(lr=1e-3),
+        tr = Trainer(cfg, mesh, optimizer=default_optimizer(lr=1e-3),
                      rng=jax.random.PRNGKey(42))
-        it = _batches(tiny)
+        if host_params is None:
+            host_params = jax.tree.map(np.asarray, tr.state.params)
+        else:
+            # opt-state init is zeros/counters (sharding-invariant);
+            # only the random params need pinning.
+            tr.state = TrainState(
+                jax.device_put(host_params,
+                               tr.state_shardings.params),
+                tr.state.opt_state, tr.state.step)
+        it = _batches(cfg)
         out = [tr.step(next(it))["loss"] for _ in range(3)]
         losses[name] = out
-    np.testing.assert_allclose(losses["dp"], losses["fsdp"], rtol=2e-3)
-    np.testing.assert_allclose(losses["dp"], losses["tp"], rtol=2e-3)
+    np.testing.assert_allclose(losses["dp"], losses["fsdp"], rtol=1e-4)
+    np.testing.assert_allclose(losses["dp"], losses["tp"], rtol=1e-4)
 
 
 def test_shard_update_matches_dp_and_shards_moments(tiny):

@@ -43,6 +43,13 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   ±127 saturate, sub-1 magnitudes round to zero); int8 wires must go
   through the block-scaled quantizers (``_q_int8_blockwise`` /
   ``quantize_leaf``), which pair every payload with its absmax scales
+- PT007 (train/ only): ``optimizer.init(...)`` (full-tree optimizer
+  state construction) outside the init/constructor helpers
+  (``__init__`` / ``init_*`` / ``_init*``) — replicated whole-tree
+  moments are exactly what the ZeRO-1 sharded update
+  (parallel/zero.ZeroState — 1/N resident per replica) exists to
+  eliminate; step/hot paths must consume the sharded or per-bucket
+  state those helpers set up, never rebuild the full tree
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -410,6 +417,68 @@ class _RawInt8CastCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: Enclosing-function prefixes where constructing full-tree optimizer
+#: state is sanctioned: constructors and the dedicated init helpers —
+#: the one place a sharding-aware path (zero=True, overlap=True) can
+#: intercept and replace the replicated state.
+_OPT_INIT_SANCTIONED = ("__init__", "init_", "_init")
+
+
+def _terminal_name(node: ast.expr) -> str | None:
+    """The last identifier of a receiver expression: ``optimizer`` for
+    ``self.optimizer``, ``default_optimizer`` for
+    ``default_optimizer()``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return None
+
+
+class _FullTreeOptStateCheck(ast.NodeVisitor):
+    """PT007: ``<...optimizer>.init(...)`` in train/ outside the
+    init/constructor helpers. A full optimizer-state tree replicated
+    per replica is the memory ceiling the sharded weight update
+    removes; building one in a step/hot path silently reintroduces it
+    (and reads as 'works' until the model grows)."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        self.fn_stack: list[str] = []
+
+    def _fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def _sanctioned(self) -> bool:
+        return any(name.startswith(_OPT_INIT_SANCTIONED)
+                   for name in self.fn_stack)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "init"
+                and not self._sanctioned()):
+            recv = _terminal_name(fn.value)
+            if recv is not None and (
+                    "optimizer" in recv.lower() or recv in ("opt",
+                                                            "_opt")):
+                self.findings.append(
+                    f"{self.path}:{node.lineno}: PT007 full-tree "
+                    f"optimizer state constructed outside the init "
+                    f"helpers ({recv}.init) — replicated moments cap "
+                    f"trainable model size; hot paths must use the "
+                    f"sharded state (parallel/zero.ZeroState, 1/N per "
+                    f"replica) or the per-bucket states the init "
+                    f"helpers set up")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -457,6 +526,9 @@ def check_file(path: str, findings: list[str]) -> None:
     parts = os.path.normpath(path).split(os.sep)
     if "train" in parts:
         _PerLeafCollectiveCheck(path, raw).visit(tree)
+        # Full-tree optimizer state belongs in init helpers only —
+        # the seam the ZeRO-1 sharded update replaces.
+        _FullTreeOptStateCheck(path, raw).visit(tree)
     if "ptype_tpu" in parts and os.path.basename(path) != "retry.py":
         # retry.py IS the sanctioned sleeper; everything else in the
         # package must go through it.
