@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench collectives-bench zero-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
+.PHONY: test test-all bench serve-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -40,6 +40,16 @@ collectives-bench:
 zero-bench:
 	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
 		python bench.py --zero
+
+# Profiling-plane microbench on the 8-device virtual host mesh
+# (docs/OBSERVABILITY.md "Profiling plane"): the capture-disabled
+# overhead of the armed plane on the store-DP loop (<1% acceptance),
+# the live-capture step cost, and the compiled-vs-analytic FLOPs gap
+# on the 125M config (XLA cost_analysis, layer scan unrolled) — the
+# ISSUE 8 acceptance numbers.
+profile-bench:
+	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
+		python bench.py --profile
 
 # Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
 # fault schedule against the in-process trainer + registry +

@@ -38,6 +38,16 @@ operations need. Commands:
                recent alerts ($TOP_ITERS bounds the refreshes for
                scripted runs; ^C exits). docs/OPERATIONS.md has the
                per-alert runbook.
+- ``obs profile`` — cluster-wide device profiling: simultaneous
+               jax.profiler XPlane capture on every registered node
+               via the built-in ptype.Profile endpoint
+               ($PROFILE_DURATION seconds, default 1), artifacts
+               shipped back under $OBS_DIR/profile/<node>/, then a
+               host-side top-ops table + per-node HBM table (no
+               TensorBoard needed; load the .xplane.pb there for the
+               full device timeline). ``obs profile summarize``
+               re-parses an existing artifact tree ($PROFILE_DIR or
+               $OBS_DIR/profile) without touching the cluster.
 """
 
 from __future__ import annotations
@@ -274,6 +284,55 @@ def _witness() -> None:
         srv.close()
 
 
+def _obs_profile_summarize(root: str) -> None:
+    """Host-side re-parse of an artifact tree — one top-ops table per
+    node directory (or the root itself when it holds a capture)."""
+    import os
+
+    from ptype_tpu.health import profiling
+
+    if not os.path.isdir(root):
+        print(f"no artifacts under {root} (set $PROFILE_DIR or "
+              f"$OBS_DIR, or run `obs profile` first)")
+        return
+    dirs = [os.path.join(root, d) for d in sorted(os.listdir(root))
+            if os.path.isdir(os.path.join(root, d))] or [root]
+    for d in dirs:
+        s = profiling.summarize(d)
+        if not s["files"]:
+            continue
+        print(f"{d}: {len(s['files'])} files, {s['events']} events")
+        for op in s["top_ops"]:
+            print(f"  {op['total_us']:>12.1f} us  x{op['count']:<6} "
+                  f"{op['name'][:80]}")
+
+
+def _obs_profile(registry) -> None:
+    import os
+
+    from ptype_tpu import telemetry as tel
+    from ptype_tpu.health import profiling
+
+    out_dir = os.path.join(os.environ.get("OBS_DIR", "."), "profile")
+    dur = float(os.environ.get("PROFILE_DURATION", "1"))
+    res = tel.cluster_profile(registry, duration_s=dur,
+                              out_dir=out_dir)
+    print(f"cluster profile @ {res['ts']} ({dur}s capture)")
+    for key in sorted(res["nodes"]):
+        n = res["nodes"][key]
+        print(f"{key}: {len(n['files'])} artifacts -> {n['dir']}")
+        s = profiling.summarize(n["dir"], top=8)
+        for op in s["top_ops"]:
+            print(f"  {op['total_us']:>12.1f} us  x{op['count']:<6} "
+                  f"{op['name'][:80]}")
+        if n.get("memory"):
+            print(profiling.render_hbm_table(n["memory"]))
+    for key in sorted(res["errors"]):
+        print(f"{key}: FAILED ({res['errors'][key]})")
+    print(f"artifacts under {out_dir} (xplane.pb loads in "
+          f"TensorBoard's profile plugin / xprof)")
+
+
 def _obs() -> None:
     import os
 
@@ -282,9 +341,21 @@ def _obs() -> None:
     from ptype_tpu.coord.remote import RemoteCoord
     from ptype_tpu.registry import CoordRegistry
 
+    if (len(sys.argv) > 3 and sys.argv[2] == "profile"
+            and sys.argv[3] == "summarize"):
+        # Offline re-parse of an existing artifact tree — the
+        # post-mortem path must work with the cluster (and its
+        # coordinator) down, so dispatch before dialing anything.
+        _obs_profile_summarize(os.environ.get(
+            "PROFILE_DIR",
+            os.path.join(os.environ.get("OBS_DIR", "."), "profile")))
+        return
     cfg = config_from_env()
     coord = RemoteCoord([cfg.platform.coordinator_address])
     try:
+        if len(sys.argv) > 2 and sys.argv[2] == "profile":
+            _obs_profile(CoordRegistry(coord))
+            return
         if len(sys.argv) > 2 and sys.argv[2] == "top":
             from ptype_tpu.health import run_top
 

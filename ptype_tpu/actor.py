@@ -33,6 +33,15 @@ _local_servers: dict[tuple[str, int], "ActorServer"] = {}
 _local_lock = threading.Lock()
 
 
+def _profile_endpoint(cmd: str, options=None):
+    """The built-in ``ptype.Profile`` handler — a lazy shim so actor.py
+    stays import-light (profiling pulls in the health plane; this
+    module must import before it)."""
+    from ptype_tpu.health import profiling
+
+    return profiling.endpoint(cmd, options)
+
+
 def lookup_local(address: str, port: int) -> "ActorServer | None":
     with _local_lock:
         server = _local_servers.get((address, port))
@@ -50,12 +59,14 @@ class ActorServer:
         # advertises the host's routable IP (cluster.go:198-213), so the
         # server must be reachable on it.
         self._handlers: dict[str, object] = {}
-        # Built-in observability endpoint: every actor server answers
+        # Built-in observability endpoints: every actor server answers
         # the cluster telemetry pull plane (metrics snapshot + recent
-        # spans from the flight recorder) without registration —
-        # ptype_tpu.telemetry.cluster_snapshot walks the registry and
-        # calls this on every node.
+        # spans from the flight recorder) and the profiling plane
+        # (jax.profiler XPlane capture + HBM snapshots) without
+        # registration — ptype_tpu.telemetry.cluster_snapshot /
+        # cluster_profile walk the registry and call these per node.
         self._handlers["ptype.Telemetry"] = trace.telemetry
+        self._handlers["ptype.Profile"] = _profile_endpoint
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))

@@ -6,19 +6,26 @@ metrics registry (:mod:`~ptype_tpu.health.series`), a per-step
 goodput ledger + cross-node straggler detection over the
 ``metrics.annotate`` seam (:mod:`~ptype_tpu.health.goodput`),
 declarative alert rules with an engine that logs, counts, and
-triggers flight-recorder dumps (:mod:`~ptype_tpu.health.rules`), and
-the live ``obs top`` view (:mod:`~ptype_tpu.health.top`). See
+triggers flight-recorder dumps (:mod:`~ptype_tpu.health.rules`), the
+live ``obs top`` view (:mod:`~ptype_tpu.health.top`), and — since
+ISSUE 8 — the profiling plane (:mod:`~ptype_tpu.health.profiling`):
+the ``ptype.Profile`` actor endpoint, alert-triggered device-profile
+capture, and compiled-cost MFU accounting. See
 docs/OBSERVABILITY.md ("Health plane & alerting") and the per-alert
 runbook in docs/OPERATIONS.md.
 """
 
 from ptype_tpu.health.goodput import (GoodputLedger, detect_stragglers,
                                       node_series_means, node_span_means)
+from ptype_tpu.health.profiling import (AlertCapture, ProfileError,
+                                        compiled_cost,
+                                        measure_compiled_cost,
+                                        summarize)
 from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
                                     ClusterView, CoordFlapRule, LossRule,
-                                    MemoryGrowthRule, P99Rule, Rule,
-                                    StallRule, StragglerRule,
-                                    default_rules)
+                                    MemoryGrowthRule, MfuGapRule,
+                                    P99Rule, Rule, StallRule,
+                                    StragglerRule, default_rules)
 from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
                                      telemetry_endpoint)
 from ptype_tpu.health.top import render_top, run_top
@@ -27,8 +34,10 @@ __all__ = [
     "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
     "GoodputLedger", "detect_stragglers", "node_series_means",
     "node_span_means",
+    "AlertCapture", "ProfileError", "compiled_cost",
+    "measure_compiled_cost", "summarize",
     "Alert", "AlertEngine", "ClusterView", "Rule", "BurnRateRule",
     "P99Rule", "StallRule", "StragglerRule", "LossRule",
-    "CoordFlapRule", "MemoryGrowthRule", "default_rules",
+    "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "default_rules",
     "render_top", "run_top",
 ]

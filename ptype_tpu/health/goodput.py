@@ -105,6 +105,9 @@ class GoodputLedger:
         self.flops_per_token = float(flops_per_token)
         self.n_chips = int(n_chips)
         self.peak_tflops = peak_tflops
+        #: FLOPs per step as XLA compiled them (health/profiling
+        #: compiled-cost accounting) — 0.0 until set_compiled_flops.
+        self.compiled_flops_per_step = 0.0
         self._lock = threading.Lock()
         #: (component, dur_s, monotonic end) for regions finished since
         #: the last step closed — the end stamp lets _close_step split
@@ -141,6 +144,19 @@ class GoodputLedger:
         """Time a region directly into this ledger — the simulated-
         node path; real processes install() onto the annotate seam."""
         return _Region(self, name)
+
+    def set_compiled_flops(self, flops_per_step: float) -> "GoodputLedger":
+        """Arm the compiled-cost MFU: ``flops_per_step`` from XLA's
+        ``cost_analysis`` over the step programs
+        (:func:`ptype_tpu.health.profiling.compiled_cost`, e.g.
+        ``StoreDPTrainer.compiled_cost()["flops"]``). Each closed step
+        then records ``mfu_compiled`` next to the analytic ``mfu`` —
+        and ``mfu_gap_pct`` when both exist, the disagreement the
+        ``mfu-divergence`` alert rule watches (a silent remat or dtype
+        change shifts real FLOPs; the formula never notices)."""
+        with self._lock:
+            self.compiled_flops_per_step = float(flops_per_step)
+        return self
 
     def install(self) -> "GoodputLedger":
         """Become the process's annotate observer: every
@@ -206,11 +222,22 @@ class GoodputLedger:
                     rec["mfu"] = round(metrics_mod.mfu(
                         tps, self.flops_per_token, self.n_chips,
                         self.peak_tflops), 5)
+            if self.compiled_flops_per_step and wall > 0:
+                # tokens/sec × flops/token == flops/sec: feed the
+                # shared mfu() with (1/wall, flops_per_step).
+                rec["mfu_compiled"] = round(metrics_mod.mfu(
+                    1.0 / wall, self.compiled_flops_per_step,
+                    self.n_chips, self.peak_tflops), 5)
+                if rec.get("mfu"):
+                    rec["mfu_gap_pct"] = round(
+                        100.0 * (rec["mfu_compiled"] - rec["mfu"])
+                        / rec["mfu"], 2)
             self._records.append(rec)
         reg = self.registry
         for key in ("step_ms", "compute_ms", "collective_ms", "data_ms",
                     "checkpoint_ms", "optimizer_ms", "stall_ms",
-                    "goodput_pct", "tokens_per_sec", "mfu"):
+                    "goodput_pct", "tokens_per_sec", "mfu",
+                    "mfu_compiled", "mfu_gap_pct"):
             if key in rec:
                 name = "goodput.pct" if key == "goodput_pct" \
                     else f"goodput.{key}"
@@ -262,6 +289,10 @@ class GoodputLedger:
             out["tokens_per_sec"] = mean("tokens_per_sec")
         if "mfu" in recs[-1]:
             out["mfu"] = round(mean("mfu"), 5)
+        if "mfu_compiled" in recs[-1]:
+            out["mfu_compiled"] = round(mean("mfu_compiled"), 5)
+        if "mfu_gap_pct" in recs[-1]:
+            out["mfu_gap_pct"] = round(mean("mfu_gap_pct"), 2)
         return out
 
 

@@ -27,6 +27,9 @@ rule                  fires when
                       window (promotion churn — dueling standbys)
 ``memory-growth``     a memory watermark grows past ``growth_frac``
                       within the window above a floor
+``mfu-divergence``    compiled-cost MFU (``goodput.mfu_compiled``, from
+                      XLA cost_analysis — health/profiling.py) disagrees
+                      with the analytic MFU by more than ``gap_frac``
 ====================  ====================================================
 
 Every rule takes the evaluation time from the :class:`ClusterView`
@@ -440,6 +443,43 @@ class MemoryGrowthRule(Rule):
         return out
 
 
+class MfuGapRule(Rule):
+    """Compiled-vs-analytic MFU disagreement: both series exist for a
+    node (the ledger computed ``mfu`` AND was armed with
+    ``set_compiled_flops``) and the latest points differ by more than
+    ``gap_frac`` relative — the signature of a silent remat, a dtype
+    change, or a stale analytic formula shifting real FLOPs while the
+    dashboard keeps smiling."""
+
+    name = "mfu-divergence"
+    severity = "warn"
+
+    def __init__(self, gap_frac: float = 0.25,
+                 analytic: str = "goodput.mfu",
+                 compiled: str = "goodput.mfu_compiled"):
+        self.gap_frac = float(gap_frac)
+        self.analytic = analytic
+        self.compiled = compiled
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            a = view.last(node, self.analytic)
+            c = view.last(node, self.compiled)
+            if a is None or c is None or a[1] <= 0 or c[1] <= 0:
+                continue
+            gap = abs(c[1] - a[1]) / a[1]
+            if gap > self.gap_frac:
+                out.append(self._alert(
+                    node,
+                    f"compiled-cost MFU {c[1]:.4f} vs analytic "
+                    f"{a[1]:.4f} ({100 * gap:.0f}% apart) — check for "
+                    f"a silent remat/dtype change or a stale "
+                    f"flops-per-token formula",
+                    value=gap, threshold=self.gap_frac))
+        return out
+
+
 def default_rules(service: str = "llm",
                   slo_p99_ms: float | None = None) -> list[Rule]:
     """The stock watchdog set; ``slo_p99_ms`` adds the latency rule."""
@@ -450,6 +490,7 @@ def default_rules(service: str = "llm",
         LossRule(),
         CoordFlapRule(),
         MemoryGrowthRule(),
+        MfuGapRule(),
     ]
     if slo_p99_ms is not None:
         rules.insert(1, P99Rule(service=service, slo_p99_ms=slo_p99_ms))
@@ -463,14 +504,23 @@ class AlertEngine:
     re-firing within ``cooldown_s`` is suppressed, so a polling loop
     does not page once per poll for one ongoing condition. History
     stays in :attr:`alerts` (bounded) for the top view.
+
+    ``capture`` takes an alert callable — in practice
+    :class:`ptype_tpu.health.profiling.AlertCapture`, which turns a
+    ``straggler``/``train-stall``/``slo-p99`` firing into a short
+    device-profile capture on the NAMED node (its own rate limit, its
+    own thread) so the page ships with its evidence. Any hook failure
+    is logged, never raised: the watchdog outlives its attachments.
     """
 
     def __init__(self, rules: list[Rule] | None = None,
                  cooldown_s: float = 30.0, dump: bool = True,
-                 registry: metrics_mod.MetricsRegistry | None = None):
+                 registry: metrics_mod.MetricsRegistry | None = None,
+                 capture=None):
         self.rules = rules if rules is not None else default_rules()
         self.cooldown_s = float(cooldown_s)
         self.dump = dump
+        self.capture = capture
         self.registry = (registry if registry is not None
                          else metrics_mod.metrics)
         self.alerts: collections.deque = collections.deque(maxlen=256)
@@ -508,6 +558,14 @@ class AlertEngine:
             log.warning("health alert", kv=alert.to_dict())
             if self.dump:
                 trace.maybe_dump(f"alert:{alert.rule}:{alert.node}")
+            if self.capture is not None:
+                try:
+                    self.capture(alert)
+                except Exception as e:  # noqa: BLE001 — a broken
+                    # capture hook must not kill the watchdog.
+                    log.warning("alert capture hook failed",
+                                kv={"rule": alert.rule,
+                                    "node": alert.node, "err": repr(e)})
         return kept
 
     def recent(self, limit: int = 16) -> list[Alert]:

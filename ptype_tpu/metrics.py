@@ -33,16 +33,90 @@ PEAK_TFLOPS = {
     "cpu": 0.5,
 }
 
+#: Env override for the peak table: either one bare float (the peak
+#: for whatever chip this process sees — the operator knows better
+#: than the substring table) or ``kind=tflops`` pairs merged over it
+#: (``"trillium=918,v7=2000"``). A new chip generation must be one
+#: env var away from a correct MFU, not a code change.
+PEAK_TFLOPS_ENV = "PTYPE_PEAK_TFLOPS"
+
+#: Process-level override (set_peak_tflops) — wins over env and table.
+_peak_override: float | None = None
+#: device_kinds already warned about — the unknown-platform fallback
+#: logs ONCE per kind, not once per MFU computation.
+_peak_warned: set = set()
+
+
+def set_peak_tflops(value: float | None) -> None:
+    """Pin (or clear, with ``None``) the per-chip peak used by every
+    MFU computation in this process — the config-file seam; the env
+    seam is :data:`PEAK_TFLOPS_ENV`."""
+    global _peak_override
+    _peak_override = None if value is None else float(value)
+
+
+def _peak_env() -> tuple[float | None, dict]:
+    """(flat override, table additions) parsed from the env var;
+    malformed entries are ignored (a typo must not break MFU)."""
+    import os
+
+    raw = os.environ.get(PEAK_TFLOPS_ENV, "").strip()
+    if not raw:
+        return None, {}
+    extra: dict = {}
+    flat = None
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            key, _, val = part.partition("=")
+            try:
+                extra[key.strip().lower()] = float(val)
+            except ValueError:
+                pass
+        else:
+            try:
+                flat = float(part)
+            except ValueError:
+                pass
+    return flat, extra
+
 
 def device_peak_tflops(device=None) -> float:
-    """Best-effort peak bf16 TFLOP/s for a device (default: devices()[0])."""
+    """Best-effort peak bf16 TFLOP/s for a device (default:
+    devices()[0]). Resolution order: :func:`set_peak_tflops` override,
+    a bare-float :data:`PEAK_TFLOPS_ENV`, then the device_kind
+    substring table (env ``kind=value`` pairs take precedence within
+    it). An UNKNOWN non-CPU platform falls back to the v5e figure and
+    logs once per kind — MFU is never quietly computed against a
+    wrong peak without a trail."""
+    if _peak_override is not None:
+        return _peak_override
+    flat, extra = _peak_env()
+    if flat is not None:
+        return flat
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "") or device.platform
     kind = kind.lower()
+    for key, tf in extra.items():
+        if key in kind:
+            return tf
     for key, tf in PEAK_TFLOPS.items():
         if key in kind:
             return tf
-    return PEAK_TFLOPS["cpu"] if device.platform == "cpu" else 197.0
+    if device.platform == "cpu":
+        return PEAK_TFLOPS["cpu"]
+    if kind not in _peak_warned:
+        _peak_warned.add(kind)
+        from ptype_tpu import logs
+
+        logs.get_logger("metrics").warning(
+            "unknown accelerator kind; MFU will use the v5e peak — "
+            "override with the env table",
+            kv={"device_kind": kind, "fallback_tflops":
+                PEAK_TFLOPS["v5e"], "env": PEAK_TFLOPS_ENV})
+    return PEAK_TFLOPS["v5e"]
 
 
 def mfu(tokens_per_sec: float, flops_per_token: float,

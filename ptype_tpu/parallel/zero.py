@@ -307,6 +307,33 @@ class ZeroState:
     def finish_step(self) -> None:
         self.count += 1
 
+    def compiled_cost(self) -> dict:
+        """XLA ``cost_analysis`` totals for the per-bucket fused
+        shard-apply programs (ISSUE 8 compiled-cost accounting).
+        ``cost_analysis`` reports the per-partition SPMD module, so
+        the totals are multiplied by the mesh size — the CLUSTER's
+        update FLOPs, comparable with the gradient program's
+        full-batch count."""
+        from ptype_tpu.health.profiling import compiled_cost
+
+        n = int(self.mesh.shape[self.axis])
+        flops = nbytes = 0.0
+        for b in self.plan.buckets:
+            shapes = tuple(s.shape for s in b.slots)
+            fn = _shard_apply_fn(self.mesh, self.axis, shapes,
+                                 b.dtype, b.pad, self.hparams)
+            dt = jnp.dtype(b.dtype)
+            leaves = [jax.ShapeDtypeStruct(s, dt) for s in shapes]
+            vec = jax.ShapeDtypeStruct((b.elems,), jnp.float32)
+            c = compiled_cost(
+                fn, *leaves, jax.ShapeDtypeStruct((b.elems,), dt),
+                vec, vec, vec, jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32))
+            flops += c["flops"] * n
+            nbytes += c["bytes_accessed"] * n
+        return {"flops": flops, "bytes_accessed": nbytes,
+                "n_buckets": len(self.plan.buckets)}
+
     # ------------------------------------------------------- accounting
 
     def moment_bytes_per_replica(self) -> int:

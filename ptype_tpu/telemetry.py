@@ -17,7 +17,11 @@ half:
   wall-clock timeline, stitched by trace id;
 - :func:`write_spans_jsonl` is the grep/jq tier (one span per line);
 - :func:`render_summary` is the operator one-pager behind
-  ``python -m ptype_tpu obs`` and ``make obs-demo``.
+  ``python -m ptype_tpu obs`` and ``make obs-demo``;
+- :func:`cluster_profile` is the profiling-plane sibling (ISSUE 8): a
+  simultaneous ``jax.profiler`` XPlane capture across every node via
+  the built-in ``ptype.Profile`` endpoint, artifacts shipped back and
+  written per node — ``python -m ptype_tpu obs profile``.
 
 Also home to :func:`measure_trace_overhead` — the bench probe backing
 ``trace_overhead_pct`` in bench.py's tail record (the ~zero-cost
@@ -134,6 +138,101 @@ def stitch_traces(spans: list[dict]) -> dict[str, list[dict]]:
     for tid in traces:
         traces[tid].sort(key=lambda s: s.get("start_s", 0.0))
     return traces
+
+
+# ---------------------------------------------------- cluster profiling
+
+
+def node_profile(node: Node, duration_s: float = 0.5,
+                 timeout: float | None = None,
+                 include_data: bool = True, label: str = "cluster",
+                 dial_timeout: float = DEFAULT_NODE_TIMEOUT_S) -> dict:
+    """One node's ``ptype.Profile`` capture over its actor surface:
+    start an XPlane capture, run ``duration_s``, stop, and ship the
+    artifact bytes + HBM snapshot back in the reply. Shared by
+    :func:`cluster_profile` and the health plane's alert-triggered
+    capture (``label="alert"``) — one dial/capture/ship sequence."""
+    from ptype_tpu import rpc as rpc_mod
+
+    timeout = (duration_s + 15.0) if timeout is None else timeout
+    conn = rpc_mod._dial(node, dial_timeout=dial_timeout)
+    try:
+        fut = conn.call_async(
+            "ptype.Profile",
+            ("capture", {"duration_s": duration_s, "label": label,
+                         "include_data": include_data}))
+        return fut.result(timeout=timeout)
+    finally:
+        conn.close()
+
+
+def cluster_profile(registry: Registry, duration_s: float = 0.5,
+                    out_dir: str = ".",
+                    services: list[str] | None = None,
+                    timeout: float | None = None) -> dict:
+    """Simultaneous device-profile capture across every registered
+    node (ISSUE 8): every node's ``ptype.Profile`` endpoint starts its
+    capture concurrently, so the per-node XPlane timelines cover ONE
+    overlapping wall-clock window — and because ``metrics.annotate``
+    feeds both the profiler and the distributed-trace plane, the
+    ``train.step`` / ``store.push*`` regions in each device timeline
+    line up with the same regions in the stitched span view
+    (:func:`cluster_snapshot`).
+
+    Artifacts land under ``out_dir/<service_addr_port>/`` per node
+    (XPlane ``.pb`` + the host-parseable ``.trace.json.gz`` —
+    :func:`ptype_tpu.health.profiling.summarize` reads the latter with
+    no TensorBoard). Returns ``{"ts", "duration_s", "nodes":
+    {key: {"dir", "files", "memory"}}, "errors": {key: why}}`` — like
+    the telemetry pull, a partial capture of a degraded fleet is the
+    point, so per-node failures (dead node, profiler already busy)
+    never fail the walk.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ptype_tpu.health import profiling
+
+    out: dict = {"ts": round(time.time(), 3),
+                 "duration_s": float(duration_s),
+                 "nodes": {}, "errors": {}}
+    svc_map = registry.services()
+    targets: list[tuple[str, Node]] = []
+    for service in sorted(svc_map):
+        if services is not None and service not in services:
+            continue
+        for node in svc_map[service]:
+            targets.append((f"{service}/{node.address}:{node.port}", node))
+    if targets:
+        # Concurrent on purpose — simultaneity IS the feature: the
+        # fleet's captures must cover one shared window or cross-node
+        # comparisons (who stalls while whose reduce runs) mean
+        # nothing. One thread per node (they are I/O-bound waiters):
+        # a 16-worker cap would queue the overflow into a LATER,
+        # non-overlapping window and silently void that contract.
+        with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+            futs = {key: pool.submit(node_profile, node,
+                                     duration_s=duration_s,
+                                     timeout=timeout)
+                    for key, node in targets}
+    else:
+        futs = {}
+    for key, fut in futs.items():
+        try:
+            result = fut.result()
+        except Exception as e:  # noqa: BLE001 — partial is the point
+            out["errors"][key] = f"{type(e).__name__}: {e}"
+            continue
+        node_dir = os.path.join(
+            out_dir, key.replace("/", "_").replace(":", "_"))
+        files = profiling.write_artifacts(node_dir, result)
+        out["nodes"][key] = {
+            "dir": node_dir,
+            "files": [os.path.relpath(p, node_dir) for p in files],
+            "remote_dir": result.get("dir"),
+            "capture_s": result.get("duration_s"),
+            "memory": result.get("memory"),
+        }
+    return out
 
 
 # ------------------------------------------------------------- exporters

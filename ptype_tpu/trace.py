@@ -247,6 +247,14 @@ def recorder() -> FlightRecorder | None:
     return _recorder
 
 
+def dump_dir() -> str | None:
+    """The on-error dump directory, if armed (``enable(dump_dir=...)``
+    or ``PTYPE_TRACE_DUMP_DIR``) — where :func:`maybe_dump` writes,
+    and where the health plane's alert-triggered profile captures
+    land so a page's span ring and device timeline sit side by side."""
+    return _dump_dir or os.environ.get(DUMP_ENV) or None
+
+
 def current() -> Span | None:
     """The active span on this thread, or None (always None when
     tracing is disabled — stale contextvars from a disable() mid-span

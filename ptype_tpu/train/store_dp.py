@@ -176,6 +176,10 @@ class StoreDPTrainer:
 
         self._grads_fn = jax.jit(jax.vmap(local_grads, in_axes=(None, 0)))
         self._apply_fn = make_apply_fn(self.optimizer)
+        #: (params avals, stacked-batch avals) stashed on the first
+        #: step — what compiled_cost() lowers the cost programs
+        #: against without holding batch data.
+        self._cost_avals: tuple | None = None
 
     def params(self) -> dict:
         """The current parameter tree. Served from the locally-kept
@@ -240,6 +244,11 @@ class StoreDPTrainer:
 
         stacked = self._stage(batch)
         params = self.params()
+        if self._cost_avals is None:
+            aval = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+            self._cost_avals = (
+                jax.tree_util.tree_map(aval, params),
+                jax.tree_util.tree_map(aval, stacked))
         losses, grads = self._grads_fn(params, stacked)
 
         if self.zero:
@@ -333,6 +342,72 @@ class StoreDPTrainer:
         new_params = jax.tree_util.tree_unflatten(
             self._treedef, self._param_leaves)
         self._params_seq = self.store.put_tree("params", new_params)
+
+    # --------------------------------------- compiled-cost accounting
+
+    def compiled_cost(self) -> dict:
+        """FLOPs/bytes per step as XLA compiled them (ISSUE 8) — the
+        ``mfu_compiled`` numerator, fed to a goodput ledger via
+        ``ledger.set_compiled_flops(trainer.compiled_cost()["flops"])``.
+
+        Sums the gradient program (lowered with the layer scan fully
+        unrolled so ``cost_analysis`` counts every layer — see
+        :func:`ptype_tpu.health.profiling.compiled_cost`) and the
+        optimizer-apply program(s) of whichever exchange mode this
+        trainer runs: the whole-tree apply, the per-bucket overlap
+        applies, or the ZeRO-1 shard-local applies. Requires one
+        completed step (the batch avals and bucket plans come from
+        it)."""
+        import dataclasses
+
+        from ptype_tpu.health import profiling
+
+        if self._cost_avals is None:
+            raise ValueError(
+                "StoreDPTrainer.compiled_cost: run at least one step "
+                "first (the cost programs lower against the real "
+                "batch shapes)")
+        params_avals, stacked_avals = self._cost_avals
+        cost_cfg = dataclasses.replace(
+            self.cfg, scan_unroll=max(1, self.cfg.n_layers))
+
+        def local_grads(p, b):
+            return jax.value_and_grad(tfm.loss_fn)(p, b, cost_cfg)
+
+        programs = {"grads": profiling.compiled_cost(
+            jax.jit(jax.vmap(local_grads, in_axes=(None, 0))),
+            params_avals, stacked_avals)}
+        if self.zero:
+            programs["optimizer"] = self._zero.compiled_cost()
+        elif self._apply_fns is not None:
+            flops = nbytes = 0.0
+            scale = jax.ShapeDtypeStruct((), jnp.float32)
+            for bi, idxs in enumerate(self._buckets):
+                leaves = jax.tree_util.tree_leaves(params_avals)
+                subp = {str(i): leaves[i] for i in idxs}
+                c = profiling.compiled_cost(
+                    self._apply_fns[bi], subp, subp,
+                    profiling.tree_avals(self._bucket_states[bi]),
+                    scale)
+                flops += c["flops"]
+                nbytes += c["bytes_accessed"]
+            programs["optimizer"] = {"flops": flops,
+                                     "bytes_accessed": nbytes}
+        elif self.opt_state is not None:
+            programs["optimizer"] = profiling.compiled_cost(
+                self._apply_fn, params_avals, params_avals,
+                profiling.tree_avals(self.opt_state))
+        w, b, s = stacked_avals["tokens"].shape
+        tokens = w * b * s
+        flops = sum(p["flops"] for p in programs.values())
+        return {
+            "flops": flops,
+            "bytes_accessed": sum(p["bytes_accessed"]
+                                  for p in programs.values()),
+            "tokens_per_step": tokens,
+            "flops_per_token": flops / tokens,
+            "programs": programs,
+        }
 
     def zero_state(self) -> ZeroState:
         """The 1/N-resident sharded optimizer state (zero=True only) —

@@ -460,3 +460,77 @@ def test_train_package_is_pt007_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt007 = [f for f in findings if "PT007" in f]
     assert not pt007, pt007
+
+
+PT008_RAW_TRACE = ("import jax\n"
+                   "def grab(d):\n"
+                   "    jax.profiler.start_trace(d)\n"
+                   "    jax.profiler.stop_trace()\n")
+
+
+def test_pt008_flags_raw_profiler_trace_calls(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/sneaky.py", PT008_RAW_TRACE)
+    assert sum("PT008" in f for f in findings) == 2, findings
+
+
+def test_pt008_flags_from_import_forms(tmp_path):
+    src = ("from jax.profiler import start_trace\n"
+           "from jax import profiler\n"
+           "def grab(d):\n"
+           "    start_trace(d)\n"
+           "    profiler.stop_trace()\n")
+    findings = _check(tmp_path, "ptype_tpu/forms.py", src)
+    assert sum("PT008" in f for f in findings) == 2, findings
+
+
+def test_pt008_exempts_the_managed_seams(tmp_path):
+    # metrics.py (the legacy local wrapper) and health/profiling.py
+    # (the managed capture plane) ARE the sanctioned call sites.
+    findings = _check(tmp_path, "ptype_tpu/metrics.py", PT008_RAW_TRACE)
+    assert not any("PT008" in f for f in findings), findings
+    findings = _check(tmp_path, "ptype_tpu/health/profiling.py",
+                      PT008_RAW_TRACE)
+    assert not any("PT008" in f for f in findings), findings
+
+
+def test_pt008_silent_outside_package(tmp_path):
+    # Tests and examples drive the profiler deliberately.
+    findings = _check(tmp_path, "tests/t8.py", PT008_RAW_TRACE)
+    assert not any("PT008" in f for f in findings), findings
+    findings = _check(tmp_path, "examples/demo8.py", PT008_RAW_TRACE)
+    assert not any("PT008" in f for f in findings), findings
+
+
+def test_pt008_ignores_other_trace_apis(tmp_path):
+    src = ("from ptype_tpu.health import profiling\n"
+           "from ptype_tpu import trace\n"
+           "def ok(d):\n"
+           "    profiling.capture(duration_s=0.1)\n"
+           "    trace.enable('svc')\n")
+    findings = _check(tmp_path, "ptype_tpu/ok8.py", src)
+    assert not any("PT008" in f for f in findings), findings
+
+
+def test_pt008_honors_noqa(tmp_path):
+    src = ("import jax\n"
+           "def grab(d):\n"
+           "    jax.profiler.start_trace(d)  # noqa: sanctioned\n")
+    findings = _check(tmp_path, "ptype_tpu/sup8.py", src)
+    assert not any("PT008" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt008_clean():
+    """Every jax.profiler start/stop in the package rides the managed
+    capture seam (ISSUE 8 satellite): metrics.py's legacy wrapper and
+    health/profiling.py only."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt008 = [f for f in findings if "PT008" in f]
+    assert not pt008, pt008

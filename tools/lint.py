@@ -50,6 +50,14 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   (parallel/zero.ZeroState — 1/N resident per replica) exists to
   eliminate; step/hot paths must consume the sharded or per-bucket
   state those helpers set up, never rebuild the full tree
+- PT008 (ptype_tpu/ except metrics.py and health/profiling.py): a raw
+  ``jax.profiler.start_trace`` / ``stop_trace`` call — the profiler is
+  process-global and un-nestable, so an ad-hoc capture silently
+  collides with the managed plane (the ptype.Profile endpoint,
+  alert-triggered capture, cluster_profile); every capture must ride
+  the rate-limited, artifact-managed seam in health/profiling.py (or
+  the metrics.trace context manager, which profiling exempts as the
+  one legacy local wrapper)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -479,6 +487,53 @@ class _FullTreeOptStateCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawProfilerTraceCheck(ast.NodeVisitor):
+    """PT008: ``jax.profiler.start_trace`` / ``stop_trace`` (any
+    ``*.profiler.start_trace`` attribute chain, or a bare
+    ``start_trace``/``stop_trace`` imported from jax.profiler) in
+    ptype_tpu/ outside metrics.py and health/profiling.py. The jax
+    profiler is process-global: a raw call races the managed capture
+    plane (ptype.Profile endpoint, alert-triggered capture,
+    telemetry.cluster_profile) and leaves artifacts nothing tracks."""
+
+    _VERBS = frozenset({"start_trace", "stop_trace"})
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        self.from_profiler: set[str] = set()
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.module.endswith("profiler"):
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.from_profiler.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        hit = None
+        if (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "profiler"):
+            hit = fn.attr            # jax.profiler.start_trace(...)
+        elif (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "profiler"):
+            hit = fn.attr            # from jax import profiler
+        elif (isinstance(fn, ast.Name)
+                and fn.id in self.from_profiler):
+            hit = fn.id              # from jax.profiler import start_trace
+        if hit is not None:
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT008 raw jax.profiler."
+                f"{hit} — the profiler is process-global and this "
+                f"call races the managed capture plane; go through "
+                f"health/profiling.py (start/stop/capture or the "
+                f"ptype.Profile endpoint)")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -543,6 +598,12 @@ def check_file(path: str, findings: list[str]) -> None:
         # metrics.py IS the family factory; everything else must get
         # families from a MetricsRegistry so the sampler sees them.
         _DirectMetricCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and os.path.basename(path) not in (
+            "metrics.py", "profiling.py"):
+        # profiling.py IS the managed capture seam (and metrics.trace
+        # the one legacy local wrapper); every other jax.profiler
+        # start/stop races the process-global profiler.
+        _RawProfilerTraceCheck(path, raw).visit(tree)
     if "ptype_tpu" in parts and "parallel" in parts:
         # The data plane's int8 narrowings must ride the scaled
         # quantize helpers — a bare cast is silent gradient loss.
