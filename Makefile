@@ -18,7 +18,10 @@ bench:
 # Serving tail-latency microbench through the inference gateway
 # (docs/OPERATIONS.md "Serving at scale"): three replicas, one slow;
 # the JSON tail carries serve_p99_ms / serve_tokens_per_sec via the
-# gateway and the round-robin comparison p99.
+# gateway and the round-robin comparison p99, plus the paged-engine
+# probe's serve_prefix_hit_speedup / serve_kv_util_pct /
+# serve_prefill_stall_ms (shared-prefix workload, affinity-routed,
+# chunked admission — the ISSUE 9 acceptance numbers).
 serve-bench:
 	JAX_PLATFORMS=cpu python bench.py --serve
 

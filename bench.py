@@ -744,6 +744,183 @@ def profile_main() -> None:
 # ------------------------------------------------------------ serve bench
 
 
+def _serve_paged_probe() -> dict:
+    """Paged-engine host probe (ISSUE 9 acceptance numbers): a
+    shared-prefix workload routed through the gateway with
+    ``prefix_affinity_key`` against the same workload with unique
+    prefixes (every request cold). Returns the tail fields:
+
+    - ``serve_prefix_hit_speedup``: cold-pass wall / shared-pass wall
+      (>1.5x is the bar — the shared pass prefills one prefix once,
+      then only divergent tails);
+    - ``serve_kv_util_pct``: peak live-block pool utilization sampled
+      across both passes;
+    - ``serve_prefill_stall_ms``: the engines' max co-batched
+      decode-step stall under chunked admission (bounded by the
+      ``prefill_chunk`` budget, vs the whole-prompt prefill today).
+    """
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.serve_engine import (PagedGeneratorActor,
+                                        prefix_affinity_key)
+
+    PREFIX, TAIL, MAX_NEW, N_REQ, CHUNK, BT = 224, 4, 4, 7, 32, 16
+    N_THREADS = 2
+    # Big enough that prefill COMPUTE dominates dispatch on CPU — the
+    # tiny preset is dispatch-bound and a 160-token prefill costs the
+    # same as a 4-token one there.
+    cfg = tfm.preset("tiny", d_model=256, n_layers=4, d_ff=512,
+                     max_seq=256, dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+
+    def mk(prefix, tail_len):
+        tail = rng.integers(1, cfg.vocab_size, tail_len)
+        return jnp.asarray(
+            np.concatenate([prefix, tail]).astype(np.int32))[None]
+
+    base = PagedGeneratorActor(cfg, n_slots=4, block_tokens=BT,
+                               prefill_chunk=CHUNK)
+    twin = PagedGeneratorActor(cfg, params=base.params, n_slots=4,
+                               block_tokens=BT, prefill_chunk=CHUNK)
+    actors = [base, twin]
+    state = CoordState(sweep_interval=0.1)
+    coord = LocalCoord(state)
+    registry = CoordRegistry(coord, lease_ttl=2.0)
+    servers, regs = [], []
+    for i, a in enumerate(actors):
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        servers.append(s)
+        regs.append(registry.register("llm-paged", f"r{i}",
+                                      "127.0.0.1", s.port))
+    gw = None
+    util_max = [0.0]
+    stop = threading.Event()
+
+    def poll_util():
+        while not stop.is_set():
+            for a in actors:
+                util_max[0] = max(util_max[0],
+                                  a.pool.stats()["kv_util_pct"])
+            time.sleep(0.002)
+
+    def one(p):
+        key = prefix_affinity_key(np.asarray(p[0]), BT)
+        np.asarray(gw.generate(p, MAX_NEW, affinity_key=key))
+
+    def drive(prompts):
+        import queue
+
+        q = queue.Queue()
+        for p in prompts[1:]:
+            q.put(p)
+        errs = []
+
+        def worker():
+            while True:
+                try:
+                    p = q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    one(p)
+                except Exception as e:  # noqa: BLE001
+                    # A lost request silently SHRINKS the measured
+                    # wall; fail the probe loudly instead.
+                    errs.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(N_THREADS)]
+        t0 = time.perf_counter()
+        # Head request runs ALONE (in the shared pass it is the one
+        # cold prefill that seals the prefix); the rest concurrently —
+        # the same discipline for both passes.
+        one(prompts[0])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    try:
+        # Warm every compile bucket on BOTH replicas off the clock
+        # (unique warm prefix: its cached blocks can't be hit later).
+        warm = mk(rng.integers(1, cfg.vocab_size, PREFIX), TAIL)
+        for a in actors:
+            np.asarray(a.Generate(warm, MAX_NEW))
+            # The warmup's compiles land on the stall meter; the
+            # measured passes start it clean.
+            a._max_stall_ms = a._last_stall_ms = 0.0
+        gw = InferenceGateway(
+            registry, "llm-paged",
+            GatewayConfig(probe_interval_s=0.2, probe_timeout_s=2.0,
+                          default_deadline_s=120.0,
+                          max_queue_depth=64))
+        deadline = time.monotonic() + 10
+        while gw.pool.n_healthy() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        poller = threading.Thread(target=poll_util, daemon=True)
+        poller.start()
+        # Pass A: every request a UNIQUE prefix — all prefills cold.
+        cold_s = drive([mk(rng.integers(1, cfg.vocab_size, PREFIX),
+                           TAIL) for _ in range(N_REQ)])
+        # Pass B: ONE shared prefix, distinct tails — affinity routing
+        # lands the stream on one replica, whose prefix cache hits for
+        # every full block after the first request.
+        shared = rng.integers(1, cfg.vocab_size, PREFIX)
+        warm_s = drive([mk(shared, TAIL) for _ in range(N_REQ)])
+        stop.set()
+        poller.join(timeout=5)
+        infos = [a.Info() for a in actors]
+        hits = [i["prefix_hits"] for i in infos]
+        return {
+            "serve_prefix_hit_speedup": round(cold_s / warm_s, 2),
+            "serve_kv_util_pct": util_max[0],
+            "serve_prefill_stall_ms":
+                max(i["prefill_stall_ms"] for i in infos),
+            "serve_prefix_hits": max(hits),
+            "serve_prefix_hit_rate":
+                max(i["prefix_hit_rate"] for i in infos),
+            "serve_kv_evictions":
+                sum(i["kv_evictions"] for i in infos),
+            "serve_prefill_chunk_tokens": CHUNK,
+            "serve_block_tokens": BT,
+            "paged_cold_wall_s": round(cold_s, 3),
+            "paged_shared_wall_s": round(warm_s, 3),
+            "notes": (
+                f"paged probe: {N_REQ} reqs x ({PREFIX} prefix + "
+                f"{TAIL} tail) tokens, {N_THREADS} threads, 2 paged "
+                f"replicas (d_model=256/L4), affinity-routed; "
+                f"speedup = unique-prefix wall / shared-prefix wall; "
+                f"stall is the max co-batched decode-step wait under "
+                f"{CHUNK}-token chunked admission"),
+        }
+    finally:
+        stop.set()
+        if gw is not None:
+            gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        for a in actors:
+            a.close()
+        state.close()
+
+
 def serve_main() -> None:
     """``make serve-bench``: tail latency THROUGH the inference
     gateway on the host (CPU, tiny preset), against the failure mode
@@ -757,7 +934,9 @@ def serve_main() -> None:
     and the round-robin p99 for the comparison the acceptance bar
     names: least-loaded routing must keep the slow replica out of the
     gateway's tail, while round-robin serializes every third request
-    behind it.
+    behind it. A second probe (:func:`_serve_paged_probe`) adds the
+    paged-engine tail fields: ``serve_prefix_hit_speedup`` /
+    ``serve_kv_util_pct`` / ``serve_prefill_stall_ms``.
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import threading
@@ -866,6 +1045,8 @@ def serve_main() -> None:
         rr_stats = drive(
             lambda: client.call("Generator.Generate", prompt, MAX_NEW))
 
+        paged = _serve_paged_probe()
+        _emit({"probe": "serve_paged_engine", **paged})
         _emit({
             "metric": "serve p99 through gateway vs round-robin "
                       "(cpu host, tiny preset, 1 of 3 replicas "
@@ -885,6 +1066,7 @@ def serve_main() -> None:
             "n_replicas": 3,
             "slow_replica_ms": SLOW_MS,
             "shed": gw.admission.shed_total,
+            **paged,
         })
     finally:
         if client is not None:

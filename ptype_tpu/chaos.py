@@ -29,6 +29,8 @@ site                    actions
 ``gateway.admit``       ``shed`` (force-refuse) / ``delay`` (gateway/admission)
 ``gateway.route``       ``drop`` (veto the picked replica) / ``delay``
 ``gateway.probe``       ``drop`` / ``timeout`` / ``delay`` (gateway/pool)
+``serve.admit``         ``shed`` (typed ShedError + retry_after, the
+                        pool-exhausted path) / ``delay`` (serve_engine)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

@@ -224,6 +224,27 @@ class InferenceGateway:
                 with dsp:
                     fut = conn.call_async(method, args)
                     result = fut.result(timeout=remaining)
+            except ShedError as e:
+                # The REPLICA shed (paged-engine backlog / KV pool
+                # exhausted — serve.admit). It is healthy and answered
+                # typed: don't evict (pool.fail would count it toward
+                # eviction), re-route to a sibling with headroom; when
+                # every option sheds, propagate the replica's typed
+                # shed with its retry hint intact. Skip the EWMA
+                # sample (ms=None): a ~1 ms shed round-trip would
+                # collapse the replica's latency score and the base
+                # least-loaded pick would PREFER the exhausted replica
+                # until the next probe refresh.
+                self.pool.done(r, None, ok=True)
+                last_err = e
+                tried.add(r.key)
+                reroutes += 1
+                if reroutes > self.cfg.max_reroutes:
+                    self.slo.shed()
+                    trace.add_event("gateway.shed",
+                                    last_error=str(e)[:200])
+                    raise
+                continue
             except RemoteError as e:
                 # The replica RAN the handler and it raised: an
                 # application error, not a routing problem. The replica

@@ -92,9 +92,18 @@ class Replica:
             self.probe_ms = (ms if self.probe_ms == 0.0
                              else alpha * ms + (1 - alpha) * self.probe_ms)
 
+    def kv_free_blocks(self) -> int | None:
+        """Replica-reported paged-KV admission headroom (None: the
+        replica doesn't run the paged engine / hasn't been probed).
+        The affinity router yields past an exhausted pool — an
+        affinity hit that sheds is worse than a cold miss elsewhere."""
+        with self.lock:
+            v = self.reported.get("kv_free_blocks")
+            return None if v is None else int(v)
+
     def snapshot(self) -> dict:
         with self.lock:
-            return {"key": self.key, "up": self.up,
+            snap = {"key": self.key, "up": self.up,
                     "inflight": self.inflight, "calls": self.calls,
                     "ewma_ms": round(max(self.ewma_ms, self.probe_ms),
                                      3),
@@ -105,6 +114,15 @@ class Replica:
                         int(self.reported.get("queue_depth", 0) or 0),
                     "reported_in_flight":
                         int(self.reported.get("in_flight", 0) or 0)}
+            # Paged-engine load signal (ISSUE 9): pool headroom and
+            # prefix-cache effectiveness, when the replica reports it.
+            if "kv_free_blocks" in self.reported:
+                snap["kv_free_blocks"] = int(
+                    self.reported["kv_free_blocks"] or 0)
+            if "prefix_hit_rate" in self.reported:
+                snap["prefix_hit_rate"] = float(
+                    self.reported["prefix_hit_rate"] or 0.0)
+            return snap
 
 
 class ReplicaPool:
@@ -322,14 +340,26 @@ class ReplicaPool:
             fresh = [r for r in candidates if r.key not in exclude]
             if fresh:
                 candidates = fresh
-        candidates.sort(key=lambda r: (r.score(), r.key))
+        # An exhausted KV pool (kv_free_blocks == 0) sorts LAST: any
+        # request routed there earns a typed shed, so a replica with
+        # headroom wins at any latency score; non-paged replicas
+        # report None and are unaffected.
+        candidates.sort(key=lambda r: (r.kv_free_blocks() == 0,
+                                       r.score(), r.key))
         chosen = candidates[0]
         if affinity_key is not None and len(candidates) > 1:
             stable = sorted(candidates, key=lambda r: r.key)
             pinned = stable[rpc_mod.fnv32a(affinity_key) % len(stable)]
             # Affinity yields to load: a warm prefix cache is worth a
-            # bounded cost multiple, not a wedged replica.
-            if (pinned.score()
+            # bounded cost multiple, not a wedged replica. It also
+            # yields when the pinned replica's KV block pool is
+            # EXHAUSTED (kv_free_blocks == 0, the paged engine's
+            # admission headroom): routing there earns a typed shed,
+            # not a cache hit — a cold miss on a replica with room
+            # strictly beats it.
+            exhausted = pinned.kv_free_blocks() == 0
+            if (not exhausted
+                    and pinned.score()
                     <= chosen.score() * self.affinity_slack + 10.0):
                 chosen = pinned
         f = chaos.hit("gateway.route", chosen.key)

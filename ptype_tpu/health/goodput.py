@@ -12,8 +12,11 @@ both a live, always-on account:
   (``store.push*`` / ``store.pull*``), ``checkpoint``
   (``checkpoint.*``), ``optimizer`` (``train.opt*`` — the apply leg,
   split out so the ZeRO-1 sharded update's ~N× FLOP saving is a
-  visible number), ``compute`` (the step remainder), and ``stall``
-  (the wall-clock gap between consecutive steps). Each closed step
+  visible number), ``prefill`` (``serve.prefill`` — chunked-prefill
+  admission on a serving node whose ledger steps on ``serve.step``;
+  the paged engine's bounded-stall contract as a measured leg),
+  ``compute`` (the step remainder), and ``stall`` (the wall-clock gap
+  between consecutive steps). Each closed step
   publishes ``goodput.*`` gauges into the node's registry, which the
   health :class:`~ptype_tpu.health.series.Sampler` turns into the
   series every other node can pull.
@@ -43,8 +46,8 @@ LEDGER_WINDOW = 512
 
 
 def _component(name: str) -> str | None:
-    """Region name → breakdown component (None: not a step cost we
-    attribute — e.g. serve-side regions)."""
+    """Region name → breakdown component (None: a region no step
+    attributes)."""
     fam = name.split("/", 1)[0]
     if fam.startswith("store.push") or fam.startswith("store.pull"):
         return "collective"
@@ -58,6 +61,13 @@ def _component(name: str) -> str | None:
         # the replicated apply paths ride the same region name so the
         # comparison is apples-to-apples in `obs top` and the bench.
         return "optimizer"
+    if fam == "serve.prefill":
+        # Chunked-prefill admission work between decode steps on a
+        # SERVING node (ledger step_name="serve.step"): its own leg so
+        # the paged engine's bounded-stall contract is a measured
+        # number — max per-step prefill is capped by the chunk budget,
+        # and what prefill doesn't account for shows up as stall.
+        return "prefill"
     return None
 
 
@@ -180,7 +190,8 @@ class GoodputLedger:
             # component and deducted from stall, never from compute.
             step_start = end - step_s
             inside = {"data": 0.0, "collective": 0.0,
-                      "checkpoint": 0.0, "optimizer": 0.0}
+                      "checkpoint": 0.0, "optimizer": 0.0,
+                      "prefill": 0.0}
             between = dict(inside)
             for comp, dur, t in events:
                 (inside if t >= step_start else between)[comp] += dur
@@ -192,6 +203,7 @@ class GoodputLedger:
             coll = inside["collective"] + between["collective"]
             ckpt = inside["checkpoint"] + between["checkpoint"]
             opt = inside["optimizer"] + between["optimizer"]
+            prefill = inside["prefill"] + between["prefill"]
             # Clamp so a mis-nested caller can't drive compute negative.
             compute = max(0.0, step_s - min(step_s,
                                             sum(inside.values())))
@@ -212,6 +224,7 @@ class GoodputLedger:
                 "data_ms": round(data * 1e3, 3),
                 "checkpoint_ms": round(ckpt * 1e3, 3),
                 "optimizer_ms": round(opt * 1e3, 3),
+                "prefill_ms": round(prefill * 1e3, 3),
                 "stall_ms": round(stall * 1e3, 3),
                 "goodput_pct": round(goodput, 2),
             }
@@ -235,8 +248,8 @@ class GoodputLedger:
             self._records.append(rec)
         reg = self.registry
         for key in ("step_ms", "compute_ms", "collective_ms", "data_ms",
-                    "checkpoint_ms", "optimizer_ms", "stall_ms",
-                    "goodput_pct", "tokens_per_sec", "mfu",
+                    "checkpoint_ms", "optimizer_ms", "prefill_ms",
+                    "stall_ms", "goodput_pct", "tokens_per_sec", "mfu",
                     "mfu_compiled", "mfu_gap_pct"):
             if key in rec:
                 name = "goodput.pct" if key == "goodput_pct" \
@@ -267,7 +280,8 @@ class GoodputLedger:
         breakdown = {
             k: mean(k) for k in
             ("step_ms", "compute_ms", "collective_ms", "data_ms",
-             "checkpoint_ms", "optimizer_ms", "stall_ms")}
+             "checkpoint_ms", "optimizer_ms", "prefill_ms",
+             "stall_ms")}
         # Share denominator: mean wall over the records that carry it
         # (averaging absent keys as 0 would deflate the wall and push
         # the share past 100% — the bound this metric promises).

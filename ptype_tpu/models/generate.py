@@ -29,6 +29,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -231,36 +233,139 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
     return _head_logits(params, x[:, 0], cfg), KVCache(kcs, vcs)
 
 
-def decode_step_ragged(params: dict, token: jax.Array,
-                       pos: jax.Array, cfg: tfm.TransformerConfig,
-                       cache: KVCache) -> tuple[jax.Array, KVCache]:
-    """One decode step with PER-ROW cache depths — the continuous-
-    batching engine step (serve.ContinuousGeneratorActor): every slot
-    is mid-decode at its own position, so ``pos`` is (B,), each row
-    writes its K/V at its own slot and attends to its own prefix.
-    Slots are RIGHT-aligned (prompt at [0, L)), so cache slot and
-    token position coincide and RoPE uses ``pos`` directly."""
+def _paged_attention_gather(q, kc, vc, tables, pos_limit, cfg):
+    """Attention through a block table — the XLA gather path of the
+    paged serving engine. q: (B, Q, H, Dh); kc/vc: (n_blocks,
+    block_tokens, Kh, Dh) bank layers; tables: (B, nb) int32 block ids
+    in POSITION order, so the gathered layout is exactly the
+    contiguous cache (garbage in never-written / trash-block columns
+    is masked, and masked-out columns contribute exact zeros to the
+    softmax sums — greedy rows match the contiguous path bit-for-bit).
+
+    ``pos_limit``: (B,) per-row limits (decode, Q=1) or (B, Q)
+    per-query limits (chunked prefill: query c attends positions
+    ``<= start + c``). Same grouped-GQA einsums as
+    :func:`_cached_attention`."""
+    B, Q, H, Dh = q.shape
+    nb = tables.shape[1]
+    bt = kc.shape[1]
+    Kh = kc.shape[2]
+    ks = kc[tables].reshape(B, nb * bt, Kh, Dh)
+    vs = vc[tables].reshape(B, nb * bt, Kh, Dh)
+    G = H // Kh
+    qg = q.reshape(B, Q, Kh, G, Dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                        ks).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(Dh))
+    cols = jnp.arange(nb * bt)
+    pos_limit = jnp.asarray(pos_limit)
+    if pos_limit.ndim == 1:
+        mask = cols[None, None, :] < pos_limit[:, None, None]
+    else:  # (B, Q) per-query
+        mask = cols[None, None, :] < pos_limit[:, :, None]
+    scores = jnp.where(mask[:, None, None, :, :], scores,
+                       jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", probs, vs)
+    return o.reshape(B, Q, H, Dh)
+
+
+def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
+                      cfg: tfm.TransformerConfig, kb: jax.Array,
+                      vb: jax.Array, tables: jax.Array,
+                      wr_blocks: jax.Array, wr_off: jax.Array,
+                      attn_impl: str = "gather",
+                      interpret: bool | None = None
+                      ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step through per-sequence BLOCK TABLES — the paged
+    engine step (serve_engine.PagedGeneratorActor). ``kb``/``vb``:
+    ``(L, n_blocks, block_tokens, Kh, Dh)`` banks shared by every
+    sequence; ``tables`` (B, nb) maps each row's positions onto bank
+    blocks. Each row writes its new K/V at ``(wr_blocks[b],
+    wr_off[b])`` — the engine routes INACTIVE rows to the trash block
+    so a masked lane can never scatter into a real (possibly shared)
+    block — and attends through its table: position order == table
+    order, so greedy rows match the solo :func:`generate` decode
+    token-for-token (the engine's parity bar).
+
+    ``attn_impl="kernel"`` uses the Pallas paged-attention kernel
+    (ops/paged_attention, gated behind its ``check_tpu_lowering``);
+    the default is the XLA gather path. Returns
+    ``(logits (B, V), kb, vb)``."""
     B = token.shape[0]
     x = params["embed"][token][:, None, :].astype(cfg.dtype)
     sin, cos = tfm.rope_tables(cfg, positions=pos[:, None])
 
     def body(x, inputs):
-        layer, kc, vc = inputs  # kc/vc: (B, Smax, Kh, Dh)
+        layer, kc, vc = inputs  # (n_blocks, block_tokens, Kh, Dh)
         q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
-        kc = kc.at[jnp.arange(B), pos].set(k[:, 0])
-        vc = vc.at[jnp.arange(B), pos].set(v[:, 0])
-        o = _cached_attention(q, kc, vc, pos + 1, cfg)
+        kc = kc.at[wr_blocks, wr_off].set(k[:, 0])
+        vc = vc.at[wr_blocks, wr_off].set(v[:, 0])
+        if attn_impl == "kernel":
+            from ptype_tpu.ops.paged_attention import paged_attention
+
+            o = paged_attention(q, kc, vc, tables, pos,
+                                interpret=interpret)
+        else:
+            o = _paged_attention_gather(q, kc, vc, tables, pos + 1,
+                                        cfg)
         x = tfm.attn_residual(x, o, layer, cfg)
         x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
         return x, (kc, vc)
 
-    x, (kcs, vcs) = lax.scan(body, x,
-                             (params["blocks"], cache.k, cache.v))
+    x, (kb, vb) = lax.scan(body, x, (params["blocks"], kb, vb))
     x = tfm.rms_norm(x, params["final_norm"])
-    return _head_logits(params, x[:, 0], cfg), KVCache(kcs, vcs)
+    return _head_logits(params, x[:, 0], cfg), kb, vb
 
 
-import functools
+def prefill_paged_chunk(params: dict, tokens: jax.Array,
+                        start: jax.Array, length: jax.Array,
+                        cfg: tfm.TransformerConfig, kb: jax.Array,
+                        vb: jax.Array, table: jax.Array
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One CHUNK of paged prefill for a single sequence — the bounded
+    unit chunked admission interleaves with decode steps. ``tokens``
+    (1, C): prompt positions ``[start, start + length)`` right-padded
+    to the chunk bucket C; ``table`` (nb,) the sequence's block table.
+    K/V for real tokens scatter into their blocks (pad columns go to
+    the trash block); attention runs per-query-causal against the
+    gathered table, i.e. query ``c`` sees every previously-written
+    position plus the chunk through itself — mathematically the same
+    full causal prefill, split at chunk boundaries. Returns
+    ``(logits (1, V) at the chunk's LAST REAL token, kb, vb)`` — only
+    the final chunk's logits feed the first sampled token."""
+    B, C = tokens.shape
+    bt = kb.shape[2]
+    nb = table.shape[0]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    pos_vec = start + jnp.arange(C)  # (C,) positions of chunk columns
+    sin, cos = tfm.rope_tables(cfg, positions=pos_vec[None])
+    valid = jnp.arange(C) < length
+    wr_b = jnp.where(valid, table[jnp.clip(pos_vec // bt, 0, nb - 1)],
+                     0)
+    wr_o = pos_vec % bt
+    # Per-query limits: pad queries attend nothing (their garbage
+    # outputs are never read — x_last indexes the last REAL token).
+    limits = jnp.where(valid, pos_vec + 1, 0)
+    # MoE: zero-drop capacity over the padded chunk (same reasoning as
+    # prefill's B*S bound — dropping is a training regularizer).
+    cap = C if cfg.n_experts else None
+
+    def body(x, inputs):
+        layer, kc, vc = inputs
+        q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+        kc = kc.at[wr_b, wr_o].set(k[0])
+        vc = vc.at[wr_b, wr_o].set(v[0])
+        o = _paged_attention_gather(q, kc, vc, table[None],
+                                    limits[None], cfg)
+        x = tfm.attn_residual(x, o, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=cap)
+        return x, (kc, vc)
+
+    x, (kb, vb) = lax.scan(body, x, (params["blocks"], kb, vb))
+    x = tfm.rms_norm(x, params["final_norm"])
+    x_last = x[jnp.arange(B), jnp.asarray(length)[None] - 1]
+    return _head_logits(params, x_last, cfg), kb, vb
 
 
 @functools.lru_cache(maxsize=64)
@@ -384,6 +489,59 @@ def _filter_logits(logits: jax.Array, top_k: int,
             axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return logits
+
+
+def _filter_logits_traced(logits: jax.Array, top_k: jax.Array,
+                          top_p: jax.Array) -> jax.Array:
+    """:func:`_filter_logits` with TRACED per-slot ``top_k``/``top_p``
+    — the continuous engine samples every live slot in ONE compiled
+    program, so the filters can't be compile-time constants. Same
+    masking values (k-th-largest threshold via sort instead of
+    ``lax.top_k``; identical nucleus cutoff math), with the
+    enable/disable branches as ``jnp.where`` gates so a disabled
+    filter is bit-for-bit a no-op, exactly like the skipped Python
+    branch in the solo path. logits: (V,) f32."""
+    V = logits.shape[-1]
+    desc = jnp.sort(logits)[::-1]
+    kth = desc[jnp.clip(top_k, 1, V) - 1]  # k-th largest == top_k's
+    logits = jnp.where((top_k > 0) & (logits < kth), -jnp.inf, logits)
+    desc2 = jnp.sort(logits)[::-1]
+    probs = jax.nn.softmax(desc2)
+    cum = jnp.cumsum(probs)
+    keep = (cum - probs) < top_p
+    cutoff = jnp.min(jnp.where(keep, desc2, jnp.inf))
+    return jnp.where((top_p < 1.0) & (logits < cutoff), -jnp.inf,
+                     logits)
+
+
+def sample_token_rows(logits: jax.Array, keys: jax.Array,
+                      steps: jax.Array, temps: jax.Array,
+                      top_ks: jax.Array, top_ps: jax.Array
+                      ) -> jax.Array:
+    """Per-ROW sampling for the continuous engine step: row i draws
+    with ITS OWN key folded at ITS OWN emitted-token index, so a
+    co-batched sampled request sees exactly the RNG stream its solo
+    (B=1) call would — ``jax.random.categorical(key, (1, V)) ==
+    argmax(logits + gumbel(key, (1, V)))`` (asserted in tests), over
+    the identically filtered/temperature-scaled logits. Rows with
+    ``temperature == 0`` take the plain argmax (the greedy path).
+
+    logits: (B, V) f32; keys: (B, 2) uint32 per-request PRNG keys;
+    steps: (B,) emitted-token index (0 = first token, matching the
+    solo path's ``fold_in(rng, 0)`` prefill draw)."""
+    V = logits.shape[-1]
+
+    def one(lg, key, step, t, k, p):
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        x = lg.astype(jnp.float32) / jnp.where(t > 0, t, 1.0)
+        x = _filter_logits_traced(x, k, p)
+        # (1, V) gumbel then [0]: the exact draw categorical makes on
+        # a (1, V) logits batch — the solo path's shape.
+        g = jax.random.gumbel(jax.random.fold_in(key, step), (1, V))[0]
+        samp = jnp.argmax(x + g, axis=-1).astype(jnp.int32)
+        return jnp.where(t > 0.0, samp, greedy)
+
+    return jax.vmap(one)(logits, keys, steps, temps, top_ks, top_ps)
 
 
 def generate(params: dict, cfg: tfm.TransformerConfig,

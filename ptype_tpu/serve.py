@@ -319,268 +319,19 @@ class BatchingGeneratorActor(GeneratorActor):
         self._thread.join(timeout=5)
 
 
-class _RowPending:
-    """One prompt ROW in the continuous engine (a (B, S) request is
-    split into B independent rows; they re-assemble at the end)."""
+def __getattr__(name: str):
+    """Lazy re-exports (PEP 562): the continuous engine now lives in
+    :mod:`ptype_tpu.serve_engine` — the paged KV-cache rebase (block
+    pool + prefix reuse + chunked prefill; ISSUE 9). Importing it here
+    eagerly would cycle (serve_engine subclasses GeneratorActor), and
+    serve.py itself must never allocate a full-reach contiguous bank
+    again (lint PT009) — ``ContinuousGeneratorActor`` IS the paged
+    engine now, same ctor surface (``n_slots``/``max_len``) plus the
+    pool knobs (``block_tokens``/``n_blocks``/``prefill_chunk``/
+    ``max_queue``/``attn``)."""
+    if name in ("ContinuousGeneratorActor", "PagedGeneratorActor"):
+        from ptype_tpu.serve_engine.engine import PagedGeneratorActor
 
-    __slots__ = ("prompt", "max_new", "stop_token", "emitted", "done",
-                 "err")
-
-    def __init__(self, prompt, max_new, stop_token):
-        self.prompt = prompt          # 1-D int32 np array
-        self.max_new = max_new
-        self.stop_token = stop_token
-        self.emitted: list[int] = []
-        self.done = threading.Event()
-        self.err = None
-
-
-class ContinuousGeneratorActor(GeneratorActor):
-    """TRUE continuous batching: a fixed bank of ``n_slots`` KV-cache
-    slots and ONE running decode loop. Requests join a free slot at
-    any step boundary (their prompt prefills into the slot while the
-    other slots are mid-decode) and leave the moment they finish
-    (max_new reached or stop token hit) — no request ever waits for a
-    co-batched stranger to finish, the standard TPU serving win over
-    the lock-serialized actor (and over BatchingGeneratorActor's
-    coalesce-at-start dynamic batching).
-
-    Engine layout (all static shapes — one compiled step program for
-    the life of the actor):
-
-    - cache bank ``(L, n_slots, reach, Kh, Dh)``; slots are
-      RIGHT-aligned (prompt at columns [0, L), decode grows from L) so
-      cache slot == token position,
-    - per-slot ``pos``/``token``/``active`` vectors drive
-      ``generate.decode_step_ragged`` — every slot attends to its own
-      prefix depth,
-    - admission prefills via a per-S-bucket compiled program that
-      writes K/V straight into the slot (``prefill(last_index=L-1)``:
-      right-pad garbage beyond L is never attended and is overwritten
-      by decode writes before it could be).
-
-    Greedy requests only (sampling keeps per-request RNG semantics on
-    the solo path, same contract as BatchingGeneratorActor); greedy
-    rows are independent, so every row matches its solo decode
-    exactly. Stop tokens retire a slot EARLY — freed capacity is
-    reused by the next queued request mid-flight.
-    """
-
-    def __init__(self, cfg: tfm.TransformerConfig, params=None,
-                 rng: jax.Array | None = None, n_slots: int = 8,
-                 max_len: int | None = None):
-        super().__init__(cfg, params, rng)
-        import numpy as np
-
-        from ptype_tpu.models import generate as g
-
-        self.n_slots = int(n_slots)
-        reach = min(int(max_len) if max_len else cfg.max_seq,
-                    cfg.max_seq)
-        self.reach = -(-reach // 128) * 128  # lane-aligned
-        bank = g.init_cache(cfg, self.n_slots, max_seq=self.reach)
-        self._k, self._v = bank.k, bank.v
-        self._tok = np.zeros(self.n_slots, np.int32)
-        self._pos = np.zeros(self.n_slots, np.int32)
-        self._active = np.zeros(self.n_slots, bool)
-        self._slot_state: dict[int, _RowPending] = {}
-        self._queue: list[_RowPending] = []
-        self._cond = threading.Condition()
-        self._closed = False
-        self._steps = 0
-        self._max_live = 0
-
-        def engine_step(params, k, v, tok, pos, active):
-            logits, cache = g.decode_step_ragged(
-                params, tok, pos, self.cfg, g.KVCache(k, v))
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            nxt = jnp.where(active, nxt, 0)
-            return cache.k, cache.v, nxt
-
-        # Donate the bank: the engine must not copy n_slots full-reach
-        # caches every step.
-        self._engine_step = jax.jit(engine_step, donate_argnums=(1, 2))
-        self._prefill_progs: dict[int, object] = {}
-        self._thread = threading.Thread(
-            target=self._engine, name="generate-engine", daemon=True)
-        self._thread.start()
-
-    # ------------------------------------------------------------ public
-
-    def Generate(self, prompt, max_new_tokens: int = 16,
-                 temperature: float = 0.0, seed: int = 0,
-                 top_k: int = 0, top_p: float = 1.0,
-                 stop_token: int = -1, pad_token: int = 0,
-                 repetition_penalty: float = 1.0):
-        import numpy as np
-
-        if (float(temperature) != 0.0
-                or float(repetition_penalty) != 1.0):
-            # Per-request RNG / penalty state: solo path.
-            return super().Generate(prompt, max_new_tokens, temperature,
-                                    seed, top_k, top_p, stop_token,
-                                    pad_token, repetition_penalty)
-        prompt = _norm_prompt(prompt)
-        max_new = int(max_new_tokens)
-        if max_new <= 0:
-            # Nothing to generate: don't occupy a slot (and don't let
-            # the engine emit into a zero-width output).
-            return jnp.zeros((prompt.shape[0], 0), jnp.int32)
-        if prompt.shape[1] + max_new > self.reach:
-            raise ValueError(
-                f"prompt {prompt.shape[1]} + max_new {max_new} exceeds "
-                f"slot reach {self.reach}")
-        rows = [_RowPending(np.asarray(prompt[i]), max_new,
-                            int(stop_token))
-                for i in range(prompt.shape[0])]
-        self._enter_request()
-        try:
-            with self._lock:
-                self._calls += 1
-            with self._cond:
-                if self._closed:
-                    raise RuntimeError("generator actor is closed")
-                self._queue.extend(rows)
-                self._cond.notify()
-            out = np.full((len(rows), max_new), int(pad_token), np.int32)
-            for i, r in enumerate(rows):
-                r.done.wait()
-                if r.err is not None:
-                    raise r.err
-                out[i, :len(r.emitted)] = r.emitted
-            return jnp.asarray(out)
-        finally:
-            self._exit_request()
-
-    # ------------------------------------------------------------ engine
-
-    def _prefill_prog(self, s_bucket: int):
-        """Per-S-bucket compiled slot prefill: fills the slot's K/V
-        columns [0, s_bucket) in the bank and returns the first greedy
-        token (logits taken at column L-1)."""
-        prog = self._prefill_progs.get(s_bucket)
-        if prog is not None:
-            return prog
-        from ptype_tpu.models import generate as g
-
-        def run(params, k, v, prompt, length, slot):
-            small = g.init_cache(self.cfg, 1, max_seq=s_bucket)
-            logits, kv = g.prefill(params, prompt, self.cfg, small,
-                                   last_index=length[None] - 1)
-            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-            k = jax.lax.dynamic_update_slice(k, kv.k,
-                                             (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(v, kv.v,
-                                             (0, slot, 0, 0, 0))
-            return k, v, first
-
-        prog = jax.jit(run, donate_argnums=(1, 2))
-        self._prefill_progs[s_bucket] = prog
-        return prog
-
-    def _admit(self, slot: int, row: _RowPending) -> None:
-        import numpy as np
-
-        L = len(row.prompt)
-        s_b = min(max(_pow2(L), 16), self.reach)
-        padded = np.zeros((1, s_b), np.int32)
-        padded[0, :L] = row.prompt  # RIGHT-aligned slot layout
-        self._k, self._v, first = self._prefill_prog(s_b)(
-            self.params, self._k, self._v, jnp.asarray(padded),
-            jnp.int32(L), jnp.int32(slot))
-        first = int(first)
-        row.emitted.append(first)
-        if (row.max_new == 1
-                or (row.stop_token >= 0 and first == row.stop_token)):
-            row.done.set()  # done at prefill; slot never activates
-            return
-        self._slot_state[slot] = row
-        self._tok[slot] = first
-        self._pos[slot] = L
-        self._active[slot] = True
-
-    def _retire(self, slot: int) -> None:
-        self._active[slot] = False
-        self._slot_state.pop(slot).done.set()
-
-    def _engine(self) -> None:
-        """Engine thread wrapper: ANY escape from the loop — clean
-        close or an unexpected error (compile failure in a new prefill
-        bucket, device OOM) — must fail every pending row, or callers
-        blocked in ``done.wait()`` hang forever while the dead actor
-        keeps accepting requests."""
-        err: Exception | None = None
-        try:
-            self._engine_loop()
-        except Exception as e:  # noqa: BLE001 — delivered to callers
-            err = e
-            log.warning("generation engine died",
-                        kv={"err": repr(e)})
-        with self._cond:
-            self._closed = True
-            stragglers, self._queue = self._queue, []
-        for slot in list(self._slot_state):
-            stragglers.append(self._slot_state.pop(slot))
-        for r in stragglers:
-            if not r.done.is_set():
-                r.err = err or RuntimeError("generator actor closed")
-                r.done.set()
-
-    def _engine_loop(self) -> None:
-        import numpy as np
-
-        while True:
-            with self._cond:
-                while (not self._queue and not self._active.any()
-                       and not self._closed):
-                    self._cond.wait()
-                if self._closed:
-                    return
-                # Admission: fill free slots at this step boundary —
-                # co-batched requests may be mid-decode right now.
-                free = [s for s in range(self.n_slots)
-                        if not self._active[s]]
-                while self._queue and free:
-                    self._admit(free.pop(0), self._queue.pop(0))
-            if not self._active.any():
-                continue
-            with self._lock:
-                self._steps += 1
-                self._max_live = max(self._max_live,
-                                     int(self._active.sum()))
-                self._k, self._v, nxt = self._engine_step(
-                    self.params, self._k, self._v,
-                    jnp.asarray(self._tok), jnp.asarray(self._pos),
-                    jnp.asarray(self._active))
-            nxt_host = np.array(nxt)  # writable copy: _admit writes slots
-            self._pos[self._active] += 1
-            self._tok = nxt_host
-            for slot in list(self._slot_state):
-                if not self._active[slot]:
-                    continue
-                row = self._slot_state[slot]
-                t = int(nxt_host[slot])
-                row.emitted.append(t)
-                if (len(row.emitted) >= row.max_new
-                        or (row.stop_token >= 0
-                            and t == row.stop_token)):
-                    self._retire(slot)  # leaves mid-loop: capacity
-                    # freed here is reused at the NEXT step boundary.
-
-    def Info(self) -> dict:
-        info = super().Info()
-        info["n_slots"] = self.n_slots
-        info["engine_steps"] = self._steps
-        info["max_live_slots"] = self._max_live
-        with self._cond:
-            # Rows waiting for a slot — the continuous engine's real
-            # backlog (admitted rows are being decoded, not queued).
-            info["queue_depth"] = len(self._queue)
-        info["live_slots"] = int(self._active.sum())
-        return info
-
-    def close(self) -> None:
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        self._thread.join(timeout=10)
+        return PagedGeneratorActor
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,35 @@
+"""Paged KV-cache serving engine (ISSUE 9).
+
+The serving-side analogue of the training data plane's bucketed
+collectives: one device-resident bank of fixed-size KV blocks shared by
+every live sequence, so resident cache memory tracks *actual* token
+counts instead of ``n_slots × reach``:
+
+- :mod:`~ptype_tpu.serve_engine.blocks` — the :class:`BlockPool`
+  (ref-counted fixed-size blocks, per-sequence block tables, LRU
+  eviction of released blocks, content-addressing by the same FNV-1a
+  prefix hash chain the gateway's affinity routing keys on);
+- :mod:`~ptype_tpu.serve_engine.engine` — the
+  :class:`PagedGeneratorActor` continuous engine rebased onto the
+  pool: chunked prefill (a long prompt can no longer stall co-batched
+  decodes for its whole prefill), prefix reuse (an affinity-routed
+  request skips prefill for every already-resident full block), and
+  per-slot RNG sampling on the continuous path.
+
+The host-mesh probe behind ``bench.py --serve``'s
+``serve_prefix_hit_speedup`` / ``serve_kv_util_pct`` /
+``serve_prefill_stall_ms`` tail fields is ``_serve_paged_probe`` in
+the top-level ``bench.py``.
+
+The decode attention path is an XLA gather through the block table
+(``models/generate.decode_step_paged``); the optional Pallas kernel
+lives in :mod:`ptype_tpu.ops.paged_attention`, gated behind the same
+``check_tpu_lowering`` machinery as the flash kernel.
+"""
+
+from ptype_tpu.serve_engine.blocks import (BlockPool, block_hashes,
+                                           prefix_affinity_key)
+from ptype_tpu.serve_engine.engine import PagedGeneratorActor
+
+__all__ = ["BlockPool", "block_hashes", "prefix_affinity_key",
+           "PagedGeneratorActor"]

@@ -1,0 +1,661 @@
+"""The paged continuous-batching engine.
+
+:class:`PagedGeneratorActor` rebases serve.py's continuous engine onto
+the :class:`~ptype_tpu.serve_engine.blocks.BlockPool`:
+
+- **Paged decode**: one engine step decodes every live slot through
+  per-sequence block tables (``models/generate.decode_step_paged``) —
+  resident KV memory tracks actual token counts (pool blocks), not
+  ``n_slots × reach`` contiguous banks. Greedy rows still match their
+  solo decode token-for-token (gathered table order == position
+  order).
+- **Chunked prefill**: admission writes the prompt in bounded
+  ``prefill_chunk``-token chunks INTERLEAVED with decode steps — a 4k
+  prompt can no longer freeze co-batched decodes for its whole
+  prefill; the per-decode-step stall is bounded by one chunk and
+  recorded (``serve.prefill`` regions feed the goodput ledger's
+  ``prefill`` leg; ``Info()['prefill_stall_ms']`` and the
+  ``serve.prefill_stall_ms`` gauge carry the host-side maximum).
+- **Prefix reuse**: prompt blocks are content-addressed by the
+  fnv32a hash chain (blocks.block_hashes — the SAME hash family the
+  gateway's affinity routing keys on), so an affinity-routed request
+  skips prefill for every already-resident full block. Hits/misses/
+  evictions surface in ``Info()`` and as ``serve.*`` gauges the
+  health sampler picks up.
+- **Sampling on the continuous path**: per-slot RNG keys fold into
+  the engine step (``generate.sample_token_rows``) — single-row
+  sampled requests (temperature/top-k/top-p) ride the engine with
+  exact solo-path RNG parity instead of convoying the lock-serialized
+  solo path. Multi-row sampled requests and repetition-penalty
+  requests keep the solo fallback (batch-shaped RNG / seen-set state).
+
+Admission control: the waiting room is bounded (``max_queue``) and
+every request reserves its worst-case block count up front — an
+arrival the pool or queue can't hold sheds with a typed
+:class:`~ptype_tpu.errors.ShedError` (+ backlog-proportional
+``retry_after_s``) instead of wedging the engine; the ``serve.admit``
+chaos seam forces sheds/delays and pairs with success-path beacons.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ptype_tpu import chaos, logs
+from ptype_tpu import metrics as metrics_mod
+from ptype_tpu.errors import ShedError
+from ptype_tpu.models import generate as gen
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.serve import GeneratorActor, _norm_prompt, _pow2
+from ptype_tpu.serve_engine.blocks import BlockPool, block_hashes
+
+log = logs.get_logger("serve_engine")
+
+
+class _PagedRow:
+    """One prompt ROW moving through the engine: queued → admitting
+    (chunked prefill) → active slot → done."""
+
+    __slots__ = ("prompt", "max_new", "stop_token", "temperature",
+                 "top_k", "top_p", "key", "emitted", "done", "err",
+                 "table", "hashes", "reused", "prefill_pos",
+                 "reserve_left", "t_enqueue", "t_head", "cancelled")
+
+    def __init__(self, prompt, max_new, stop_token, temperature,
+                 top_k, top_p, key):
+        self.prompt = prompt          # 1-D int32 np array
+        self.max_new = max_new
+        self.stop_token = stop_token
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.key = key                # (2,) uint32 np array
+        self.emitted: list[int] = []
+        self.done = threading.Event()
+        self.err = None
+        self.table: list[int] = []    # block ids, position order
+        self.hashes: list[int] = []
+        self.reused = 0
+        self.prefill_pos = -1         # -1: reuse walk not yet run
+        self.reserve_left = 0
+        self.t_enqueue = time.perf_counter()
+        self.t_head = None            # first reserve refusal at head
+        self.cancelled = False
+
+
+class PagedGeneratorActor(GeneratorActor):
+    """Continuous batching over the paged KV block pool.
+
+    Knobs (docs/OPERATIONS.md "Serving at scale"): ``n_slots`` live
+    sequences; ``block_tokens`` KV block granularity (sublane-aligned,
+    also the prefix-sharing granularity); ``n_blocks`` pool size
+    (default ``n_slots × reach/block_tokens + 1`` — the contiguous
+    engine's worst case; shrink it to oversubscribe on real token
+    counts); ``prefill_chunk`` admission token budget per engine
+    iteration (the decode-stall bound; ``None`` = whole-prompt, the
+    legacy behavior); ``max_queue`` waiting-room bound before typed
+    sheds; ``admit_timeout_s`` bound on how long a head-of-line
+    request may wait for a pool reservation before it sheds typed
+    (pool exhaustion becomes a routing signal instead of a gateway
+    deadline burn; 0 = wait forever); ``attn`` "gather" (XLA,
+    default) or "kernel" (Pallas paged attention, TPU backends gated
+    by its ``check_tpu_lowering``).
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, params=None,
+                 rng: jax.Array | None = None, n_slots: int = 8,
+                 max_len: int | None = None, block_tokens: int = 16,
+                 n_blocks: int | None = None,
+                 prefill_chunk: int | None = 64,
+                 max_queue: int = 64, admit_timeout_s: float = 10.0,
+                 attn: str = "gather"):
+        super().__init__(cfg, params, rng)
+        self.n_slots = int(n_slots)
+        bt = int(block_tokens)
+        reach = min(int(max_len) if max_len else cfg.max_seq,
+                    cfg.max_seq)
+        self.reach = -(-reach // bt) * bt  # block-aligned
+        self.block_tokens = bt
+        self.nb = self.reach // bt
+        n_blocks = (int(n_blocks) if n_blocks
+                    else self.n_slots * self.nb + 1)
+        self.pool = BlockPool(cfg, n_blocks, bt)
+        self.prefill_chunk = (int(prefill_chunk) if prefill_chunk
+                              else self.reach)
+        self.max_queue = int(max_queue)
+        self.admit_timeout_s = float(admit_timeout_s)
+        if attn not in ("gather", "kernel"):
+            raise ValueError(f"attn must be 'gather'|'kernel', "
+                             f"got {attn!r}")
+        if attn == "kernel" and jax.default_backend() != "cpu":
+            from ptype_tpu.ops.paged_attention import check_tpu_lowering
+
+            bad = check_tpu_lowering(
+                self.n_slots, cfg.n_heads, cfg.kv_heads, cfg.head_dim,
+                n_blocks, bt, self.nb)
+            if bad:
+                raise ValueError(
+                    "paged-attention kernel cannot lower for this "
+                    "config: " + "; ".join(bad))
+        self.attn = attn
+
+        ns = self.n_slots
+        self._tables = np.zeros((ns, self.nb), np.int32)
+        self._nalloc = np.zeros(ns, np.int32)
+        self._tok = np.zeros(ns, np.int32)
+        self._pos = np.zeros(ns, np.int32)
+        self._active = np.zeros(ns, bool)
+        self._keys = np.zeros((ns, 2), np.uint32)
+        self._temps = np.zeros(ns, np.float32)
+        self._topk = np.zeros(ns, np.int32)
+        self._topp = np.ones(ns, np.float32)
+        self._eidx = np.zeros(ns, np.int32)
+        self._slot_state: dict[int, _PagedRow] = {}
+        self._queue: list[_PagedRow] = []
+        self._admitting: _PagedRow | None = None
+        self._cond = threading.Condition()
+        self._closed = False
+        self._steps = 0
+        self._max_live = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefill_chunks = 0
+        self._prefill_tokens = 0
+        self._max_stall_ms = 0.0
+        self._last_stall_ms = 0.0
+        #: EWMA of per-request service seconds — the retry_after hint.
+        self._svc_ewma_s = 0.0
+
+        def engine_step(sampled, params, kb, vb, tok, pos, tables,
+                        active, keys, eidx, temps, topk, topp):
+            B = tok.shape[0]
+            bt_ = self.block_tokens
+            # Write routing in-graph: inactive lanes scatter to the
+            # trash block. Keeping this (and the pos/eidx increments)
+            # on device lets the engine loop skip re-uploading its
+            # slot state on steps where nothing was admitted/retired —
+            # the steady-state decode step transfers nothing in.
+            wr_b = jnp.where(active,
+                             tables[jnp.arange(B), pos // bt_], 0)
+            wr_o = pos % bt_
+            logits, kb, vb = gen.decode_step_paged(
+                params, tok, pos, self.cfg, kb, vb, tables, wr_b,
+                wr_o, attn_impl=self.attn)
+            if sampled:
+                nxt = gen.sample_token_rows(logits, keys, eidx, temps,
+                                            topk, topp)
+            else:
+                # All-greedy step: skip the per-row sort/gumbel
+                # machinery entirely (the serving hot path; two cached
+                # programs, picked per step by live-slot inspection).
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, 0)
+            return (kb, vb, nxt, jnp.where(active, pos + 1, pos),
+                    jnp.where(active, eidx + 1, eidx))
+
+        # Donate the banks: the engine must not copy the pool per step.
+        self._engine_step = jax.jit(engine_step, donate_argnums=(2, 3),
+                                    static_argnums=(0,))
+        #: Device mirrors of the slot state; None = host copy is
+        #: authoritative and must be re-uploaded (set dirty by
+        #: admission, retire, and block-boundary allocation).
+        self._dev: dict | None = None
+
+        def sample_first(logits, key, temp, topk, topp):
+            return gen.sample_token_rows(
+                logits, key[None], jnp.zeros((1,), jnp.int32),
+                temp[None], topk[None], topp[None])[0]
+
+        self._sample_first = jax.jit(sample_first)
+        self._chunk_progs: dict[int, object] = {}
+        self._thread = threading.Thread(
+            target=self._engine, name="paged-engine", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ public
+
+    def Generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 stop_token: int = -1, pad_token: int = 0,
+                 repetition_penalty: float = 1.0):
+        prompt = _norm_prompt(prompt)
+        if (float(repetition_penalty) != 1.0
+                or (float(temperature) != 0.0 and prompt.shape[0] > 1)):
+            # Repetition penalty needs per-request seen-set state, and
+            # a MULTI-row sampled request draws from the solo path's
+            # batch-shaped RNG stream — both keep the solo fallback.
+            # Single-row sampled requests ride the engine with exact
+            # solo RNG parity (sample_token_rows).
+            return super().Generate(prompt, max_new_tokens, temperature,
+                                    seed, top_k, top_p, stop_token,
+                                    pad_token, repetition_penalty)
+        if not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(
+                f"generate: top_p must be in (0, 1], got {top_p}")
+        max_new = int(max_new_tokens)
+        if max_new <= 0:
+            return jnp.zeros((prompt.shape[0], 0), jnp.int32)
+        if prompt.shape[1] + max_new > self.reach:
+            raise ValueError(
+                f"prompt {prompt.shape[1]} + max_new {max_new} exceeds "
+                f"engine reach {self.reach}")
+        bt = self.block_tokens
+        blocks_per_row = -(-(prompt.shape[1] + max_new) // bt)
+        if blocks_per_row > self.pool.capacity:
+            raise ValueError(
+                f"request needs {blocks_per_row} blocks; pool holds "
+                f"{self.pool.capacity}")
+        # The admission seam: chaos can force a shed/delay here; real
+        # sheds (queue full) ride the same typed contract.
+        f = chaos.hit("serve.admit", f"rows={prompt.shape[0]}")
+        if f is not None:
+            if f.action == "delay":
+                f.sleep()
+            elif f.action == "shed":
+                raise ShedError("chaos: serve.admit shed",
+                                retry_after_s=self._retry_after())
+        key = (np.asarray(jax.random.PRNGKey(int(seed)))
+               if float(temperature) != 0.0
+               else np.zeros(2, np.uint32))
+        rows = [_PagedRow(np.asarray(prompt[i]), max_new,
+                          int(stop_token), float(temperature),
+                          int(top_k), float(top_p), key)
+                for i in range(prompt.shape[0])]
+        self._enter_request()
+        try:
+            with self._lock:
+                self._calls += 1
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                if (self.max_queue
+                        and len(self._queue) + len(rows) > self.max_queue):
+                    raise ShedError(
+                        f"serving backlog full "
+                        f"({len(self._queue)} queued, cap "
+                        f"{self.max_queue})",
+                        retry_after_s=self._retry_after())
+                self._queue.extend(rows)
+                self._cond.notify()
+            chaos.note_ok("serve.admit")
+            out = np.full((len(rows), max_new), int(pad_token),
+                          np.int32)
+            for i, r in enumerate(rows):
+                r.done.wait()
+                if r.err is not None:
+                    # One row failed (e.g. admit-timeout shed): the
+                    # caller gets the error for the WHOLE request, so
+                    # withdraw the sibling rows — otherwise they keep
+                    # decoding output nobody reads, holding the very
+                    # blocks an exhausted pool's shed exists to free.
+                    self._cancel_rows(rows)
+                    raise r.err
+                out[i, :len(r.emitted)] = r.emitted
+            return jnp.asarray(out)
+        finally:
+            self._exit_request()
+
+    def _cancel_rows(self, rows) -> None:
+        """Withdraw a request's not-yet-finished rows: queued ones
+        leave the queue now; the admitting/active ones are flagged and
+        the engine retires them at its next boundary."""
+        with self._cond:
+            live = set()
+            for r in rows:
+                if not r.done.is_set():
+                    r.cancelled = True
+                    live.add(id(r))
+            if live:
+                kept = []
+                for q in self._queue:
+                    if id(q) in live:
+                        q.err = RuntimeError("request cancelled")
+                        q.done.set()
+                    else:
+                        kept.append(q)
+                self._queue = kept
+
+    def _retry_after(self) -> float:
+        with self._cond:
+            backlog = len(self._queue) + len(self._slot_state) + 1
+        per = self._svc_ewma_s or 0.1
+        return round(max(0.05, backlog * per), 3)
+
+    # ------------------------------------------------------------ engine
+
+    def _engine(self) -> None:
+        """Wrapper: ANY escape — clean close or an engine error — must
+        fail every pending row, or callers hang in done.wait()."""
+        err: Exception | None = None
+        try:
+            self._engine_loop()
+        except Exception as e:  # noqa: BLE001 — delivered to callers
+            err = e
+            log.warning("paged engine died", kv={"err": repr(e)})
+        with self._cond:
+            self._closed = True
+            stragglers, self._queue = self._queue, []
+            if self._admitting is not None:
+                stragglers.append(self._admitting)
+                self._admitting = None
+        for slot in list(self._slot_state):
+            stragglers.append(self._slot_state.pop(slot))
+        for r in stragglers:
+            if not r.done.is_set():
+                r.err = err or RuntimeError("generator actor closed")
+                r.done.set()
+
+    def _engine_loop(self) -> None:
+        pending_stall = 0.0
+        while True:
+            with self._cond:
+                while (not self._queue and self._admitting is None
+                       and not self._active.any() and not self._closed):
+                    self._cond.wait()
+                    pending_stall = 0.0  # idle time is not stall
+                if self._closed:
+                    return
+            # Cancelled rows (their caller already got a sibling's
+            # error) retire before admission: their blocks are exactly
+            # the headroom the queue head is waiting on.
+            for slot in list(self._slot_state):
+                if self._active[slot] and self._slot_state[slot].cancelled:
+                    self._retire(slot)
+            # Admission round, bounded by the TOKEN budget: several
+            # short prompts (or one chunk of a long one) may prefill,
+            # but never more than prefill_chunk prompt tokens — that
+            # budget IS the stall bound a co-batched decode step sees.
+            # Charge it as stall only when a decode was LIVE to wait
+            # on it: the chunk that activates the first row of an
+            # idle engine stalls nobody (that row's own first decode
+            # is not a co-batched waiter).
+            if self._active.any():
+                pending_stall += self._admission_round()
+            else:
+                self._admission_round()
+                pending_stall = 0.0
+            if not self._active.any():
+                continue
+            self._record_stall(pending_stall * 1e3)
+            pending_stall = 0.0
+            with metrics_mod.annotate("serve.step"):
+                self._step()
+
+    def _admission_round(self) -> float:
+        """Prefill up to ``prefill_chunk`` prompt tokens; returns the
+        wall seconds spent (the stall charged to the next step)."""
+        budget = self.prefill_chunk
+        spent = 0.0
+        while budget > 0:
+            with self._cond:
+                self._maybe_start_admission()
+            row = self._admitting
+            if row is not None and row.cancelled:
+                # Withdrawn mid-prefill: drop its blocks + reservation.
+                self._admitting = None
+                self._finish_row(row)
+                continue
+            if self._admitting is None:
+                break
+            t0 = time.perf_counter()
+            with metrics_mod.annotate("serve.prefill"):
+                budget -= self._prefill_one_chunk(budget)
+            spent += time.perf_counter() - t0
+        return spent
+
+    def _maybe_start_admission(self) -> None:
+        """(under _cond) Move the queue head into admission when a
+        slot is free and the pool can cover its worst case. FIFO:
+        head-of-line blocking is the fairness contract."""
+        if self._admitting is not None or not self._queue:
+            return
+        if self._active.all():
+            return  # no slot to land in
+        row = self._queue[0]
+        need = -(-(len(row.prompt) + row.max_new) // self.block_tokens)
+        if not self.pool.try_reserve(need):
+            # Blocks come back at retire; re-checked each loop. But a
+            # bounded wait only: past admit_timeout_s AT THE QUEUE
+            # HEAD (not counting time spent behind other requests —
+            # backlog depth must not convert momentary pressure into
+            # sheds) the pool is EXHAUSTED for this request and it
+            # sheds typed — the frontdoor re-routes on that, a burned
+            # gateway deadline reads as replica failure.
+            now = time.perf_counter()
+            if row.t_head is None:
+                row.t_head = now
+            if (self.admit_timeout_s > 0
+                    and now - row.t_head > self.admit_timeout_s):
+                self._queue.pop(0)
+                row.err = ShedError(
+                    f"kv pool exhausted: need {need} blocks, "
+                    f"free {self.pool.free_blocks()} after "
+                    f"{self.admit_timeout_s:g}s at queue head",
+                    retry_after_s=self._retry_after())
+                row.done.set()
+            return
+        row.reserve_left = need
+        self._queue.pop(0)
+        self._admitting = row
+
+    def _chunk_prog(self, C: int):
+        prog = self._chunk_progs.get(C)
+        if prog is None:
+            def run(params, kb, vb, tokens, start, length, table):
+                return gen.prefill_paged_chunk(
+                    params, tokens, start, length, self.cfg, kb, vb,
+                    table)
+
+            prog = jax.jit(run, donate_argnums=(1, 2))
+            self._chunk_progs[C] = prog
+        return prog
+
+    def _prefill_one_chunk(self, budget: int | None = None) -> int:
+        """Prefill one bounded chunk of the admitting row; returns the
+        prompt tokens written (the budget it consumed)."""
+        row = self._admitting
+        toks = row.prompt
+        L = len(toks)
+        bt = self.block_tokens
+        if row.prefill_pos < 0:
+            # Reuse walk first: ref every leading resident full block.
+            # Never through the LAST prompt token — its logits must be
+            # computed to emit the first token, so at least one token
+            # always prefills.
+            row.hashes = block_hashes(toks, bt)
+            cap = min(len(row.hashes), (L - 1) // bt)
+            for i in range(cap):
+                bid = self.pool.lookup(row.hashes[i],
+                                       toks[i * bt:(i + 1) * bt])
+                if bid is None:
+                    break
+                self.pool.ref(bid)
+                row.reserve_left -= 1
+                row.table.append(bid)
+                row.reused += 1
+            self._prefix_hits += row.reused
+            self._prefix_misses += len(row.hashes) - row.reused
+            row.prefill_pos = row.reused * bt
+        start = row.prefill_pos
+        n = min(self.prefill_chunk, L - start)
+        if budget is not None:
+            n = max(1, min(n, budget))  # always progress: a 0-token
+            #                             chunk would loop forever
+        while len(row.table) * bt < start + n:
+            row.table.append(self.pool.alloc())
+            row.reserve_left -= 1
+        C = max(16, _pow2(n))
+        padded = np.zeros((1, C), np.int32)
+        padded[0, :n] = toks[start:start + n]
+        table_arr = np.zeros(self.nb, np.int32)
+        table_arr[:len(row.table)] = row.table
+        logits, self.pool.k, self.pool.v = self._chunk_prog(C)(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
+            jnp.asarray(table_arr))
+        row.prefill_pos += n
+        self._prefill_chunks += 1
+        self._prefill_tokens += n
+        if row.prefill_pos < L:
+            return n
+        # Prompt fully resident: seal the freshly-computed full blocks
+        # (reused ones are already in the index), emit the first token,
+        # land in a slot (or finish outright).
+        for i in range(row.reused, len(row.hashes)):
+            self.pool.seal(row.table[i], row.hashes[i],
+                           toks[i * bt:(i + 1) * bt])
+        if row.temperature == 0.0:
+            first = int(np.asarray(logits)[0].argmax())
+        else:
+            first = int(self._sample_first(
+                logits, jnp.asarray(row.key),
+                jnp.float32(row.temperature), jnp.int32(row.top_k),
+                jnp.float32(row.top_p)))
+        row.emitted.append(first)
+        self._admitting = None
+        self._export_gauges()
+        if (row.max_new == 1
+                or (row.stop_token >= 0 and first == row.stop_token)):
+            self._finish_row(row)
+            return n
+        slot = int(np.flatnonzero(~self._active)[0])
+        self._slot_state[slot] = row
+        self._tables[slot] = 0
+        self._tables[slot, :len(row.table)] = row.table
+        self._nalloc[slot] = len(row.table)
+        self._tok[slot] = first
+        self._pos[slot] = L
+        self._active[slot] = True
+        self._keys[slot] = row.key
+        self._temps[slot] = row.temperature
+        self._topk[slot] = row.top_k
+        self._topp[slot] = row.top_p
+        self._eidx[slot] = 1
+        self._dev = None  # slot state changed: re-upload next step
+        return n
+
+    def _step(self) -> None:
+        # Boundary crossings first: a slot whose next write lands past
+        # its allocated blocks materializes one from its reservation
+        # (guaranteed — admission reserved the worst case).
+        for slot in np.flatnonzero(self._active):
+            if self._pos[slot] == self._nalloc[slot] * self.block_tokens:
+                row = self._slot_state[slot]
+                bid = self.pool.alloc()
+                row.reserve_left -= 1
+                row.table.append(bid)
+                self._tables[slot, self._nalloc[slot]] = bid
+                self._nalloc[slot] += 1
+                self._dev = None  # tables changed: re-upload
+        sampled = bool((self._temps[self._active] > 0.0).any())
+        if self._dev is None:
+            self._dev = {
+                "tok": jnp.asarray(self._tok),
+                "pos": jnp.asarray(self._pos),
+                "tables": jnp.asarray(self._tables),
+                "active": jnp.asarray(self._active),
+                "keys": jnp.asarray(self._keys),
+                "eidx": jnp.asarray(self._eidx),
+                "temps": jnp.asarray(self._temps),
+                "topk": jnp.asarray(self._topk),
+                "topp": jnp.asarray(self._topp),
+            }
+        d = self._dev
+        with self._lock:
+            self._steps += 1
+            self._max_live = max(self._max_live,
+                                 int(self._active.sum()))
+            (self.pool.k, self.pool.v, nxt, d["pos"],
+             d["eidx"]) = self._engine_step(
+                sampled, self.params, self.pool.k, self.pool.v,
+                d["tok"], d["pos"], d["tables"], d["active"],
+                d["keys"], d["eidx"], d["temps"], d["topk"], d["topp"])
+        d["tok"] = nxt
+        nxt_host = np.array(nxt)  # host mirror for retire bookkeeping
+        self._pos[self._active] += 1
+        self._eidx[self._active] += 1
+        self._tok = nxt_host
+        for slot in list(self._slot_state):
+            if not self._active[slot]:
+                continue
+            row = self._slot_state[slot]
+            t = int(nxt_host[slot])
+            row.emitted.append(t)
+            if (len(row.emitted) >= row.max_new
+                    or (row.stop_token >= 0 and t == row.stop_token)):
+                self._retire(slot)
+        if self._steps % 32 == 0:
+            self._export_gauges()  # sampler cadence is ~50 ms+; the
+            #                        retire/admission exports keep the
+            #                        block gauges fresh between these.
+
+    def _retire(self, slot: int) -> None:
+        self._active[slot] = False
+        self._temps[slot] = 0.0
+        self._dev = None  # slot state changed: re-upload next step
+        self._finish_row(self._slot_state.pop(slot))
+        self._export_gauges()
+
+    def _finish_row(self, row: _PagedRow) -> None:
+        for bid in row.table:
+            self.pool.deref(bid)
+        if row.reserve_left > 0:
+            self.pool.unreserve(row.reserve_left)
+        row.reserve_left = 0
+        svc = time.perf_counter() - row.t_enqueue
+        self._svc_ewma_s = (svc if self._svc_ewma_s == 0.0
+                            else 0.3 * svc + 0.7 * self._svc_ewma_s)
+        row.done.set()
+
+    # -------------------------------------------------------- telemetry
+
+    def _record_stall(self, stall_ms: float) -> None:
+        self._last_stall_ms = stall_ms
+        if stall_ms > self._max_stall_ms:
+            self._max_stall_ms = stall_ms
+
+    def _export_gauges(self) -> None:
+        reg = metrics_mod.metrics
+        st = self.pool.stats()
+        reg.gauge("serve.kv_free_blocks").set(st["kv_free_blocks"])
+        reg.gauge("serve.kv_util_pct").set(st["kv_util_pct"])
+        reg.gauge("serve.prefix_hit_rate").set(self.prefix_hit_rate())
+        reg.gauge("serve.prefill_stall_ms").set(
+            round(self._max_stall_ms, 3))
+
+    def prefix_hit_rate(self) -> float:
+        total = self._prefix_hits + self._prefix_misses
+        return round(self._prefix_hits / total, 4) if total else 0.0
+
+    def Info(self) -> dict:
+        info = super().Info()
+        info["n_slots"] = self.n_slots
+        info["engine_steps"] = self._steps
+        info["max_live_slots"] = self._max_live
+        with self._cond:
+            info["queue_depth"] = len(self._queue)
+        info["live_slots"] = int(self._active.sum())
+        info.update(self.pool.stats())
+        info["block_tokens"] = self.block_tokens
+        info["prefill_chunk"] = self.prefill_chunk
+        info["admit_timeout_s"] = self.admit_timeout_s
+        info["prefix_hits"] = self._prefix_hits
+        info["prefix_misses"] = self._prefix_misses
+        info["prefix_hit_rate"] = self.prefix_hit_rate()
+        info["prefill_chunks"] = self._prefill_chunks
+        info["prefill_tokens"] = self._prefill_tokens
+        info["prefill_stall_ms"] = round(self._max_stall_ms, 3)
+        info["prefill_stall_last_ms"] = round(self._last_stall_ms, 3)
+        return info
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)

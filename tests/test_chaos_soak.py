@@ -423,13 +423,19 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
     - serving continues after the replica death (the pool evicts the
       corpse and routes around it);
     - every injected fault drains to a paired recovery
-      (``chaos.unrecovered() == {}``);
+      (``chaos.unrecovered() == {}``) — including the PAGED engine's
+      ``serve.admit`` seam (ISSUE 9): replica 1 is a real
+      PagedGeneratorActor whose admission is forced to shed/delay;
+      the gateway re-routes its typed sheds to siblings (no request
+      lost, the shedding replica not evicted), and later successful
+      admissions beacon the recoveries;
     - gateway-path fault firings (admit sheds, route vetoes, dropped
       sends) land as chaos.fault span events on the afflicted
       request's gateway.request trace (ISSUE 4).
     """
     from unittest import mock
 
+    import jax.numpy as jnp
     import numpy as np
 
     from ptype_tpu import actor as actor_mod
@@ -438,7 +444,9 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
     from ptype_tpu.coord.local import LocalCoord
     from ptype_tpu.errors import ShedError
     from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.models import transformer as tfm
     from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.serve_engine import PagedGeneratorActor
 
     class _Gen:
         def __init__(self, delay_s=0.0):
@@ -466,15 +474,23 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
         FaultSpec("gateway.probe", "timeout", after=5, times=3),
         FaultSpec("rpc.send", "drop", match="Generator.Generate",
                   after=6, times=2),
+        # The paged engine's admission seam: force typed sheds and a
+        # delay on the REAL replica (index 1 — it must survive the
+        # server-0 kill so its recoveries can pair).
+        FaultSpec("serve.admit", "shed", after=2, times=2),
+        FaultSpec("serve.admit", "delay", after=8, times=1,
+                  delay_s=0.02),
     ], seed=3, name="gateway-soak"))
+    paged = PagedGeneratorActor(
+        tfm.preset("tiny", dtype=jnp.float32), n_slots=4,
+        block_tokens=16)
     actors, servers, regs = [], [], []
     gw = None
     # Real TCP end to end: the in-process fast path has no socket for
     # rpc.send faults to injure.
     with mock.patch.object(actor_mod, "lookup_local", lambda a, p: None):
         try:
-            for i, d in enumerate((0.0, 0.0, 0.08)):
-                a = _Gen(delay_s=d)
+            for i, a in enumerate((_Gen(), paged, _Gen(delay_s=0.08))):
                 s = ActorServer("127.0.0.1", 0)
                 s.register(a, "Generator")
                 s.serve()
@@ -482,6 +498,9 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
                 servers.append(s)
                 regs.append(registry.register(
                     "llm-soak", f"r{i}", "127.0.0.1", s.port))
+            chaos.pause()
+            paged.Generate(prompt, 8)  # compile OFF the soak clock
+            chaos.resume()
             gw = InferenceGateway(
                 registry, "llm-soak",
                 GatewayConfig(probe_interval_s=0.1,
@@ -575,6 +594,7 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
                 r.close()
             for s in servers:
                 s.close()
+            paged.close()
             state.close()
 
 

@@ -232,12 +232,15 @@ def test_prefix_affinity_pins_stable_replica_and_yields_under_load(
         pinned = gw.pool.pick(affinity_key="user-42")
         # Pile synthetic load onto the pinned replica: affinity must
         # yield to the least-loaded choice rather than wedge the user.
-        for _ in range(15):
+        # 50 deep: the yield threshold has a +10 ms absolute floor, so
+        # with sub-ms probe EWMAs a shallow pile sits ON the boundary
+        # (15 deep flaked with host-load-dependent probe times).
+        for _ in range(50):
             gw.pool.begin(pinned)
         try:
             assert gw.pool.pick(affinity_key="user-42").key != pinned.key
         finally:
-            for _ in range(15):
+            for _ in range(50):
                 gw.pool.done(pinned)
     finally:
         gw.close()

@@ -534,3 +534,68 @@ def test_ptype_tpu_package_is_pt008_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt008 = [f for f in findings if "PT008" in f]
     assert not pt008, pt008
+
+
+# --------------------------------------------------------------- PT009
+
+
+PT009_RAW_BANK = (
+    "from ptype_tpu.models import generate as g\n"
+    "def build(cfg, n_slots, reach):\n"
+    "    bank = g.init_cache(cfg, n_slots, max_seq=reach)\n"
+    "    return bank\n")
+
+
+def test_pt009_flags_raw_cache_bank_in_serving_code(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve.py", PT009_RAW_BANK)
+    assert sum("PT009" in f for f in findings) == 1, findings
+    # Bare-name form too.
+    src = ("from ptype_tpu.models.generate import init_cache\n"
+           "def build(cfg):\n"
+           "    return init_cache(cfg, 8)\n")
+    findings = _check(tmp_path, "ptype_tpu/frontend.py", src)
+    assert sum("PT009" in f for f in findings) == 1, findings
+
+
+def test_pt009_exempts_serve_engine_and_models(tmp_path):
+    # serve_engine/ IS the paged pool; models/ holds init_cache and
+    # the solo compiled path.
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/blocks.py",
+                      PT009_RAW_BANK)
+    assert not any("PT009" in f for f in findings), findings
+    findings = _check(tmp_path, "ptype_tpu/models/generate.py",
+                      PT009_RAW_BANK)
+    assert not any("PT009" in f for f in findings), findings
+
+
+def test_pt009_silent_outside_package(tmp_path):
+    # Tests allocate contiguous caches deliberately (parity refs).
+    findings = _check(tmp_path, "tests/t9.py", PT009_RAW_BANK)
+    assert not any("PT009" in f for f in findings), findings
+    findings = _check(tmp_path, "examples/demo9.py", PT009_RAW_BANK)
+    assert not any("PT009" in f for f in findings), findings
+
+
+def test_pt009_honors_noqa(tmp_path):
+    src = ("from ptype_tpu.models import generate as g\n"
+           "def build(cfg):\n"
+           "    return g.init_cache(cfg, 8)  # noqa: sanctioned\n")
+    findings = _check(tmp_path, "ptype_tpu/sup9.py", src)
+    assert not any("PT009" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt009_clean():
+    """The serving actors allocate KV through the paged block pool
+    only (ISSUE 9): no contiguous full-reach bank allocations outside
+    serve_engine/ and models/."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt009 = [f for f in findings if "PT009" in f]
+    assert not pt009, pt009

@@ -1,0 +1,752 @@
+"""Paged KV-cache serving engine (ISSUE 9): block pool invariants,
+paged-vs-contiguous greedy parity, prefix reuse skipping prefill,
+chunked-prefill stall bounds, continuous-path sampling parity, the
+Pallas paged-attention kernel (interpret + lowering contract), typed
+admission sheds + the serve.admit chaos seam, and the gateway's
+pool-exhaustion / prefix-affinity load signals."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ptype_tpu import chaos
+from ptype_tpu.chaos import FaultPlan, FaultSpec
+from ptype_tpu.errors import ShedError
+from ptype_tpu.models import generate as gen
+from ptype_tpu.models import transformer as tfm
+from ptype_tpu.serve_engine import (BlockPool, PagedGeneratorActor,
+                                    block_hashes, prefix_affinity_key)
+
+CFG = tfm.preset("tiny", dtype=jnp.float32)
+RNG = np.random.default_rng(7)
+
+
+def _prompt(n, rng=RNG):
+    return jnp.asarray(rng.integers(1, CFG.vocab_size, n),
+                       jnp.int32)[None]
+
+
+# ------------------------------------------------------- pool (unit)
+
+
+def test_block_pool_refcount_reuse_eviction_invariants():
+    pool = BlockPool(CFG, n_blocks=5, block_tokens=16)  # 4 usable
+    assert pool.capacity == 4 and pool.free_blocks() == 4
+    # Reservation gates admission; acquisitions consume it.
+    assert pool.try_reserve(3)
+    assert pool.free_blocks() == 1
+    assert not pool.try_reserve(2)  # over-commit refused
+    a, b = pool.alloc(), pool.alloc()
+    toks = list(range(16))
+    h = block_hashes(toks, 16)[0]
+    pool.seal(a, h, toks)
+    assert pool.lookup(h, toks) == a
+    # Content verified: a colliding hash with different tokens misses.
+    assert pool.lookup(h, list(range(1, 17))) is None
+    # Deref a hashed block → cached (still reusable), unhashed → free.
+    pool.deref(a)
+    pool.deref(b)
+    assert pool.lookup(h, toks) == a  # cached, still addressable
+    pool.unreserve(1)
+    assert pool.check_invariants() == []
+    # Re-ref from cache consumes a reservation, leaves the LRU.
+    assert pool.try_reserve(1)
+    pool.ref(a)
+    st = pool.stats()
+    assert st["kv_used_blocks"] == 1 and st["kv_cached_blocks"] == 0
+    pool.deref(a)
+    # Exhaust the free list: the next allocs evict LRU cached blocks
+    # and their hashes leave the index.
+    assert pool.try_reserve(4)
+    got = [pool.alloc() for _ in range(4)]
+    assert a in got  # the cached block was reclaimed
+    assert pool.lookup(h, toks) is None
+    assert pool.evictions >= 1
+    for bid in got:
+        pool.deref(bid)
+    assert pool.check_invariants() == []
+    assert pool.free_blocks() == 4
+
+
+def test_block_pool_rejects_misaligned_block_tokens():
+    with pytest.raises(ValueError, match="divide"):
+        BlockPool(CFG, n_blocks=4, block_tokens=12)
+
+
+def test_block_hash_chain_commits_to_whole_prefix():
+    t1 = list(RNG.integers(1, 200, 48))
+    h1 = block_hashes(t1, 16)
+    assert len(h1) == 3
+    # Same prefix → same chain; a flip in block 0 changes EVERY hash.
+    assert block_hashes(t1 + [5, 6], 16) == h1  # partial tail ignored
+    t2 = list(t1)
+    t2[0] ^= 1
+    h2 = block_hashes(t2, 16)
+    assert all(x != y for x, y in zip(h1, h2))
+    # A flip in block 1 keeps h[0], changes h[1:] (chain property).
+    t3 = list(t1)
+    t3[20] ^= 1
+    h3 = block_hashes(t3, 16)
+    assert h3[0] == h1[0] and h3[1] != h1[1] and h3[2] != h1[2]
+    # The gateway affinity key is the FIRST block's chain hash.
+    assert prefix_affinity_key(t1, 16) == f"kv:{h1[0]:08x}"
+    assert prefix_affinity_key(t1[:15], 16) is None
+
+
+# ---------------------------------------------- parity (acceptance)
+
+
+def test_paged_engine_matches_contiguous_greedy_token_for_token():
+    """THE parity bar: concurrent mixed-length greedy requests through
+    the paged engine — including mid-decode joins — each match the
+    contiguous compiled decode (gen.generate) exactly."""
+    actor = PagedGeneratorActor(CFG, n_slots=4, block_tokens=16,
+                                prefill_chunk=24)
+    try:
+        lens = (3, 17, 5, 33, 4, 21)
+        news = (6, 12, 9, 5, 10, 7)
+        prompts = [_prompt(n) for n in lens]
+        outs = [None] * len(prompts)
+
+        def call(i, delay):
+            time.sleep(delay)  # staggered joins: mid-flight admission
+            outs[i] = actor.Generate(prompts[i], news[i])
+
+        threads = [threading.Thread(target=call,
+                                    args=(i, 0.05 * (i % 3)))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            want = gen.generate(actor.params, CFG, p, news[i])
+            np.testing.assert_array_equal(np.asarray(outs[i]),
+                                          np.asarray(want),
+                                          err_msg=f"req {i}")
+        info = actor.Info()
+        assert info["max_live_slots"] >= 2, info
+        assert actor.pool.check_invariants() == []
+        # Everything retired: pool fully reclaimable again.
+        assert info["kv_used_blocks"] == 0
+    finally:
+        actor.close()
+
+
+def test_sampled_single_row_rides_engine_with_exact_solo_parity():
+    """The sampling satellite: temperature/top-k/top-p single-row
+    requests ride the CONTINUOUS path (per-slot RNG keys folded into
+    the engine step) and still match the solo path draw-for-draw —
+    two run CONCURRENTLY to prove they co-batch without perturbing
+    each other's streams."""
+    actor = PagedGeneratorActor(CFG, n_slots=4, block_tokens=16)
+    try:
+        p1, p2 = _prompt(5), _prompt(9)
+        kw1 = dict(temperature=0.7, seed=11, top_k=5, top_p=0.9)
+        kw2 = dict(temperature=1.1, seed=3, top_k=0, top_p=0.8)
+        steps0 = actor.Info()["engine_steps"]
+        outs = [None, None]
+        ts = [threading.Thread(
+                 target=lambda: outs.__setitem__(
+                     0, actor.Generate(p1, 8, **kw1))),
+              threading.Thread(
+                 target=lambda: outs.__setitem__(
+                     1, actor.Generate(p2, 8, **kw2)))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        w1 = gen.generate(actor.params, CFG, p1, 8, 0.7,
+                          jax.random.PRNGKey(11), top_k=5, top_p=0.9)
+        w2 = gen.generate(actor.params, CFG, p2, 8, 1.1,
+                          jax.random.PRNGKey(3), top_p=0.8)
+        np.testing.assert_array_equal(np.asarray(outs[0]),
+                                      np.asarray(w1))
+        np.testing.assert_array_equal(np.asarray(outs[1]),
+                                      np.asarray(w2))
+        # They actually rode the engine, not the solo fallback.
+        assert actor.Info()["engine_steps"] > steps0
+    finally:
+        actor.close()
+
+
+def test_categorical_equals_gumbel_argmax_contract():
+    """The RNG equivalence sample_token_rows' solo parity stands on:
+    categorical(key, (1, V)) == argmax(logits + gumbel(key, (1, V))).
+    If a jax upgrade changes categorical's internals, this fails
+    before the engine's parity does."""
+    key = jax.random.fold_in(jax.random.PRNGKey(11), 3)
+    logits = jax.random.normal(jax.random.PRNGKey(0), (1, 64))
+    want = jax.random.categorical(key, logits, axis=-1)
+    got = jnp.argmax(logits + jax.random.gumbel(key, (1, 64)), axis=-1)
+    assert int(want[0]) == int(got[0])
+
+
+def test_stop_token_frees_slot_and_blocks_early():
+    actor = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16)
+    try:
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        max_new = 24
+        solo = gen.generate(actor.params, CFG, prompt, max_new)
+        stop = int(np.asarray(solo)[0, 2])
+        out = actor.Generate(prompt, max_new, stop_token=stop,
+                             pad_token=7)
+        want = gen.generate(actor.params, CFG, prompt, max_new,
+                            stop_token=stop, pad_token=7)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(want))
+        info = actor.Info()
+        assert info["engine_steps"] < max_new, (
+            "stop token did not retire the slot early")
+        assert info["kv_used_blocks"] == 0  # blocks came back
+    finally:
+        actor.close()
+
+
+# --------------------------------------------------- prefix reuse
+
+
+def test_prefix_hit_skips_prefill_engine_work_asserted():
+    """An affinity-landed request whose prefix blocks are resident
+    skips their prefill: hits > 0, and the second request's prefill
+    token/chunk counts shrink to just its divergent tail — with exact
+    greedy parity throughout (reused blocks ARE the same K/V)."""
+    actor = PagedGeneratorActor(CFG, n_slots=4, block_tokens=16,
+                                prefill_chunk=16)
+    try:
+        shared = np.asarray(RNG.integers(1, CFG.vocab_size, 48),
+                            np.int32)
+        p1 = jnp.asarray(np.concatenate(
+            [shared, RNG.integers(1, CFG.vocab_size, 7)]).astype(
+                np.int32))[None]
+        p2 = jnp.asarray(np.concatenate(
+            [shared, RNG.integers(1, CFG.vocab_size, 5)]).astype(
+                np.int32))[None]
+        o1 = actor.Generate(p1, 8)
+        i1 = actor.Info()
+        assert i1["prefix_hits"] == 0  # cold: nothing resident
+        o2 = actor.Generate(p2, 8)
+        i2 = actor.Info()
+        # 48 shared tokens = 3 full blocks reused.
+        assert i2["prefix_hits"] == 3, i2
+        assert i2["prefix_hit_rate"] > 0
+        # Prefill work asserted: request 2 prefilled ONLY its 5-token
+        # tail (one chunk), not the 53-token prompt.
+        assert i2["prefill_tokens"] - i1["prefill_tokens"] == 5
+        assert i2["prefill_chunks"] - i1["prefill_chunks"] == 1
+        for p, o in ((p1, o1), (p2, o2)):
+            want = gen.generate(actor.params, CFG, p, 8)
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.asarray(want))
+        assert actor.pool.check_invariants() == []
+    finally:
+        actor.close()
+
+
+def test_prefix_cache_evicts_under_pressure_and_stays_sound():
+    """A pool smaller than the working set: cached prefix blocks are
+    evicted LRU to make room, counters tick, invariants hold, and
+    every request still matches solo."""
+    actor = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16,
+                                n_blocks=9, max_len=64)  # 8 usable
+    try:
+        prompts = [_prompt(33) for _ in range(4)]  # 3 blocks each
+        for p in prompts:
+            want = gen.generate(actor.params, CFG, p, 4)
+            np.testing.assert_array_equal(
+                np.asarray(actor.Generate(p, 4)), np.asarray(want))
+        st = actor.pool.stats()
+        assert st["kv_evictions"] > 0, st
+        assert actor.pool.check_invariants() == []
+        assert st["kv_used_blocks"] == 0
+    finally:
+        actor.close()
+
+
+# ------------------------------------------- chunked prefill stall
+
+
+def test_chunked_prefill_bounds_co_batched_decode_stall():
+    """The interference bar: one long prompt admitted while a decode
+    is live. Whole-prompt admission stalls the co-batched decode for
+    the full prefill; chunked admission bounds the per-step stall to
+    one chunk — measured by the engine's own stall meter, with the
+    goodput ledger's serve-side prefill leg cross-checking."""
+    from ptype_tpu.health.goodput import GoodputLedger
+
+    # Big enough that per-chunk COMPUTE dominates dispatch (the tiny
+    # preset is dispatch-bound on CPU — 96- vs 16-token prefills cost
+    # the same there and the comparison measures scheduler noise).
+    cfg = tfm.preset("tiny", d_model=256, n_layers=4, d_ff=512,
+                     dtype=jnp.float32)
+    long_p = jnp.asarray(RNG.integers(1, cfg.vocab_size, 96),
+                         jnp.int32)[None]
+    # Same length (same compiled shapes), DIFFERENT content: warming
+    # with long_p itself would seal its blocks and the measured pass
+    # would prefix-hit its way down to one tail chunk in both drives,
+    # reducing the comparison to scheduler noise.
+    warm_p = jnp.asarray(RNG.integers(1, cfg.vocab_size, 96),
+                         jnp.int32)[None]
+    short = jnp.zeros((1, 4), jnp.int32)
+
+    def drive(prefill_chunk):
+        actor = PagedGeneratorActor(cfg, n_slots=2, block_tokens=16,
+                                    prefill_chunk=prefill_chunk)
+        ledger = GoodputLedger(step_name="serve.step").install()
+        stalls: list[float] = []
+        rec0 = actor._record_stall
+        actor._record_stall = lambda ms: (stalls.append(ms),
+                                          rec0(ms))[-1]
+        try:
+            # Warm every chunk-bucket compile OFF the measured pass.
+            actor.Generate(warm_p, 2)
+            actor.Generate(short, 2)
+            actor._max_stall_ms = actor._last_stall_ms = 0.0
+            stalls.clear()
+            done = threading.Event()
+            t = threading.Thread(target=lambda: (
+                actor.Generate(short, 48), done.set()))
+            t.start()
+            while actor.Info()["live_slots"] < 1 and not done.is_set():
+                time.sleep(0.002)
+            out = actor.Generate(long_p, 4)
+            t.join(timeout=120)
+            meter = actor.Info()["prefill_stall_ms"]
+            recs = ledger.records()
+            return out, [s for s in stalls if s > 0.05], meter, recs
+        finally:
+            ledger.uninstall()
+            actor.close()
+
+    out_c, stalls_c, meter_c, recs = drive(16)
+    out_w, stalls_w, meter_w, _ = drive(None)  # None → whole prompt
+    np.testing.assert_array_equal(np.asarray(out_c), np.asarray(out_w))
+    # The acceptance inequality: bounded chunks beat the whole-prompt
+    # stall with real margin (96 tokens vs 16-token chunks). The
+    # chunked side is judged by its MEDIAN per-step stall — the
+    # typical decode step's wait, robust to one OS-scheduler spike
+    # poisoning the max on a shared CPU — against the whole-prompt
+    # drive's biggest recorded stall (its long prefill; noise only
+    # inflates it, which tightens the bar). The two drives run seconds
+    # apart, so a sustained load shift between them can still invert
+    # the comparison: re-drive BOTH sides (up to twice) only when the
+    # bar is unmet rather than trusting one poisoned pair.
+    for _ in range(2):
+        if (len(stalls_c) >= 6
+                and float(np.median(stalls_c)) < 0.75 * max(stalls_w)):
+            break
+        out_c, stalls_c, meter_c, recs = drive(16)
+        out_w, stalls_w, meter_w, _ = drive(None)
+        np.testing.assert_array_equal(np.asarray(out_c),
+                                      np.asarray(out_w))
+    stall_whole = max(stalls_w)
+    stall_chunked = float(np.median(stalls_c))
+    # Chunked admission interleaved: ≥ 96/16 bounded stalls, not one.
+    assert len(stalls_c) >= 6, stalls_c
+    assert stall_chunked < 0.75 * stall_whole, (stalls_c, stalls_w)
+    # The engine's own meter carries the signal the bench exports.
+    assert meter_w >= stall_whole - 0.01 and meter_c > 0
+    # The ledger saw serve-side steps with a prefill leg.
+    assert any(r["prefill_ms"] > 0 for r in recs), recs[-5:]
+
+
+# ------------------------------------------------ admission sheds
+
+
+def test_backlog_sheds_typed_with_retry_hint():
+    actor = PagedGeneratorActor(CFG, n_slots=1, block_tokens=16,
+                                max_queue=1)
+    try:
+        first_done = threading.Event()
+        t = threading.Thread(target=lambda: (
+            actor.Generate(jnp.zeros((1, 4), jnp.int32), 48),
+            first_done.set()))
+        t.start()
+        deadline = time.monotonic() + 30
+        while (actor.Info()["live_slots"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        # Slot busy: the next request QUEUES (cap 1)...
+        t2 = threading.Thread(target=lambda: actor.Generate(
+            jnp.zeros((1, 5), jnp.int32), 4))
+        t2.start()
+        deadline = time.monotonic() + 30
+        while (actor.Info()["queue_depth"] < 1
+               and not first_done.is_set()
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        # ... and the one after sheds TYPED with a retry hint. The
+        # first request finishing between the check and the call drains
+        # the queue and admits this one instead — a benign interleaving
+        # on a loaded host, tolerated; anything else must shed typed.
+        if not first_done.is_set():
+            try:
+                actor.Generate(jnp.zeros((1, 6), jnp.int32), 4)
+                assert first_done.is_set(), \
+                    "admitted with the backlog still full (expected ShedError)"
+            except ShedError as e:
+                assert e.retry_after_s > 0
+        t.join(timeout=120)
+        t2.join(timeout=120)
+    finally:
+        actor.close()
+
+    # A request that can NEVER fit rejects loudly up front.
+    tiny = PagedGeneratorActor(CFG, n_slots=1, block_tokens=16,
+                               n_blocks=2, max_len=32)  # capacity 1
+    try:
+        with pytest.raises(ValueError, match="blocks"):
+            tiny.Generate(jnp.zeros((1, 30), jnp.int32), 2)
+    finally:
+        tiny.close()
+
+
+def test_pool_exhaustion_sheds_typed_after_admit_timeout():
+    """A reserve-refused head-of-line request waits at most
+    admit_timeout_s, then sheds TYPED (the frontdoor re-routes on
+    that) — and admits normally once headroom returns."""
+    actor = PagedGeneratorActor(CFG, n_slots=1, block_tokens=16,
+                                admit_timeout_s=0.2)
+    try:
+        # Exhaust the pool from outside: every real reservation is
+        # now refused, exactly the oversubscribed-pool regime.
+        grabbed = actor.pool.free_blocks()
+        assert actor.pool.try_reserve(grabbed)
+        t0 = time.monotonic()
+        with pytest.raises(ShedError, match="exhausted") as ei:
+            actor.Generate(jnp.zeros((1, 4), jnp.int32), 4)
+        assert ei.value.retry_after_s > 0
+        assert time.monotonic() - t0 < 10  # bounded, not deadline-burn
+        # Headroom back -> the same request admits and completes.
+        actor.pool.unreserve(grabbed)
+        out = actor.Generate(jnp.zeros((1, 4), jnp.int32), 4)
+        assert out.shape == (1, 4)
+        assert actor.Info()["admit_timeout_s"] == 0.2
+    finally:
+        actor.close()
+
+
+def test_multirow_shed_leaves_no_orphaned_work():
+    """When a multi-row request raises (one row shed at the admit
+    timeout), its sibling rows are withdrawn: nothing keeps queuing or
+    decoding output the caller will never read, and the pool drains."""
+    # capacity 8 covers exactly ONE row's worst case (4 + 120 tokens
+    # -> 8 blocks): row 0 admits, rows 1-2 queue and shed.
+    actor = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16,
+                                n_blocks=9, admit_timeout_s=0.2)
+    try:
+        with pytest.raises(ShedError):
+            actor.Generate(jnp.zeros((3, 4), jnp.int32), 120)
+        s0 = actor.Info()["engine_steps"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            info = actor.Info()
+            if (info["live_slots"] == 0 and info["queue_depth"] == 0
+                    and actor.pool.used_blocks() == 0):
+                break
+            time.sleep(0.01)
+        info = actor.Info()
+        assert info["live_slots"] == 0
+        assert info["queue_depth"] == 0
+        assert actor.pool.used_blocks() == 0
+        # No withdrawn sibling decoded its 120 steps after the raise.
+        assert actor.Info()["engine_steps"] - s0 < 60
+    finally:
+        actor.close()
+
+
+def test_cancel_rows_retires_active_row_and_frees_blocks():
+    """White-box: flagging a LIVE row via _cancel_rows makes the
+    engine retire it at the next boundary and free its blocks."""
+    actor = PagedGeneratorActor(CFG, n_slots=1, block_tokens=16)
+    try:
+        t = threading.Thread(target=lambda: np.asarray(
+            actor.Generate(jnp.zeros((1, 4), jnp.int32), 120)))
+        t.start()
+        deadline = time.monotonic() + 30
+        while (actor.Info()["live_slots"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        slot = int(np.flatnonzero(actor._active)[0])
+        row = actor._slot_state[slot]
+        actor._cancel_rows([row])
+        deadline = time.monotonic() + 30
+        while (actor.pool.used_blocks() > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert actor.pool.used_blocks() == 0
+        assert len(row.emitted) < 120  # retired early, not run out
+        t.join(timeout=120)
+    finally:
+        actor.close()
+
+
+def test_serve_admit_chaos_seam_sheds_and_pairs():
+    """The serve.admit seam: a planned fault forces a typed shed with
+    a retry hint; the next successful admission beacons recovery
+    (unrecovered() drains to empty)."""
+    actor = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("serve.admit", "shed", times=1),
+        FaultSpec("serve.admit", "delay", after=1, times=1,
+                  delay_s=0.01),
+    ], seed=1, name="serve-admit"))
+    try:
+        with pytest.raises(ShedError) as ei:
+            actor.Generate(jnp.zeros((1, 4), jnp.int32), 4)
+        assert ei.value.retry_after_s > 0
+        out = actor.Generate(jnp.zeros((1, 4), jnp.int32), 4)
+        assert np.asarray(out).shape == (1, 4)
+        # Pairing is one success per outstanding fault: the delayed
+        # call's own beacon paired the delay; one more clean admission
+        # pairs the shed.
+        actor.Generate(jnp.zeros((1, 4), jnp.int32), 2)
+        assert [e.site for e in plan.fired()] == ["serve.admit",
+                                                  "serve.admit"]
+        assert chaos.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+        actor.close()
+
+
+# ------------------------------------------------- paged kernel
+
+
+def test_paged_kernel_interpret_matches_gather():
+    rng = np.random.default_rng(0)
+    from ptype_tpu.ops.paged_attention import paged_attention
+
+    B, bt, nb, n_blocks = 3, 16, 8, 30
+    Kh, Dh, H = CFG.kv_heads, CFG.head_dim, CFG.n_heads
+    kc = jnp.asarray(rng.normal(size=(n_blocks, bt, Kh, Dh)),
+                     jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(n_blocks, bt, Kh, Dh)),
+                     jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+    tables = jnp.asarray(rng.integers(1, n_blocks, (B, nb)), jnp.int32)
+    pos = jnp.asarray([5, 37, 100], jnp.int32)
+    ref = gen._paged_attention_gather(q, kc, vc, tables, pos + 1, CFG)
+    out = paged_attention(q, kc, vc, tables, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_kernel_lowering_contract():
+    from ptype_tpu.ops.paged_attention import check_tpu_lowering
+
+    # The serving shapes that should run on real TPU: 128-wide heads,
+    # sublane-aligned blocks (the optimus-125m presets' geometry).
+    assert check_tpu_lowering(8, 6, 6, 128, 257, 32, 16) == []
+    assert check_tpu_lowering(8, 8, 2, 128, 513, 128, 8) == []  # GQA
+    # Misaligned block_tokens / head_dim are NAMED, on CPU, before a
+    # TPU session trips over them (the BENCH_r02 failure class).
+    assert any("block_tokens" in v
+               for v in check_tpu_lowering(8, 6, 6, 128, 257, 12, 16))
+    assert any("head_dim" in v
+               for v in check_tpu_lowering(8, 4, 4, 16, 65, 16, 8))
+    # The engine refuses to arm the kernel on a non-CPU backend when
+    # the contract fails (gated, not crash-at-decode).
+    import unittest.mock as mock
+    with mock.patch.object(jax, "default_backend",
+                           return_value="tpu"):
+        with pytest.raises(ValueError, match="lower"):
+            PagedGeneratorActor(CFG, n_slots=2, attn="kernel")
+
+
+def test_engine_with_kernel_attn_matches_gather_engine():
+    """End-to-end: the SAME engine stack with attn="kernel"
+    (interpret-mode on CPU) decodes greedy requests to the same
+    tokens as the gather path."""
+    a = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16)
+    b = PagedGeneratorActor(CFG, params=a.params, n_slots=2,
+                            block_tokens=16, attn="kernel")
+    try:
+        p = _prompt(21)
+        out_a = np.asarray(a.Generate(p, 10))
+        out_b = np.asarray(b.Generate(p, 10))
+        np.testing.assert_array_equal(out_a, out_b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------ gateway signals
+
+
+def test_gateway_affinity_yields_when_replica_pool_exhausted(coord):
+    """The load-signal satellite: probes pick up kv_free_blocks /
+    prefix_hit_rate from Info(), and prefix affinity YIELDS when the
+    pinned replica's pool is exhausted (an affinity hit that sheds is
+    worse than a cold miss elsewhere)."""
+    import test_gateway as tg
+    from ptype_tpu.registry import CoordRegistry
+
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    actors, servers, regs = tg._fleet(registry, "llm-kv", [0.0, 0.0])
+    gw = tg._gateway(registry, "llm-kv")
+    try:
+        assert tg._wait_healthy(gw, 2)
+        # Freeze probing: a probe RTT spike under full-suite CPU load
+        # would overwrite the pinned latency signals and make affinity
+        # yield for the wrong reason. (Monkeypatch, then let any
+        # in-flight round drain.)
+        gw.pool.probe_now = lambda: None
+        time.sleep(0.3)
+        # Fake paged-engine load reports (the fleet is fake actors;
+        # the pool only sees Info payloads either way).
+        key = "kv:deadbeef"
+        stable = sorted(gw.pool.healthy(), key=lambda r: r.key)
+        from ptype_tpu.rpc import fnv32a
+
+        pinned = stable[fnv32a(key) % len(stable)]
+        other = next(r for r in stable if r is not pinned)
+        for r, free in ((pinned, 17), (other, 9)):
+            with r.lock:
+                r.reported = dict(r.reported, kv_free_blocks=free,
+                                  prefix_hit_rate=0.5)
+                r.ewma_ms = r.probe_ms = 1.0  # equal latency signals
+        assert gw.pool.pick(affinity_key=key) is pinned
+        snap = pinned.snapshot()
+        assert snap["kv_free_blocks"] == 17
+        assert snap["prefix_hit_rate"] == 0.5
+        # Exhaust the pinned replica's pool: affinity yields.
+        with pinned.lock:
+            pinned.reported = dict(pinned.reported, kv_free_blocks=0)
+        assert gw.pool.pick(affinity_key=key) is other
+        # Headroom back → affinity pins again.
+        with pinned.lock:
+            pinned.reported = dict(pinned.reported, kv_free_blocks=3)
+        assert gw.pool.pick(affinity_key=key) is pinned
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+def test_gateway_shared_prefix_workload_earns_hits_on_affinity_replica(
+        coord):
+    """Acceptance shape: a shared-prefix workload routed with
+    prefix_affinity_key through the gateway lands every request on
+    ONE replica, whose prefix-cache hit counters move — the OTHER
+    replica stays cold (affinity is what turns routing into cache
+    hits)."""
+    import test_gateway as tg
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.registry import CoordRegistry
+
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    base = PagedGeneratorActor(CFG, n_slots=4, block_tokens=16)
+    twin = PagedGeneratorActor(CFG, params=base.params, n_slots=4,
+                               block_tokens=16)
+    actors, servers, regs = [base, twin], [], []
+    for i, a in enumerate(actors):
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        servers.append(s)
+        regs.append(registry.register("llm-paged", f"r{i}",
+                                      "127.0.0.1", s.port))
+    gw = tg._gateway(registry, "llm-paged", per_replica_inflight=4)
+    try:
+        assert tg._wait_healthy(gw, 2)
+        shared = np.asarray(RNG.integers(1, CFG.vocab_size, 48),
+                            np.int32)
+        key = prefix_affinity_key(shared, 16)
+        assert key is not None
+        for i in range(3):
+            tail = RNG.integers(1, CFG.vocab_size, 3 + i)
+            p = jnp.asarray(np.concatenate([shared, tail]).astype(
+                np.int32))[None]
+            out = gw.generate(p, 4, affinity_key=key)
+            assert np.asarray(out).shape == (1, 4)
+        hits = [a.Info()["prefix_hits"] for a in actors]
+        # One replica took the whole affinity stream and HIT; the
+        # other never saw the prefix.
+        assert sorted(hits)[-1] > 0, hits
+        assert sorted(hits)[0] == 0, hits
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        for a in actors:
+            a.close()
+
+
+def test_gateway_reroutes_replica_shed_without_evicting(coord):
+    """A replica-side typed shed (serve.admit / pool exhausted) is a
+    ROUTING signal, not a failure: the gateway re-routes to a sibling
+    with headroom, answers the request, and the shedding replica is
+    neither evicted nor error-counted."""
+    import test_gateway as tg
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.registry import CoordRegistry
+
+    class _Shedder:
+        calls = 0
+
+        def Generate(self, prompt, max_new_tokens=8, *a):
+            type(self).calls += 1
+            raise ShedError("pool exhausted", retry_after_s=0.25)
+
+        def Info(self):
+            return {"in_flight": 0, "queue_depth": 0,
+                    "kv_free_blocks": 0}
+
+    registry = CoordRegistry(coord, lease_ttl=1.0)
+    healthy = tg._FakeGen(name="ok")
+    actors = [_Shedder(), healthy]
+    servers, regs = [], []
+    for i, a in enumerate(actors):
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        servers.append(s)
+        regs.append(registry.register("llm-shed", f"r{i}",
+                                      "127.0.0.1", s.port))
+    gw = tg._gateway(registry, "llm-shed")
+    try:
+        assert tg._wait_healthy(gw, 2)
+        served = 0
+        for _ in range(6):
+            out = gw.generate(tg.PROMPT, 8)
+            assert np.asarray(out).shape == (1, 8)
+            served += 1
+        assert served == 6
+        # The shedder answered typed at least once and is still a
+        # healthy, routable member (no eviction pressure).
+        assert gw.pool.n_healthy() == 2
+    finally:
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+
+
+# -------------------------------------------------- goodput leg
+
+
+def test_goodput_ledger_attributes_serve_prefill_leg():
+    from ptype_tpu.health.goodput import GoodputLedger
+
+    led = GoodputLedger(step_name="serve.step")
+    with led.region("serve.step"):
+        time.sleep(0.005)
+    with led.region("serve.prefill"):
+        time.sleep(0.02)
+    with led.region("serve.step"):
+        time.sleep(0.005)
+    rec = led.records()[-1]
+    # The chunk is attributed to the prefill leg AND deducted from
+    # stall — bounded-stall is a measured number, not a vibe.
+    assert rec["prefill_ms"] >= 15, rec
+    assert rec["stall_ms"] < rec["prefill_ms"], rec
+    assert led.summary()["step_breakdown"]["prefill_ms"] > 0

@@ -143,9 +143,9 @@ def main() -> int:
         "flash prefill logits diverge from dense on TPU")
     print("tpu-smoke flash-prefill: OK")
 
-    # (b) continuous-batching engine: per-row-depth ragged decode
-    # (decode_step_ragged scatter writes + per-row position masks) and
-    # the slot prefill must produce each row's solo decode on TPU.
+    # (b) continuous-batching engine: the paged decode step (block-
+    # table gather/scatter + per-row position masks) and chunked
+    # prefill must produce each row's solo decode on TPU.
     from ptype_tpu.serve import ContinuousGeneratorActor
 
     actor = ContinuousGeneratorActor(dcfg, params=fparams, n_slots=2)

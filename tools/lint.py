@@ -58,6 +58,13 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   the rate-limited, artifact-managed seam in health/profiling.py (or
   the metrics.trace context manager, which profiling exempts as the
   one legacy local wrapper)
+- PT009 (ptype_tpu/ outside serve_engine/ and models/): a raw
+  ``init_cache`` call — a serving actor that allocates a contiguous
+  full-reach KV bank pins ``n_slots × reach`` device memory whether
+  or not any token exists, exactly the footprint the paged block pool
+  (serve_engine.BlockPool: ref-counted blocks, prefix reuse, LRU
+  eviction) replaces; serving code gets its KV storage from the pool
+  (models/generate.py keeps init_cache for the solo compiled path)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -534,6 +541,37 @@ class _RawProfilerTraceCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawCacheBankCheck(ast.NodeVisitor):
+    """PT009: ``init_cache(...)`` (bare or attribute form — ``g.
+    init_cache`` / ``gen.init_cache``) in ptype_tpu/ outside
+    serve_engine/ and models/. A contiguous full-reach bank resident
+    per slot is the memory ceiling the paged KV pool removes; serving
+    code must allocate through serve_engine.BlockPool so resident
+    memory tracks actual token counts (and prefix blocks are shared
+    and evictable)."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name == "init_cache":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT009 raw init_cache "
+                f"full-reach bank allocation in serving code — "
+                f"resident KV must come from the paged block pool "
+                f"(serve_engine.BlockPool: ref-counted blocks, prefix "
+                f"reuse, LRU eviction), not a contiguous "
+                f"n_slots×reach bank")
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -608,6 +646,13 @@ def check_file(path: str, findings: list[str]) -> None:
         # The data plane's int8 narrowings must ride the scaled
         # quantize helpers — a bare cast is silent gradient loss.
         _RawInt8CastCheck(path, raw).visit(tree)
+    if ("ptype_tpu" in parts and "serve_engine" not in parts
+            and "models" not in parts):
+        # serve_engine/ IS the paged pool; models/ holds init_cache
+        # itself and the solo compiled path. Everywhere else (serve.py
+        # and any future serving module), contiguous full-reach banks
+        # are the footprint the pool replaces.
+        _RawCacheBankCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
