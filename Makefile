@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo
+.PHONY: test test-all bench serve-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -78,6 +78,14 @@ obs-demo:
 # stitched cluster snapshot and the `obs top` view renders it.
 health-demo:
 	JAX_PLATFORMS=cpu python examples/observability/health_demo.py
+
+# Serving observability walkthrough (docs/OBSERVABILITY.md "Serving
+# plane"): a traced 2-replica paged fleet takes a shared-prefix burst
+# through the gateway; the serving ledger's TTFT/TPOT/KV series feed
+# the `obs serve` view and one stitched Perfetto export lands in
+# $OBS_DIR/serve_trace.json.
+serve-obs-demo:
+	JAX_PLATFORMS=cpu python examples/observability/serve_demo.py
 
 # Compile + run the Pallas flash kernel fwd/bwd on an attached TPU —
 # the only tier that sees Mosaic tiling checks (exit 42 = no TPU,

@@ -758,6 +758,19 @@ def _serve_paged_probe() -> dict:
     - ``serve_prefill_stall_ms``: the engines' max co-batched
       decode-step stall under chunked admission (bounded by the
       ``prefill_chunk`` budget, vs the whole-prompt prefill today).
+
+    Serving-ledger fields (ISSUE 10), from the same driven traffic:
+
+    - ``serve_ttft_p99_ms`` / ``serve_tpot_ms``: the ledgers'
+      time-to-first-token p99 and median inter-token time across both
+      replicas — the histograms `obs serve` and the ``ttft-p99`` rule
+      read, here measured on real gateway-routed requests;
+    - ``serving_ledger_overhead_pct``: ledger seam cost per engine
+      iteration (``measure_seam_cost_us``, a tight loop over the real
+      seam calls — measured like PR 8's ``profile_overhead_pct``,
+      because wall-clock A/B on a shared host reports scheduler
+      jitter) divided by the measured mean engine-iteration time.
+      The bar is <1%; the number is REPORTED here, never asserted.
     """
     import threading
 
@@ -886,6 +899,19 @@ def _serve_paged_probe() -> dict:
         poller.join(timeout=5)
         infos = [a.Info() for a in actors]
         hits = [i["prefix_hits"] for i in infos]
+        # Serving-ledger tail (ISSUE 10): TTFT/TPOT from the ledgers
+        # that metered the driven traffic; overhead = seam cost per
+        # iteration / measured iteration time.
+        from ptype_tpu.health.serving import measure_seam_cost_us
+
+        ttft_p99 = max(i.get("ttft_p99_ms", 0.0) for i in infos)
+        tpot_ms = max(i.get("tpot_p50_ms", 0.0) for i in infos)
+        step_means = [a.ledger.iteration_summary()["step_ms_mean"]
+                      for a in actors]
+        step_ms = max([m for m in step_means if m > 0] or [0.0])
+        seam_us = measure_seam_cost_us()["seam_cost_us"]
+        overhead_pct = (round(100.0 * seam_us / (step_ms * 1e3), 4)
+                        if step_ms > 0 else None)
         return {
             "serve_prefix_hit_speedup": round(cold_s / warm_s, 2),
             "serve_kv_util_pct": util_max[0],
@@ -898,6 +924,11 @@ def _serve_paged_probe() -> dict:
                 sum(i["kv_evictions"] for i in infos),
             "serve_prefill_chunk_tokens": CHUNK,
             "serve_block_tokens": BT,
+            "serve_ttft_p99_ms": ttft_p99,
+            "serve_tpot_ms": tpot_ms,
+            "serving_ledger_overhead_pct": overhead_pct,
+            "serving_ledger_seam_cost_us": seam_us,
+            "serve_step_ms_mean": step_ms,
             "paged_cold_wall_s": round(cold_s, 3),
             "paged_shared_wall_s": round(warm_s, 3),
             "notes": (
@@ -906,7 +937,10 @@ def _serve_paged_probe() -> dict:
                 f"replicas (d_model=256/L4), affinity-routed; "
                 f"speedup = unique-prefix wall / shared-prefix wall; "
                 f"stall is the max co-batched decode-step wait under "
-                f"{CHUNK}-token chunked admission"),
+                f"{CHUNK}-token chunked admission; ttft/tpot from the "
+                f"serving ledgers on the same traffic; ledger overhead "
+                f"= seam cost per iteration / mean engine-iteration "
+                f"wall (<1% bar, reported not asserted)"),
         }
     finally:
         stop.set()

@@ -38,6 +38,13 @@ operations need. Commands:
                recent alerts ($TOP_ITERS bounds the refreshes for
                scripted runs; ^C exits). docs/OPERATIONS.md has the
                per-alert runbook.
+- ``obs serve`` — LIVE serving-plane view (ISSUE 10): re-pull the
+               cluster telemetry every $TOP_INTERVAL, run the alert
+               rules (incl. kv-pressure / prefix-hit-collapse /
+               serve-stall; ttft-p99 when an SLO is set), and repaint
+               per-replica TTFT/TPOT/e2e tails, queue + batch
+               occupancy, and KV-pool pressure from the serving
+               ledger ($TOP_ITERS bounds refreshes; ^C exits).
 - ``obs profile`` — cluster-wide device profiling: simultaneous
                jax.profiler XPlane capture on every registered node
                via the built-in ptype.Profile endpoint
@@ -364,6 +371,17 @@ def _obs() -> None:
                         iters=int(os.environ.get("TOP_ITERS", "0")),
                         interval_s=float(
                             os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "serve":
+            from ptype_tpu.health import run_serve
+
+            try:
+                run_serve(CoordRegistry(coord),
+                          iters=int(os.environ.get("TOP_ITERS", "0")),
+                          interval_s=float(
+                              os.environ.get("TOP_INTERVAL", "2")))
             except KeyboardInterrupt:
                 pass
             return

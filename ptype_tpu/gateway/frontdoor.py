@@ -36,6 +36,8 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 
+import numpy as np
+
 from ptype_tpu import chaos, logs, metrics as metrics_mod, retry, trace
 from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
                               ShedError)
@@ -80,8 +82,31 @@ class GatewayConfig:
     info_method: str = "Generator.Info"
     #: Optional p99 target feeding the scale hint (None = no SLO term).
     slo_p99_ms: float | None = None
+    #: Optional TTFT p99 target (ms). Fed from replica-reported
+    #: per-request samples (the serving ledger's ``ttft_recent``,
+    #: drained by the pool's probes); a breach outranks the e2e p99
+    #: term in the scale hint — prompt-heavy overload blows the first
+    #: token long before the e2e tail moves.
+    slo_ttft_p99_ms: float | None = None
     #: Rolling window for shed-rate / tokens-per-sec readouts.
     stats_window_s: float = 30.0
+
+
+def _count_generated(result, stop_token: int) -> int:
+    """Generated tokens in one ``Generate`` reply ``(B, max_new)``:
+    each row ends at its first ``stop_token`` (inclusive — the engine
+    emits it) or runs the full width; the pad tail after an early stop
+    is NOT generated throughput."""
+    arr = np.asarray(result)
+    if arr.ndim != 2:
+        return int(arr.size)
+    if stop_token < 0:
+        return int(arr.size)
+    total = 0
+    for row in arr:
+        hits = np.flatnonzero(row == stop_token)
+        total += (int(hits[0]) + 1) if hits.size else int(row.shape[0])
+    return total
 
 
 class InferenceGateway:
@@ -94,7 +119,8 @@ class InferenceGateway:
         self.service = service
         self.slo = SLOTracker(service, registry=metrics_registry,
                               window_s=self.cfg.stats_window_s,
-                              slo_p99_ms=self.cfg.slo_p99_ms)
+                              slo_p99_ms=self.cfg.slo_p99_ms,
+                              slo_ttft_p99_ms=self.cfg.slo_ttft_p99_ms)
         self.pool = ReplicaPool(
             registry, service,
             info_method=self.cfg.info_method,
@@ -104,7 +130,8 @@ class InferenceGateway:
             ewma_alpha=self.cfg.ewma_alpha,
             dial_timeout=self.cfg.dial_timeout_s,
             affinity_slack=self.cfg.affinity_slack,
-            on_change=self._on_fleet_change)
+            on_change=self._on_fleet_change,
+            on_ttft=self.slo.record_ttft)
         self.admission = AdmissionQueue(
             self.cfg.max_queue_depth,
             capacity=self._capacity,
@@ -142,6 +169,7 @@ class InferenceGateway:
         surviving replicas inside the deadline.
         """
         args = (prompt, int(max_new_tokens))
+        stop_token = int(gen_kwargs.get("stop_token", -1))
         if gen_kwargs:
             # Positional tail matching GeneratorActor.Generate.
             order = ("temperature", "seed", "top_k", "top_p",
@@ -154,12 +182,15 @@ class InferenceGateway:
                 raise TypeError(f"unknown generate kwargs: {unknown}")
             defaults.update(gen_kwargs)
             args = args + tuple(defaults[k] for k in order)
-        return self.call(self.cfg.generate_method, *args,
-                         deadline_s=deadline_s, affinity_key=affinity_key)
+        return self.call(
+            self.cfg.generate_method, *args,
+            deadline_s=deadline_s, affinity_key=affinity_key,
+            count_tokens=lambda out: _count_generated(out, stop_token))
 
     def call(self, method: str, *args,
              deadline_s: float | None = None,
-             affinity_key: str | None = None):
+             affinity_key: str | None = None,
+             count_tokens=None):
         """Generic gateway dispatch (Generate is sugar over this).
 
         The whole request runs inside a ``gateway.request`` span with
@@ -182,13 +213,14 @@ class InferenceGateway:
                 trace.maybe_dump(f"shed at admission ({self.service})")
                 raise
             try:
-                return self._dispatch(method, args, deadline, affinity_key)
+                return self._dispatch(method, args, deadline,
+                                      affinity_key, count_tokens)
             finally:
                 self.admission.release()
                 self._export_gauges()
 
     def _dispatch(self, method: str, args, deadline: float,
-                  affinity_key: str | None):
+                  affinity_key: str | None, count_tokens=None):
         last_err: Exception | None = None
         reroutes = 0
         tried: set[str] = set()
@@ -271,10 +303,17 @@ class InferenceGateway:
                 continue
             ms = (time.perf_counter() - t0) * 1000.0
             self.pool.done(r, ms, ok=True)
+            # Real generated-token count (not B × max_new with the
+            # pad tail charged as throughput): Generate supplies a
+            # stop-token-aware counter; generic calls keep the shape
+            # heuristic so tokens_per_sec never lies upward.
             tokens = 0
             try:
-                tokens = int(result.shape[0]) * int(result.shape[1])
-            except (AttributeError, IndexError, TypeError):
+                if count_tokens is not None:
+                    tokens = int(count_tokens(result))
+                else:
+                    tokens = int(result.shape[0]) * int(result.shape[1])
+            except (AttributeError, IndexError, TypeError, ValueError):
                 pass
             self.slo.answered(ms, tokens)
             chaos.note_ok("gateway.call", r.key)
@@ -351,12 +390,14 @@ class GatewayActor:
                  temperature: float = 0.0, seed: int = 0,
                  top_k: int = 0, top_p: float = 1.0,
                  stop_token: int = -1, pad_token: int = 0,
-                 repetition_penalty: float = 1.0):
+                 repetition_penalty: float = 1.0,
+                 affinity_key: str = ""):
         return self._gw.generate(
             prompt, max_new_tokens, temperature=float(temperature),
             seed=int(seed), top_k=int(top_k), top_p=float(top_p),
             stop_token=int(stop_token), pad_token=int(pad_token),
-            repetition_penalty=float(repetition_penalty))
+            repetition_penalty=float(repetition_penalty),
+            affinity_key=str(affinity_key) or None)
 
     def Info(self) -> dict:
         return self._gw.stats()

@@ -15,7 +15,13 @@ threads keep the map honest:
   (serve.py exports them). ``eviction_threshold`` consecutive probe
   failures evict the replica (connection closed, no traffic routed);
   every later round re-dials, so a recovered replica is revived
-  without operator action.
+  without operator action. Probes also drain the paged engine's
+  recent per-request TTFT samples (``ttft_recent`` in ``Info()``,
+  sequence-tagged) into the ``on_ttft`` callback — the gateway's SLO
+  tracker folds them, so its fleet-level TTFT percentiles are fed
+  from real per-request samples rather than a replica percentile of
+  percentiles; the high-water mark per replica keeps overlapping
+  probe windows from double-counting.
 
 Routing (:meth:`pick`) replaces the RPC plane's blind round-robin:
 
@@ -61,6 +67,9 @@ class Replica:
         #: signal back to "fast" between requests.
         self.probe_ms = 0.0
         self.reported: dict = {}   # last Info() payload
+        #: High-water ``ttft_recent`` sequence already drained — the
+        #: replica's ledger tags samples so probes never double-count.
+        self.ttft_seen = 0
         self.fails = 0             # consecutive probe failures
         self.up = False
         self.dialing = False       # one (re)dial in flight at a time
@@ -136,7 +145,7 @@ class ReplicaPool:
                  ewma_alpha: float = 0.3,
                  dial_timeout: float = 2.0,
                  affinity_slack: float = 3.0,
-                 on_change=None):
+                 on_change=None, on_ttft=None):
         self.service = service
         self.info_method = info_method
         self.probe_interval = probe_interval
@@ -146,6 +155,9 @@ class ReplicaPool:
         self.dial_timeout = dial_timeout
         self.affinity_slack = float(affinity_slack)
         self._on_change = on_change or (lambda: None)
+        #: ``on_ttft(ttft_ms)`` per NEW replica-reported per-request
+        #: TTFT sample (the gateway wires SLOTracker.record_ttft).
+        self._on_ttft = on_ttft
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}
         self._closed = threading.Event()
@@ -255,16 +267,49 @@ class ReplicaPool:
             return
         ms = (time.perf_counter() - t0) * 1000.0
         was_down = not r.up
+        fresh: list[float] = []
         with r.lock:
             r.reported = dict(info) if isinstance(info, dict) else {}
             r.fails = 0
             r.up = True
+            if self._on_ttft is not None:
+                fresh = self._drain_ttft_locked(r)
         r.observe_probe_ms(ms, self.ewma_alpha)
+        for sample_ms in fresh:
+            try:
+                self._on_ttft(sample_ms)
+            except Exception:  # noqa: BLE001 — observer must not
+                pass           # poison the probe loop
         if was_down:
             chaos.note_ok("gateway.probe", r.key)
             log.info("replica healthy", kv={"replica": r.key,
                                             "probe_ms": round(ms, 1)})
             self._on_change()
+
+    def _drain_ttft_locked(self, r: Replica) -> list[float]:
+        """NEW (seq > high-water) per-request TTFT samples from the
+        replica's just-stored ``Info()``; caller holds ``r.lock``."""
+        raw = r.reported.get("ttft_recent")
+        if not isinstance(raw, (list, tuple)):
+            return []
+        pairs: list[tuple[int, float]] = []
+        for item in raw:
+            try:
+                pairs.append((int(item[0]), float(item[1])))
+            except Exception:  # noqa: BLE001 — any malformed item
+                continue       # (wrong shape/type) is just skipped
+        if pairs and max(s for s, _ in pairs) < r.ttft_seen:
+            # Every reported seq is BELOW the high-water mark: the
+            # replica restarted with a fresh ledger (same registry
+            # key, seq counter back at 1). Reset, or its post-restart
+            # samples would be dropped until the new seq caught up.
+            r.ttft_seen = 0
+        fresh: list[float] = []
+        for seq, sample_ms in pairs:
+            if seq > r.ttft_seen:
+                r.ttft_seen = seq
+                fresh.append(sample_ms)
+        return fresh
 
     def _probe_failed(self, r: Replica, why: str) -> None:
         with r.lock:

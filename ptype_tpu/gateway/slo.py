@@ -17,6 +17,10 @@ Metric names (under the process-global registry by default):
 ``gateway.<svc>.shed``                  typed sheds, all causes (counter)
 ``gateway.<svc>.errors``                non-shed failures (counter)
 ``gateway.<svc>.latency_ms``            answered-request latency (histogram)
+``gateway.<svc>.ttft_ms``               replica-reported per-request TTFT
+                                        (histogram; fed by the pool's
+                                        probes from the serving ledger's
+                                        ``ttft_recent`` samples)
 ``gateway.<svc>.queue_depth``           admission queue depth (gauge)
 ``gateway.<svc>.healthy_replicas``      routable fleet size (gauge)
 ``gateway.<svc>.scale_hint``            last computed hint delta (gauge)
@@ -52,10 +56,12 @@ class SLOTracker:
     def __init__(self, service: str,
                  registry: metrics_mod.MetricsRegistry | None = None,
                  window_s: float = 30.0,
-                 slo_p99_ms: float | None = None):
+                 slo_p99_ms: float | None = None,
+                 slo_ttft_p99_ms: float | None = None):
         self.service = service
         self.window_s = float(window_s)
         self.slo_p99_ms = slo_p99_ms
+        self.slo_ttft_p99_ms = slo_ttft_p99_ms
         reg = registry if registry is not None else metrics_mod.metrics
         self._reg = reg
         p = f"gateway.{service}"
@@ -64,6 +70,7 @@ class SLOTracker:
         self.c_shed = reg.counter(f"{p}.shed")
         self.c_errors = reg.counter(f"{p}.errors")
         self.h_latency = reg.histogram(f"{p}.latency_ms")
+        self.h_ttft = reg.histogram(f"{p}.ttft_ms")
         self.g_queue = reg.gauge(f"{p}.queue_depth")
         self.g_replicas = reg.gauge(f"{p}.healthy_replicas")
         self.g_hint = reg.gauge(f"{p}.scale_hint")
@@ -95,6 +102,14 @@ class SLOTracker:
         with self._lock:
             self._sheds.append(now)
             self._trim(now)
+
+    def record_ttft(self, ttft_ms: float) -> None:
+        """Fold one replica-reported per-request TTFT sample. Fed by
+        the replica pool's probe loop, which drains NEW
+        (sequence-tagged) samples from each replica's serving-ledger
+        ``ttft_recent`` — real per-request samples, never a
+        percentile-of-percentile."""
+        self.h_ttft.observe(float(ttft_ms))
 
     def errored(self) -> None:
         self.c_errors.add(1)
@@ -145,7 +160,9 @@ class SLOTracker:
     def percentiles(self) -> dict:
         return {"p50_ms": self.h_latency.percentile(50),
                 "p95_ms": self.h_latency.percentile(95),
-                "p99_ms": self.h_latency.percentile(99)}
+                "p99_ms": self.h_latency.percentile(99),
+                "ttft_p50_ms": self.h_ttft.percentile(50),
+                "ttft_p99_ms": self.h_ttft.percentile(99)}
 
     # --------------------------------------------------------- scale hint
 
@@ -155,10 +172,13 @@ class SLOTracker:
         """Distill the window into one fleet-size delta.
 
         Priority order: shedding (capacity is actively short) beats a
-        deep queue (capacity is about to be short) beats a p99 SLO
-        breach (capacity is marginal) beats idle shrink. Hold
-        otherwise. The hint is advisory — the elastic layer owns
-        actuation and rate-limiting.
+        deep queue (capacity is about to be short) beats a TTFT SLO
+        breach (prompt-heavy overload — queue + prefill wait blows the
+        first token long before the e2e tail moves, which is exactly
+        why a controller acting on e2e p99 alone scales too late)
+        beats a p99 SLO breach (capacity is marginal) beats idle
+        shrink. Hold otherwise. The hint is advisory — the elastic
+        layer owns actuation and rate-limiting.
         """
         signals = {"queue_depth": queue_depth,
                    "shed_rate": round(self.shed_rate(), 4),
@@ -178,6 +198,12 @@ class SLOTracker:
         elif max_depth and queue_depth >= max_depth // 2:
             delta = max(1, queue_depth // per_replica)
             reason = "admission queue above half depth"
+        elif (self.slo_ttft_p99_ms is not None
+              and self.h_ttft.count >= 20
+              and signals["ttft_p99_ms"] > self.slo_ttft_p99_ms):
+            delta = 1
+            reason = (f"ttft p99 {signals['ttft_p99_ms']:.0f}ms over "
+                      f"SLO {self.slo_ttft_p99_ms:.0f}ms")
         elif (self.slo_p99_ms is not None and self.h_latency.count >= 20
               and signals["p99_ms"] > self.slo_p99_ms):
             delta = 1

@@ -22,22 +22,31 @@ from ptype_tpu.health.profiling import (AlertCapture, ProfileError,
                                         measure_compiled_cost,
                                         summarize)
 from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
-                                    ClusterView, CoordFlapRule, LossRule,
+                                    ClusterView, CoordFlapRule,
+                                    KvPressureRule, LossRule,
                                     MemoryGrowthRule, MfuGapRule,
-                                    P99Rule, Rule, StallRule,
-                                    StragglerRule, default_rules)
+                                    P99Rule, PrefixHitCollapseRule,
+                                    Rule, ServeStallRule, StallRule,
+                                    StragglerRule, TtftRule,
+                                    default_rules)
 from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
                                      telemetry_endpoint)
-from ptype_tpu.health.top import render_top, run_top
+from ptype_tpu.health.serving import (RequestRecord, ServingLedger,
+                                      measure_seam_cost_us)
+from ptype_tpu.health.top import (render_serve, render_top, run_serve,
+                                  run_top)
 
 __all__ = [
     "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
     "GoodputLedger", "detect_stragglers", "node_series_means",
     "node_span_means",
+    "ServingLedger", "RequestRecord", "measure_seam_cost_us",
     "AlertCapture", "ProfileError", "compiled_cost",
     "measure_compiled_cost", "summarize",
     "Alert", "AlertEngine", "ClusterView", "Rule", "BurnRateRule",
     "P99Rule", "StallRule", "StragglerRule", "LossRule",
-    "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "default_rules",
-    "render_top", "run_top",
+    "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "TtftRule",
+    "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
+    "default_rules",
+    "render_top", "run_top", "render_serve", "run_serve",
 ]

@@ -334,8 +334,13 @@ def write_artifacts(out_dir: str, result: dict) -> list[str]:
 
 #: Alerts whose firing auto-captures a profile on the named node:
 #: the rules whose runbook first question is "what is that node's
-#: device timeline doing" (docs/OPERATIONS.md).
-PROFILE_ALERT_RULES = ("straggler", "train-stall", "slo-p99")
+#: device timeline doing" (docs/OPERATIONS.md). The serving rules
+#: (ISSUE 10) ride the same hook — a TTFT blowup or a thrashing KV
+#: pool is diagnosed from the afflicted REPLICA's engine timeline
+#: (prefill chunks vs decode steps vs admission waits), and the
+#: replica is exactly what the alert names.
+PROFILE_ALERT_RULES = ("straggler", "train-stall", "slo-p99",
+                       "ttft-p99", "kv-pressure", "serve-stall")
 
 
 class AlertCapture:

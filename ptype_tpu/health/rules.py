@@ -30,6 +30,19 @@ rule                  fires when
 ``mfu-divergence``    compiled-cost MFU (``goodput.mfu_compiled``, from
                       XLA cost_analysis — health/profiling.py) disagrees
                       with the analytic MFU by more than ``gap_frac``
+``ttft-p99``          a serving replica's time-to-first-token p99
+                      (``serve.ttft_ms.p99``, the serving ledger's
+                      histogram) exceeds the SLO target
+``kv-pressure``       a replica's paged-KV admission headroom is pinned
+                      low while the pool actively evicts (the
+                      eviction-rate floor keeps a small-but-idle pool
+                      from paging) — names the replica
+``prefix-hit-collapse``  a replica's prefix-cache hit rate collapsed
+                      from a healthy level (affinity routing broke, or
+                      eviction pressure is churning the shared prefix)
+``serve-stall``       a serving replica's engine iterations stopped
+                      while its admission queue is non-empty — the
+                      per-replica wedged-engine page
 ====================  ====================================================
 
 Every rule takes the evaluation time from the :class:`ClusterView`
@@ -480,9 +493,200 @@ class MfuGapRule(Rule):
         return out
 
 
+class TtftRule(Rule):
+    """Serving TTFT tail: a replica's ``serve.ttft_ms.p99`` series
+    (the serving ledger's histogram, sampler-stamped) exceeds the SLO
+    target. This is the prompt-heavy overload signal an e2e-p99 rule
+    misses — queue + reservation + prefill wait all land in TTFT long
+    before the decode tail moves — and it NAMES the replica, which is
+    what lets the profile-capture hook grab that node's timeline."""
+
+    name = "ttft-p99"
+    severity = "page"
+
+    def __init__(self, slo_ttft_ms: float = 2000.0,
+                 min_count: float = 8.0,
+                 metric: str = "serve.ttft_ms"):
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.min_count = float(min_count)
+        self.metric = metric
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            count = view.last(node, f"{self.metric}.count")
+            if count is None or count[1] < self.min_count:
+                continue  # tail of a handful of requests is noise
+            last = view.last(node, f"{self.metric}.p99")
+            if last is not None and last[1] > self.slo_ttft_ms:
+                out.append(self._alert(
+                    node,
+                    f"serving TTFT p99 {last[1]:.0f}ms over SLO "
+                    f"{self.slo_ttft_ms:.0f}ms "
+                    f"({count[1]:.0f} requests)",
+                    value=last[1], threshold=self.slo_ttft_ms))
+        return out
+
+
+class KvPressureRule(Rule):
+    """Paged-KV pool pressure: a replica's admission headroom
+    (``kv.free_blocks`` / ``kv.total_blocks``) sat below ``free_frac``
+    for most of the window WHILE the pool was actively evicting
+    (``kv.evictions.rate`` above ``evict_rate_floor``). Both gates
+    matter: low headroom alone is a well-sized busy pool; evictions
+    alone are a healthy LRU turning over — together they are the
+    thrash signature (admission waits at the head, prefix blocks churn
+    out before they can be reused) that precedes admit-timeout sheds.
+    Majority-of-window, not last-point: the free-blocks gauge swings
+    at every retire, and one momentary recovery must not mask (nor one
+    momentary dip fake) sustained pressure."""
+
+    name = "kv-pressure"
+    severity = "page"
+
+    def __init__(self, free_frac: float = 0.15,
+                 evict_rate_floor: float = 0.2,
+                 window_s: float = 120.0, min_points: int = 3):
+        self.free_frac = float(free_frac)
+        self.evict_rate_floor = float(evict_rate_floor)
+        self.window_s = float(window_s)
+        self.min_points = int(min_points)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            total = view.last(node, "kv.total_blocks")
+            if total is None or total[1] <= 0:
+                continue
+            pts = [p for p in view.series(node, "kv.free_blocks")
+                   if p[0] >= view.now - self.window_s]
+            if len(pts) < self.min_points:
+                continue
+            low = [v for _, v in pts
+                   if v / total[1] <= self.free_frac]
+            if len(low) * 2 < len(pts):
+                continue
+            rate = max((v for t, v in
+                        view.series(node, "kv.evictions.rate")
+                        if t >= view.now - self.window_s),
+                       default=0.0)
+            if rate <= self.evict_rate_floor:
+                continue
+            frac = min(low) / total[1]
+            out.append(self._alert(
+                node,
+                f"kv pool pressure: free blocks down to "
+                f"{min(low):.0f}/{total[1]:.0f} "
+                f"({100 * frac:.0f}%) with evictions at "
+                f"{rate:.1f}/s — admission is about to shed",
+                value=frac, threshold=self.free_frac,
+                evictions_per_s=round(rate, 2)))
+        return out
+
+
+class PrefixHitCollapseRule(Rule):
+    """Prefix-cache effectiveness collapse: a replica whose
+    ``kv.prefix_hit_rate`` was healthy earlier in the window reads
+    collapsed now — the signature of affinity routing breaking (fleet
+    churn re-hashed the keys) or eviction pressure churning the shared
+    prefix out between requests. Hit rate only moves with traffic
+    (change-driven sampling), so a quiet replica never fires."""
+
+    name = "prefix-hit-collapse"
+    severity = "warn"
+
+    def __init__(self, healthy_frac: float = 0.3,
+                 collapsed_frac: float = 0.1,
+                 window_s: float = 600.0, min_points: int = 4):
+        self.healthy_frac = float(healthy_frac)
+        self.collapsed_frac = float(collapsed_frac)
+        self.window_s = float(window_s)
+        self.min_points = int(min_points)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = [p for p in
+                   view.series(node, "kv.prefix_hit_rate")
+                   if p[0] >= view.now - self.window_s]
+            if len(pts) < self.min_points:
+                continue
+            peak = max(v for _, v in pts[:-1])
+            last = pts[-1][1]
+            if peak >= self.healthy_frac \
+                    and last <= self.collapsed_frac:
+                out.append(self._alert(
+                    node,
+                    f"prefix hit rate collapsed "
+                    f"{peak:.2f} → {last:.2f} — check affinity "
+                    f"routing and pool eviction pressure",
+                    value=last, threshold=self.collapsed_frac,
+                    peak=round(peak, 4)))
+        return out
+
+
+class ServeStallRule(Rule):
+    """Per-replica serving stall: the engine's iteration counter
+    (``serve.steps``) stopped advancing while the admission queue
+    (``serve.queue_depth``) is non-empty — a wedged engine thread, a
+    hung device call, or an admission deadlock. The queue gate keeps
+    an idle replica (no traffic, no steps — healthy) from paging; the
+    threshold scales with the replica's own median iteration time with
+    an absolute floor, like the training ``train-stall`` rule."""
+
+    name = "serve-stall"
+    severity = "page"
+
+    def __init__(self, factor: float = 8.0, min_gap_s: float = 5.0,
+                 min_steps: int = 3,
+                 steps_series: str = "serve.steps",
+                 step_ms_series: str = "serve.step_ms",
+                 queue_series: str = "serve.queue_depth"):
+        self.factor = float(factor)
+        self.min_gap_s = float(min_gap_s)
+        self.min_steps = int(min_steps)
+        self.steps_series = steps_series
+        self.step_ms_series = step_ms_series
+        self.queue_series = queue_series
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = view.series(node, self.steps_series)
+            if not pts or pts[-1][1] < self.min_steps:
+                continue
+            queued = view.last(node, self.queue_series)
+            if queued is None or queued[1] <= 0:
+                continue  # nothing waiting: an idle engine is healthy
+            step_vals = [v for _, v in
+                         view.series(node, self.step_ms_series)]
+            med_s = (statistics.median(step_vals) / 1e3
+                     if step_vals else 0.0)
+            threshold = max(self.factor * med_s, self.min_gap_s)
+            gap = view.now - pts[-1][0]
+            if gap > threshold:
+                out.append(self._alert(
+                    node,
+                    f"engine made no iteration for {gap:.1f}s with "
+                    f"{queued[1]:.0f} queued (median iteration "
+                    f"{med_s * 1e3:.0f}ms, threshold "
+                    f"{threshold:.1f}s)",
+                    value=gap, threshold=threshold))
+        return out
+
+
 def default_rules(service: str = "llm",
-                  slo_p99_ms: float | None = None) -> list[Rule]:
-    """The stock watchdog set; ``slo_p99_ms`` adds the latency rule."""
+                  slo_p99_ms: float | None = None,
+                  slo_ttft_ms: float | None = None) -> list[Rule]:
+    """The stock watchdog set; ``slo_p99_ms`` adds the latency rule
+    and ``slo_ttft_ms`` the serving TTFT rule — both are SLO targets
+    nobody but the operator can pick, so like ``P99Rule`` the TTFT
+    page is opt-in (a healthy prompt-heavy fleet over an arbitrary
+    default would page, and auto-capture profiles, out of the box).
+    The structural serving rules (kv-pressure / prefix-hit-collapse /
+    serve-stall) are always in the set — they key on ``serve.*`` /
+    ``kv.*`` series only a serving replica emits and need no target,
+    so a training fleet never pays a false page for their presence."""
     rules: list[Rule] = [
         BurnRateRule(service=service),
         StallRule(),
@@ -491,7 +695,12 @@ def default_rules(service: str = "llm",
         CoordFlapRule(),
         MemoryGrowthRule(),
         MfuGapRule(),
+        KvPressureRule(),
+        PrefixHitCollapseRule(),
+        ServeStallRule(),
     ]
+    if slo_ttft_ms is not None:
+        rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))
     if slo_p99_ms is not None:
         rules.insert(1, P99Rule(service=service, slo_p99_ms=slo_p99_ms))
     return rules

@@ -4,8 +4,12 @@
 history into the operator one-pager (per-node goodput, step
 breakdown, throughput, memory, and the active alert list);
 :func:`run_top` is the refresh loop behind ``python -m ptype_tpu obs
-top`` — snapshot, evaluate the rules, repaint. Pure string rendering
-here; the CLI owns stdout (PT004: framework code never prints).
+top`` — snapshot, evaluate the rules, repaint.
+:func:`render_serve` / :func:`run_serve` are the serving-plane
+siblings behind ``obs serve`` (ISSUE 10): per-replica TTFT/TPOT/e2e
+tails, queue/batch occupancy, and KV-pool pressure from the serving
+ledger's metrics. Pure string rendering here; the CLI owns stdout
+(PT004: framework code never prints).
 """
 
 from __future__ import annotations
@@ -83,17 +87,103 @@ def render_top(snapshot: dict, alerts=(), max_nodes: int = 32) -> str:
     return "\n".join(lines)
 
 
+def _hist(telem: dict, name: str) -> dict:
+    return telem.get("metrics", {}).get("histograms", {}).get(name) \
+        or {}
+
+
+def render_serve(snapshot: dict, alerts=(),
+                 max_nodes: int = 32) -> str:
+    """``obs serve``: the serving-plane one-pager — per-replica
+    TTFT/TPOT/e2e tails from the serving ledger's histograms, queue
+    and batch occupancy, KV-pool pressure (free blocks, utilization,
+    prefix hit rate, evictions), and the co-batched prefill stall.
+    Replicas are rows; nodes with no serving metrics (trainers, the
+    coordinator) are skipped — this is the serving view, ``obs top``
+    is the fleet view."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+    serving = {k: t for k, t in nodes.items()
+               if _hist(t, "serve.ttft_ms")
+               or _gauge(t, "serve.step_ms") is not None}
+    lines = [
+        f"ptype serving @ {snapshot.get('ts')} — "
+        f"{len(serving)} serving replicas "
+        f"({len(nodes)} nodes, {len(errors)} unreachable)",
+        f"{'replica':<28} {'ttft99':>8} {'tpot':>7} {'e2e99':>8} "
+        f"{'q':>4} {'live':>5} {'kvfree':>7} {'util%':>6} "
+        f"{'hit%':>6} {'evic':>6} {'stall':>7}",
+    ]
+
+    def num(v, fmt="{:.1f}", dash="-"):
+        return fmt.format(v) if v is not None else dash
+
+    for key in sorted(serving)[:max_nodes]:
+        t = serving[key]
+        ttft = _hist(t, "serve.ttft_ms").get("p99")
+        tpot = _hist(t, "serve.tpot_ms").get("p50")
+        e2e = _hist(t, "serve.e2e_ms").get("p99")
+        q = _gauge(t, "serve.queue_depth")
+        live = _gauge(t, "serve.active_slots")
+        free = _gauge(t, "kv.free_blocks")
+        util = _gauge(t, "kv.util_pct")
+        hit = _gauge(t, "kv.prefix_hit_rate")
+        evic = (t.get("metrics", {}).get("counters", {})
+                .get("kv.evictions"))
+        stall = _gauge(t, "serve.stall_ms")
+        lines.append(
+            f"{key[:28]:<28} {num(ttft, '{:.0f}'):>7}m "
+            f"{num(tpot):>6}m {num(e2e, '{:.0f}'):>7}m "
+            f"{num(q, '{:.0f}'):>4} {num(live, '{:.0f}'):>5} "
+            f"{num(free, '{:.0f}'):>7} {num(util):>6} "
+            f"{num(hit * 100 if hit is not None else None):>6} "
+            f"{num(evic, '{:.0f}'):>6} {num(stall):>6}m")
+    if not serving:
+        lines.append("  (no serving replicas report serve.* metrics)")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
+def run_serve(registry, iters: int = 0, interval_s: float = 2.0,
+              engine: AlertEngine | None = None,
+              services: list[str] | None = None,
+              include_local: bool = False, out=None,
+              clear: bool = True) -> AlertEngine:
+    """The ``obs serve`` loop: :func:`run_top`'s poll contract with
+    the serving-plane rendering (the serving rules fire off the same
+    snapshot either way)."""
+    return run_top(registry, iters=iters, interval_s=interval_s,
+                   engine=engine, services=services,
+                   include_local=include_local, out=out, clear=clear,
+                   render=render_serve)
+
+
 def run_top(registry, iters: int = 0, interval_s: float = 2.0,
             engine: AlertEngine | None = None,
             services: list[str] | None = None,
             include_local: bool = False, out=None,
-            clear: bool = True) -> AlertEngine:
+            clear: bool = True, render=None) -> AlertEngine:
     """The ``obs top`` loop: pull, evaluate, repaint. ``iters=0``
     runs until KeyboardInterrupt (the caller catches it); tests pass
-    ``iters=1`` and a capture ``out``. Returns the engine so callers
-    can inspect the alert history."""
+    ``iters=1`` and a capture ``out``. ``render`` swaps the view
+    (:func:`render_serve` for ``obs serve``) without forking the
+    loop. Returns the engine so callers can inspect the alert
+    history."""
     from ptype_tpu import telemetry as telemetry_mod
 
+    render = render if render is not None else render_top
     write = out if out is not None else sys.stdout.write
     engine = engine if engine is not None else AlertEngine()
     tick = threading.Event()
@@ -103,7 +193,7 @@ def run_top(registry, iters: int = 0, interval_s: float = 2.0,
             registry, services=services, include_local=include_local)
         engine.evaluate(snap)
         prefix = CLEAR if clear else ""
-        write(prefix + render_top(snap, engine.recent()) + "\n")
+        write(prefix + render(snap, engine.recent()) + "\n")
         n += 1
         if iters and n >= iters:
             return engine

@@ -35,20 +35,29 @@ arrival the pool or queue can't hold sheds with a typed
 :class:`~ptype_tpu.errors.ShedError` (+ backlog-proportional
 ``retry_after_s``) instead of wedging the engine; the ``serve.admit``
 chaos seam forces sheds/delays and pairs with success-path beacons.
+
+Observability (ISSUE 10): every latency stamp in this engine rides a
+seam on its :class:`~ptype_tpu.health.serving.ServingLedger` (lint
+PT010 bars raw timers in ``serve_engine/``) — per-request lifecycle
+records with TTFT/TPOT/e2e histograms, per-iteration batch
+composition, ``kv.*`` pressure series, and a synthesized
+``serve.admit`` / ``serve.prefill.chunk[i]`` / ``serve.decode`` span
+tree under the caller's traceparent so one stitched Perfetto trace
+answers "where did this request's latency go" across processes.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ptype_tpu import chaos, logs
+from ptype_tpu import chaos, logs, trace
 from ptype_tpu import metrics as metrics_mod
 from ptype_tpu.errors import ShedError
+from ptype_tpu.health.serving import ServingLedger
 from ptype_tpu.models import generate as gen
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.serve import GeneratorActor, _norm_prompt, _pow2
@@ -64,7 +73,7 @@ class _PagedRow:
     __slots__ = ("prompt", "max_new", "stop_token", "temperature",
                  "top_k", "top_p", "key", "emitted", "done", "err",
                  "table", "hashes", "reused", "prefill_pos",
-                 "reserve_left", "t_enqueue", "t_head", "cancelled")
+                 "reserve_left", "rec", "cancelled")
 
     def __init__(self, prompt, max_new, stop_token, temperature,
                  top_k, top_p, key):
@@ -83,8 +92,10 @@ class _PagedRow:
         self.reused = 0
         self.prefill_pos = -1         # -1: reuse walk not yet run
         self.reserve_left = 0
-        self.t_enqueue = time.perf_counter()
-        self.t_head = None            # first reserve refusal at head
+        #: Lifecycle record (health/serving.RequestRecord) — every
+        #: stamp the engine needs comes through its ledger seams
+        #: (lint PT010: no raw timers in serve_engine/).
+        self.rec = None
         self.cancelled = False
 
 
@@ -113,8 +124,21 @@ class PagedGeneratorActor(GeneratorActor):
                  n_blocks: int | None = None,
                  prefill_chunk: int | None = 64,
                  max_queue: int = 64, admit_timeout_s: float = 10.0,
-                 attn: str = "gather"):
+                 attn: str = "gather",
+                 metrics_registry: metrics_mod.MetricsRegistry | None
+                 = None):
         super().__init__(cfg, params, rng)
+        #: Registry the engine's gauges/histograms land in (default:
+        #: the process-global one; drills and simulated multi-replica
+        #: fleets pass a per-node registry so each replica's series
+        #: stay distinct in the cluster snapshot).
+        self._reg = (metrics_registry if metrics_registry is not None
+                     else metrics_mod.metrics)
+        #: The serving observability ledger (ISSUE 10): request
+        #: lifecycle records, TTFT/TPOT/e2e histograms, engine-
+        #: iteration composition, KV-pressure series — every latency
+        #: stamp in this engine rides its seams.
+        self.ledger = ServingLedger(registry=self._reg)
         self.n_slots = int(n_slots)
         bt = int(block_tokens)
         reach = min(int(max_len) if max_len else cfg.max_seq,
@@ -168,8 +192,6 @@ class PagedGeneratorActor(GeneratorActor):
         self._prefill_tokens = 0
         self._max_stall_ms = 0.0
         self._last_stall_ms = 0.0
-        #: EWMA of per-request service seconds — the retry_after hint.
-        self._svc_ewma_s = 0.0
 
         def engine_step(sampled, params, kb, vb, tok, pos, tables,
                         active, keys, eidx, temps, topk, topp):
@@ -258,6 +280,7 @@ class PagedGeneratorActor(GeneratorActor):
             if f.action == "delay":
                 f.sleep()
             elif f.action == "shed":
+                self.ledger.shed_untracked()
                 raise ShedError("chaos: serve.admit shed",
                                 retry_after_s=self._retry_after())
         key = (np.asarray(jax.random.PRNGKey(int(seed)))
@@ -267,6 +290,13 @@ class PagedGeneratorActor(GeneratorActor):
                           int(stop_token), float(temperature),
                           int(top_k), float(top_p), key)
                 for i in range(prompt.shape[0])]
+        # One traceparent per call: the actor handler span (when the
+        # request arrived over a traced RPC) — the synthesized
+        # admit/prefill/decode span tree parents under it, which is
+        # what stitches gateway.request → ... → serve.decode.
+        tp = trace.traceparent()
+        for r in rows:
+            r.rec = self.ledger.enqueued(len(r.prompt), max_new, tp=tp)
         self._enter_request()
         try:
             with self._lock:
@@ -276,12 +306,20 @@ class PagedGeneratorActor(GeneratorActor):
                     raise RuntimeError("generator actor is closed")
                 if (self.max_queue
                         and len(self._queue) + len(rows) > self.max_queue):
+                    for r in rows:
+                        self.ledger.retired(r.rec, "shed")
                     raise ShedError(
                         f"serving backlog full "
                         f"({len(self._queue)} queued, cap "
                         f"{self.max_queue})",
                         retry_after_s=self._retry_after())
                 self._queue.extend(rows)
+                # Exported from the CALLER thread on purpose: the
+                # serve-stall rule gates on a non-empty queue, and a
+                # wedged engine thread (its primary target) would
+                # never export the depth that pages it.
+                self._reg.gauge("serve.queue_depth").set(
+                    len(self._queue))
                 self._cond.notify()
             chaos.note_ok("serve.admit")
             out = np.full((len(rows), max_new), int(pad_token),
@@ -316,6 +354,7 @@ class PagedGeneratorActor(GeneratorActor):
                 for q in self._queue:
                     if id(q) in live:
                         q.err = RuntimeError("request cancelled")
+                        self.ledger.retired(q.rec, "cancelled")
                         q.done.set()
                     else:
                         kept.append(q)
@@ -324,7 +363,7 @@ class PagedGeneratorActor(GeneratorActor):
     def _retry_after(self) -> float:
         with self._cond:
             backlog = len(self._queue) + len(self._slot_state) + 1
-        per = self._svc_ewma_s or 0.1
+        per = self.ledger.svc_ewma_s() or 0.1
         return round(max(0.05, backlog * per), 3)
 
     # ------------------------------------------------------------ engine
@@ -349,6 +388,7 @@ class PagedGeneratorActor(GeneratorActor):
         for r in stragglers:
             if not r.done.is_set():
                 r.err = err or RuntimeError("generator actor closed")
+                self.ledger.retired(r.rec, "error")
                 r.done.set()
 
     def _engine_loop(self) -> None:
@@ -366,7 +406,7 @@ class PagedGeneratorActor(GeneratorActor):
             # the headroom the queue head is waiting on.
             for slot in list(self._slot_state):
                 if self._active[slot] and self._slot_state[slot].cancelled:
-                    self._retire(slot)
+                    self._retire(slot, "cancelled")
             # Admission round, bounded by the TOKEN budget: several
             # short prompts (or one chunk of a long one) may prefill,
             # but never more than prefill_chunk prompt tokens — that
@@ -378,14 +418,27 @@ class PagedGeneratorActor(GeneratorActor):
             if self._active.any():
                 pending_stall += self._admission_round()
             else:
-                self._admission_round()
+                # Prefill-only iteration (no decode co-batched): still
+                # an engine iteration — metered, so `serve.steps`
+                # advances (a burst of max_new=1 requests completing
+                # entirely inside prefill must not read as a stalled
+                # engine with a non-empty queue) and this round's
+                # chunk accounting lands on its own record instead of
+                # being charged to the next unrelated decode step.
+                with self.ledger.iteration(active=0, stall_ms=0.0):
+                    self._admission_round()
                 pending_stall = 0.0
             if not self._active.any():
                 continue
-            self._record_stall(pending_stall * 1e3)
-            pending_stall = 0.0
+            stall_ms, pending_stall = pending_stall * 1e3, 0.0
+            self._record_stall(stall_ms)
             with metrics_mod.annotate("serve.step"):
-                self._step()
+                # The iteration meter is the batch-composition seam:
+                # step wall, active slots, this round's prefill split,
+                # and the co-batched stall — one record per iteration.
+                with self.ledger.iteration(int(self._active.sum()),
+                                           stall_ms):
+                    self._step()
 
     def _admission_round(self) -> float:
         """Prefill up to ``prefill_chunk`` prompt tokens; returns the
@@ -399,14 +452,14 @@ class PagedGeneratorActor(GeneratorActor):
             if row is not None and row.cancelled:
                 # Withdrawn mid-prefill: drop its blocks + reservation.
                 self._admitting = None
-                self._finish_row(row)
+                self._finish_row(row, "cancelled")
                 continue
             if self._admitting is None:
                 break
-            t0 = time.perf_counter()
             with metrics_mod.annotate("serve.prefill"):
-                budget -= self._prefill_one_chunk(budget)
-            spent += time.perf_counter() - t0
+                n, dur_s = self._prefill_one_chunk(budget)
+            budget -= n
+            spent += dur_s
         return spent
 
     def _maybe_start_admission(self) -> None:
@@ -427,21 +480,21 @@ class PagedGeneratorActor(GeneratorActor):
             # sheds) the pool is EXHAUSTED for this request and it
             # sheds typed — the frontdoor re-routes on that, a burned
             # gateway deadline reads as replica failure.
-            now = time.perf_counter()
-            if row.t_head is None:
-                row.t_head = now
+            head_wait = self.ledger.head_refused(row.rec)
             if (self.admit_timeout_s > 0
-                    and now - row.t_head > self.admit_timeout_s):
+                    and head_wait > self.admit_timeout_s):
                 self._queue.pop(0)
                 row.err = ShedError(
                     f"kv pool exhausted: need {need} blocks, "
                     f"free {self.pool.free_blocks()} after "
                     f"{self.admit_timeout_s:g}s at queue head",
                     retry_after_s=self._retry_after())
+                self.ledger.retired(row.rec, "shed")
                 row.done.set()
             return
         row.reserve_left = need
         self._queue.pop(0)
+        self.ledger.admitted(row.rec)
         self._admitting = row
 
     def _chunk_prog(self, C: int):
@@ -456,9 +509,11 @@ class PagedGeneratorActor(GeneratorActor):
             self._chunk_progs[C] = prog
         return prog
 
-    def _prefill_one_chunk(self, budget: int | None = None) -> int:
-        """Prefill one bounded chunk of the admitting row; returns the
-        prompt tokens written (the budget it consumed)."""
+    def _prefill_one_chunk(self, budget: int | None = None
+                           ) -> tuple[int, float]:
+        """Prefill one bounded chunk of the admitting row; returns
+        (prompt tokens written — the budget consumed, chunk seconds —
+        the stall charge)."""
         row = self._admitting
         toks = row.prompt
         L = len(toks)
@@ -482,6 +537,7 @@ class PagedGeneratorActor(GeneratorActor):
             self._prefix_hits += row.reused
             self._prefix_misses += len(row.hashes) - row.reused
             row.prefill_pos = row.reused * bt
+            row.rec.reused_blocks = row.reused
         start = row.prefill_pos
         n = min(self.prefill_chunk, L - start)
         if budget is not None:
@@ -495,35 +551,51 @@ class PagedGeneratorActor(GeneratorActor):
         padded[0, :n] = toks[start:start + n]
         table_arr = np.zeros(self.nb, np.int32)
         table_arr[:len(row.table)] = row.table
-        logits, self.pool.k, self.pool.v = self._chunk_prog(C)(
-            self.params, self.pool.k, self.pool.v,
-            jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
-            jnp.asarray(table_arr))
-        row.prefill_pos += n
+        # The meter stays open through the FINAL chunk's first-token
+        # sampling: under async dispatch the program call returns
+        # before the device runs, and the np.asarray/sample host sync
+        # below is where that chunk's wall is actually paid — closing
+        # the meter early would under-report the stall charge (and the
+        # chunk span) by the final chunk's compute.
+        cm = self.ledger.chunk(row.rec, n)
+        with cm:
+            logits, self.pool.k, self.pool.v = self._chunk_prog(C)(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
+                jnp.asarray(table_arr))
+            row.prefill_pos += n
+            done = row.prefill_pos >= L
+            if done:
+                # Prompt fully resident: seal the freshly-computed
+                # full blocks (reused ones are already in the index)
+                # and emit the first token.
+                for i in range(row.reused, len(row.hashes)):
+                    self.pool.seal(row.table[i], row.hashes[i],
+                                   toks[i * bt:(i + 1) * bt])
+                if row.temperature == 0.0:
+                    first = int(np.asarray(logits)[0].argmax())
+                else:
+                    first = int(self._sample_first(
+                        logits, jnp.asarray(row.key),
+                        jnp.float32(row.temperature),
+                        jnp.int32(row.top_k),
+                        jnp.float32(row.top_p)))
         self._prefill_chunks += 1
         self._prefill_tokens += n
-        if row.prefill_pos < L:
-            return n
-        # Prompt fully resident: seal the freshly-computed full blocks
-        # (reused ones are already in the index), emit the first token,
-        # land in a slot (or finish outright).
-        for i in range(row.reused, len(row.hashes)):
-            self.pool.seal(row.table[i], row.hashes[i],
-                           toks[i * bt:(i + 1) * bt])
-        if row.temperature == 0.0:
-            first = int(np.asarray(logits)[0].argmax())
-        else:
-            first = int(self._sample_first(
-                logits, jnp.asarray(row.key),
-                jnp.float32(row.temperature), jnp.int32(row.top_k),
-                jnp.float32(row.top_p)))
+        if not done:
+            return n, cm.dur_s
+        # The TTFT stamp: the first token exists on the host here.
+        self.ledger.first_token(row.rec)
         row.emitted.append(first)
         self._admitting = None
         self._export_gauges()
         if (row.max_new == 1
                 or (row.stop_token >= 0 and first == row.stop_token)):
-            self._finish_row(row)
-            return n
+            self._finish_row(row,
+                             "stop" if (row.stop_token >= 0
+                                        and first == row.stop_token)
+                             else "complete")
+            return n, cm.dur_s
         slot = int(np.flatnonzero(~self._active)[0])
         self._slot_state[slot] = row
         self._tables[slot] = 0
@@ -538,7 +610,7 @@ class PagedGeneratorActor(GeneratorActor):
         self._topp[slot] = row.top_p
         self._eidx[slot] = 1
         self._dev = None  # slot state changed: re-upload next step
-        return n
+        return n, cm.dur_s
 
     def _step(self) -> None:
         # Boundary crossings first: a slot whose next write lands past
@@ -581,36 +653,39 @@ class PagedGeneratorActor(GeneratorActor):
         self._pos[self._active] += 1
         self._eidx[self._active] += 1
         self._tok = nxt_host
-        for slot in list(self._slot_state):
-            if not self._active[slot]:
-                continue
-            row = self._slot_state[slot]
+        live = [(slot, self._slot_state[slot])
+                for slot in list(self._slot_state)
+                if self._active[slot]]
+        # One shared stamp for every row that just emitted — the
+        # per-token decode-delta trail behind the TPOT histogram.
+        self.ledger.tokens_emitted([row.rec for _, row in live])
+        for slot, row in live:
             t = int(nxt_host[slot])
             row.emitted.append(t)
-            if (len(row.emitted) >= row.max_new
-                    or (row.stop_token >= 0 and t == row.stop_token)):
-                self._retire(slot)
+            if row.stop_token >= 0 and t == row.stop_token:
+                self._retire(slot, "stop")
+            elif len(row.emitted) >= row.max_new:
+                self._retire(slot, "complete")
         if self._steps % 32 == 0:
             self._export_gauges()  # sampler cadence is ~50 ms+; the
             #                        retire/admission exports keep the
             #                        block gauges fresh between these.
 
-    def _retire(self, slot: int) -> None:
+    def _retire(self, slot: int, reason: str = "complete") -> None:
         self._active[slot] = False
         self._temps[slot] = 0.0
         self._dev = None  # slot state changed: re-upload next step
-        self._finish_row(self._slot_state.pop(slot))
+        self._finish_row(self._slot_state.pop(slot), reason)
         self._export_gauges()
 
-    def _finish_row(self, row: _PagedRow) -> None:
+    def _finish_row(self, row: _PagedRow,
+                    reason: str = "complete") -> None:
         for bid in row.table:
             self.pool.deref(bid)
         if row.reserve_left > 0:
             self.pool.unreserve(row.reserve_left)
         row.reserve_left = 0
-        svc = time.perf_counter() - row.t_enqueue
-        self._svc_ewma_s = (svc if self._svc_ewma_s == 0.0
-                            else 0.3 * svc + 0.7 * self._svc_ewma_s)
+        self.ledger.retired(row.rec, reason)
         row.done.set()
 
     # -------------------------------------------------------- telemetry
@@ -621,13 +696,18 @@ class PagedGeneratorActor(GeneratorActor):
             self._max_stall_ms = stall_ms
 
     def _export_gauges(self) -> None:
-        reg = metrics_mod.metrics
+        reg = self._reg
         st = self.pool.stats()
         reg.gauge("serve.kv_free_blocks").set(st["kv_free_blocks"])
         reg.gauge("serve.kv_util_pct").set(st["kv_util_pct"])
         reg.gauge("serve.prefix_hit_rate").set(self.prefix_hit_rate())
         reg.gauge("serve.prefill_stall_ms").set(
             round(self._max_stall_ms, 3))
+        # len() read without _cond on purpose: a point-in-time gauge,
+        # and the exporters run on the engine thread mid-admission.
+        reg.gauge("serve.queue_depth").set(len(self._queue))
+        # The kv.* pressure sample the serving alert rules key on.
+        self.ledger.kv_sample(st, self.prefix_hit_rate())
 
     def prefix_hit_rate(self) -> float:
         total = self._prefix_hits + self._prefix_misses
@@ -652,6 +732,12 @@ class PagedGeneratorActor(GeneratorActor):
         info["prefill_tokens"] = self._prefill_tokens
         info["prefill_stall_ms"] = round(self._max_stall_ms, 3)
         info["prefill_stall_last_ms"] = round(self._last_stall_ms, 3)
+        # Serving-ledger surface (ISSUE 10): TTFT/TPOT/e2e tails the
+        # gateway's probes and `obs serve` read, plus the recent
+        # per-request TTFT samples the pool drains into the fleet SLO
+        # tracker (sequence-tagged so probes never double-count).
+        info.update(self.ledger.summary())
+        info["ttft_recent"] = self.ledger.ttft_recent()
         return info
 
     def close(self) -> None:

@@ -193,3 +193,37 @@ def test_observability_demo(tmp_path):
                for e in chrome["traceEvents"])
     spans = [json.loads(x) for x in open(tmp_path / "spans.jsonl")]
     assert any(s["name"] == "gateway.request" for s in spans)
+
+
+def test_serve_obs_demo(tmp_path):
+    """`make serve-obs-demo` (examples/observability/serve_demo.py):
+    a traced 2-replica paged fleet takes a shared-prefix burst through
+    the gateway; the serving ledgers feed the `obs serve` view and the
+    Perfetto export carries the request span trees — gateway.request,
+    every serve.admit / prefill chunk / serve.decode, and the
+    first-token instants."""
+    import json
+
+    env = dict(_env(), OBS_DIR=str(tmp_path))
+    proc = subprocess.Popen(
+        [sys.executable,
+         str(EXAMPLES / "observability" / "serve_demo.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        lines = _wait_output(proc, "SERVE OBS DEMO OK", 240)
+        out = "".join(lines)
+        assert "ptype serving @" in out     # the obs-serve rendering
+        assert "prefix-cache block hits" in out
+    finally:
+        proc.kill()
+    chrome = json.load(open(tmp_path / "serve_trace.json"))
+    names = {e["name"] for e in chrome["traceEvents"]
+             if e["ph"] == "X"}
+    assert {"gateway.request", "rpc.call",
+            "actor/Generator.Generate", "serve.admit",
+            "serve.decode"} <= names
+    assert any(n.startswith("serve.prefill.chunk") for n in names)
+    # The TTFT acceptance instant, stamped where the token appeared.
+    assert any(e["ph"] == "i" and e["name"] == "first_token"
+               for e in chrome["traceEvents"])

@@ -599,3 +599,83 @@ def test_ptype_tpu_package_is_pt009_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt009 = [f for f in findings if "PT009" in f]
     assert not pt009, pt009
+
+
+# --------------------------------------------------------------- PT010
+
+
+PT010_RAW_TIMER = (
+    "import time\n"
+    "def step(engine):\n"
+    "    t0 = time.perf_counter()\n"
+    "    engine.run()\n"
+    "    return (time.perf_counter() - t0, time.time())\n")
+
+
+def test_pt010_flags_raw_timers_in_serve_engine(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sneak.py",
+                      PT010_RAW_TIMER)
+    assert sum("PT010" in f for f in findings) == 3, findings
+
+
+def test_pt010_flags_aliased_and_from_import_forms(tmp_path):
+    src = ("import time as _t\n"
+           "from time import perf_counter as pc, time as wall\n"
+           "def step(engine):\n"
+           "    a = _t.perf_counter()\n"
+           "    b = pc()\n"
+           "    c = wall()\n"
+           "    return a, b, c\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/forms.py", src)
+    assert sum("PT010" in f for f in findings) == 3, findings
+
+
+def test_pt010_silent_outside_serve_engine(tmp_path):
+    # The ledger (health/serving.py) IS the timing home; the rest of
+    # the package and the tests time things deliberately.
+    for rel in ("ptype_tpu/health/serving.py", "ptype_tpu/serve.py",
+                "tests/t10.py", "examples/demo10.py"):
+        findings = _check(tmp_path, rel, PT010_RAW_TIMER)
+        assert not any("PT010" in f for f in findings), (rel, findings)
+
+
+def test_pt010_ignores_non_timer_time_attrs(tmp_path):
+    src = ("import time\n"
+           "def fmt(ts):\n"
+           "    return time.strftime('%H:%M', time.localtime(ts))\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/ok10.py", src)
+    assert not any("PT010" in f for f in findings), findings
+
+
+def test_pt010_ignores_unrelated_modules_named_time(tmp_path):
+    # Only names bound to the stdlib ``time`` module count; a .time()
+    # method on some other object is not a wall-clock read.
+    src = ("def f(sim):\n"
+           "    return sim.time()\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sim10.py", src)
+    assert not any("PT010" in f for f in findings), findings
+
+
+def test_pt010_honors_noqa(tmp_path):
+    src = ("import time\n"
+           "def step():\n"
+           "    return time.perf_counter()  # noqa: sanctioned\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sup10.py", src)
+    assert not any("PT010" in f for f in findings), findings
+
+
+def test_serve_engine_package_is_pt010_clean():
+    """Every latency stamp in serve_engine/ rides the serving ledger's
+    seams (ISSUE 10): no raw perf_counter/time calls in the package."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "serve_engine")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt010 = [f for f in findings if "PT010" in f]
+    assert not pt010, pt010

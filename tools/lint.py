@@ -43,6 +43,14 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   ±127 saturate, sub-1 magnitudes round to zero); int8 wires must go
   through the block-scaled quantizers (``_q_int8_blockwise`` /
   ``quantize_leaf``), which pair every payload with its absmax scales
+- PT010 (ptype_tpu/serve_engine/ only): a raw ``time.perf_counter()``
+  / ``time.time()`` call (bare, module-aliased, or from-imported) —
+  the engine's latency math lives in exactly one place, the serving
+  ledger's seams (health/serving.py: enqueued / head_refused /
+  admitted / chunk / first_token / tokens_emitted / iteration /
+  retired); an ad-hoc stamp next to them drifts from the histograms
+  and spans the ledger derives, and escapes the seam-cost probe that
+  backs the <1%-overhead bar (``serving_ledger_overhead_pct``)
 - PT007 (train/ only): ``optimizer.init(...)`` (full-tree optimizer
   state construction) outside the init/constructor helpers
   (``__init__`` / ``init_*`` / ``_init*``) — replicated whole-tree
@@ -572,6 +580,60 @@ class _RawCacheBankCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawTimerCheck(ast.NodeVisitor):
+    """PT010: ``time.perf_counter()`` / ``time.time()`` anywhere in
+    ptype_tpu/serve_engine/ — bare attribute form, any module alias
+    (``import time as _t``), or from-imports (``from time import
+    perf_counter [as pc]``). The serving ledger (health/serving.py)
+    is the engine's one timing home: its seams produce the stamps the
+    TTFT/TPOT histograms AND the synthesized span tree derive from,
+    and the seam-cost probe prices exactly those calls for the bench's
+    overhead bar — a raw timer beside them is unpriced drift."""
+
+    _VERBS = frozenset({"perf_counter", "time"})
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        #: Local names bound to the ``time`` module.
+        self.mods: set[str] = set()
+        #: Local name → original verb for from-imports of
+        #: time.perf_counter / time.time (aliases included).
+        self.funcs: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "time":
+                self.mods.add(a.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.funcs[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, verb: str) -> None:
+        self.findings.append(
+            f"{self.path}:{node.lineno}: PT010 raw time.{verb} in "
+            f"serve_engine/ — engine latency stamps must ride the "
+            f"serving ledger's seams (health/serving.py: enqueued/"
+            f"head_refused/admitted/chunk/first_token/tokens_emitted/"
+            f"iteration/retired), the one timing home the histograms, "
+            f"span tree, and seam-cost probe all derive from")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in (self.mods or {"time", "_time"})):
+            self._flag(node, fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node, self.funcs[fn.id])
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -646,6 +708,11 @@ def check_file(path: str, findings: list[str]) -> None:
         # The data plane's int8 narrowings must ride the scaled
         # quantize helpers — a bare cast is silent gradient loss.
         _RawInt8CastCheck(path, raw).visit(tree)
+    if "ptype_tpu" in parts and "serve_engine" in parts:
+        # The serving ledger (health/serving.py) is the engine's one
+        # timing home: raw timers beside its seams drift from the
+        # histograms/spans and escape the seam-cost overhead probe.
+        _RawTimerCheck(path, raw).visit(tree)
     if ("ptype_tpu" in parts and "serve_engine" not in parts
             and "models" not in parts):
         # serve_engine/ IS the paged pool; models/ holds init_cache
