@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -24,6 +24,15 @@ bench:
 # chunked admission — the ISSUE 9 acceptance numbers).
 serve-bench:
 	JAX_PLATFORMS=cpu python bench.py --serve
+
+# Speculative-decoding microbench (docs/PERF.md "Speculative
+# decoding"): batch-1 single-stream decode tokens/sec through the
+# paged engine with draft-propose + batched target-verify vs the
+# plain engine, at bit-identical greedy output, plus the measured
+# accept rate — the ISSUE 12 acceptance numbers. Also emitted in the
+# serve-bench tail.
+spec-bench:
+	JAX_PLATFORMS=cpu python bench.py --spec
 
 # Gradient-wire microbench on the 8-device virtual host mesh
 # (docs/PERF.md "Quantized + overlapped collectives"): bucketed

@@ -31,6 +31,10 @@ site                    actions
 ``gateway.probe``       ``drop`` / ``timeout`` / ``delay`` (gateway/pool)
 ``serve.admit``         ``shed`` (typed ShedError + retry_after, the
                         pool-exhausted path) / ``delay`` (serve_engine)
+``serve.spec``          ``reject`` (poison a speculation window — that
+                        iteration falls back to the plain decode step:
+                        correct tokens, just slower) / ``delay`` (stall
+                        the draft forward) (serve_engine)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

@@ -131,6 +131,12 @@ class Replica:
             if "prefix_hit_rate" in self.reported:
                 snap["prefix_hit_rate"] = float(
                     self.reported["prefix_hit_rate"] or 0.0)
+            # Speculative-decoding accept rate (ISSUE 12): reported
+            # only by spec-armed replicas, so a collapsed rate is
+            # visible fleet-wide without faking 0.0 on the rest.
+            if "spec_accept_rate" in self.reported:
+                snap["spec_accept_rate"] = float(
+                    self.reported["spec_accept_rate"] or 0.0)
             return snap
 
 

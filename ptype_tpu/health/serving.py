@@ -205,13 +205,19 @@ class _IterMeter:
     the iteration record: active slots, decode/prefill token split,
     the co-batched stall the engine charged to this step."""
 
-    __slots__ = ("_led", "active", "stall_ms", "_t0")
+    __slots__ = ("_led", "active", "stall_ms", "decode_tokens", "_t0")
 
     def __init__(self, led: "ServingLedger", active: int,
                  stall_ms: float):
         self._led = led
         self.active = int(active)
         self.stall_ms = float(stall_ms)
+        #: Tokens this iteration actually decoded. Defaults to the
+        #: active-slot count (one token per live row); a speculative
+        #: window overwrites it with its emitted total before the
+        #: scope closes, so ``serve.decode_tokens`` stays the real
+        #: throughput counter either way.
+        self.decode_tokens: int | None = None
 
     def __enter__(self) -> "_IterMeter":
         self._t0 = time.perf_counter()
@@ -220,19 +226,21 @@ class _IterMeter:
     def __exit__(self, *exc) -> bool:
         led = self._led
         dur_ms = (time.perf_counter() - self._t0) * 1e3
+        dtoks = (self.active if self.decode_tokens is None
+                 else int(self.decode_tokens))
         with led._lock:
             prefill_s, led._iter_prefill_s = led._iter_prefill_s, 0.0
             ptoks, led._iter_prefill_tokens = \
                 led._iter_prefill_tokens, 0
             rec = {"step_ms": round(dur_ms, 3),
                    "active": self.active,
-                   "decode_tokens": self.active,
+                   "decode_tokens": dtoks,
                    "prefill_tokens": ptoks,
                    "prefill_ms": round(prefill_s * 1e3, 3),
                    "stall_ms": round(self.stall_ms, 3)}
             led._iters.append(rec)
         led.c_steps.add(1)
-        led.c_decode_tokens.add(self.active)
+        led.c_decode_tokens.add(dtoks)
         if ptoks:
             led.c_prefill_tokens.add(ptoks)
         led.g_step_ms.set(rec["step_ms"])
@@ -285,6 +293,13 @@ class ServingLedger:
         self._iter_prefill_s = 0.0
         self._iter_prefill_tokens = 0
         self._evictions_last = 0.0
+        # Speculative decoding (ISSUE 12): cumulative window totals
+        # behind the summary's spec_accept_rate / spec_tokens; the
+        # counter/gauge families resolved lazily in spec_window so a
+        # non-speculative engine's registry stays spec-free.
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_tokens = 0
 
     # --------------------------------------------------- request seams
 
@@ -317,12 +332,19 @@ class ServingLedger:
         rec.t_first = time.perf_counter()
         rec.tok_t.append(rec.t_first)
 
-    def tokens_emitted(self, recs) -> None:
+    def tokens_emitted(self, recs, counts=None) -> None:
         """One decode step emitted a token on each of ``recs`` — one
-        shared stamp (the step boundary), appended per row."""
+        shared stamp (the step boundary), appended per row.
+        ``counts`` (speculative windows): per-rec emitted-token counts
+        — the window's tokens share the commit stamp, so TPOT stays
+        the mean inter-token time of what the caller actually saw."""
         now = time.perf_counter()
-        for rec in recs:
-            rec.tok_t.append(now)
+        if counts is None:
+            for rec in recs:
+                rec.tok_t.append(now)
+            return
+        for rec, n in zip(recs, counts):
+            rec.tok_t.extend([now] * int(n))
 
     def shed_untracked(self) -> None:
         """A shed before any record existed (the chaos admit seam)."""
@@ -373,6 +395,32 @@ class ServingLedger:
         """Meter one engine iteration (wrap the batched decode step)."""
         return _IterMeter(self, active, stall_ms)
 
+    def spec_window(self, proposed: int, accepted: int, emitted: int,
+                    rate: float) -> None:
+        """One committed speculative-decoding window (ISSUE 12):
+        ``proposed`` draft tokens scored, ``accepted`` of them kept,
+        ``emitted`` tokens committed (accepted prefixes + one
+        corrected/bonus token per live row). ``rate`` is the engine's
+        accept-rate EWMA — published as the ``serve.spec_accept_rate``
+        gauge the gateway probes, ``obs serve``, and a fleet-wide
+        collapse diagnosis all read; the counters
+        (``serve.spec_windows`` / ``spec_proposed`` / ``spec_accepted``
+        / ``spec_tokens``) carry the cumulative totals behind the
+        summary's measured speedup accounting."""
+        reg = self.registry
+        reg.counter("serve.spec_windows").add(1)
+        if proposed:
+            reg.counter("serve.spec_proposed").add(int(proposed))
+        if accepted:
+            reg.counter("serve.spec_accepted").add(int(accepted))
+        if emitted:
+            reg.counter("serve.spec_tokens").add(int(emitted))
+        reg.gauge("serve.spec_accept_rate").set(round(float(rate), 4))
+        with self._lock:
+            self._spec_proposed += int(proposed)
+            self._spec_accepted += int(accepted)
+            self._spec_tokens += int(emitted)
+
     def kv_sample(self, stats: dict, prefix_hit_rate: float) -> None:
         """Publish one KV-pool pressure sample from
         ``BlockPool.stats()`` — the ``kv.*`` names the serving alert
@@ -393,6 +441,15 @@ class ServingLedger:
             reg.counter("kv.evictions").add(delta)
 
     # ------------------------------------------------------- readouts
+
+    def spec_totals(self) -> tuple[int, int, int]:
+        """Cumulative (proposed, accepted, emitted) speculative
+        totals — the ONE accumulation home (the engine derives its
+        Info() surface from this; a second engine-side copy would be
+        a drift surface)."""
+        with self._lock:
+            return (self._spec_proposed, self._spec_accepted,
+                    self._spec_tokens)
 
     def svc_ewma_s(self) -> float:
         """EWMA of completed-request service seconds — the engine's
@@ -419,7 +476,18 @@ class ServingLedger:
         with self._lock:
             retired = self._retired
             reasons = dict(self._reasons)
+            spec_prop = self._spec_proposed
+            spec_acc = self._spec_accepted
+            spec_toks = self._spec_tokens
+        out = {}
+        if spec_prop:
+            # Only once speculation actually ran: a non-speculative
+            # replica's Info() stays spec-free, so fleet views can
+            # tell "no speculation" from "accept rate 0".
+            out["spec_accept_rate"] = round(spec_acc / spec_prop, 4)
+            out["spec_tokens"] = spec_toks
         return {
+            **out,
             "requests_retired": retired,
             "retire_reasons": reasons,
             "ttft_p50_ms": round(self.h_ttft.percentile(50), 3),

@@ -112,7 +112,7 @@ def render_serve(snapshot: dict, alerts=(),
         f"({len(nodes)} nodes, {len(errors)} unreachable)",
         f"{'replica':<28} {'ttft99':>8} {'tpot':>7} {'e2e99':>8} "
         f"{'q':>4} {'live':>5} {'kvfree':>7} {'util%':>6} "
-        f"{'hit%':>6} {'evic':>6} {'stall':>7}",
+        f"{'hit%':>6} {'spec%':>6} {'evic':>6} {'stall':>7}",
     ]
 
     def num(v, fmt="{:.1f}", dash="-"):
@@ -128,6 +128,10 @@ def render_serve(snapshot: dict, alerts=(),
         free = _gauge(t, "kv.free_blocks")
         util = _gauge(t, "kv.util_pct")
         hit = _gauge(t, "kv.prefix_hit_rate")
+        # Speculative-decoding accept rate (ISSUE 12): absent on
+        # replicas that never ran a window — "-" means no speculation,
+        # a number near 0 means a collapsed draft.
+        spec = _gauge(t, "serve.spec_accept_rate")
         evic = (t.get("metrics", {}).get("counters", {})
                 .get("kv.evictions"))
         stall = _gauge(t, "serve.stall_ms")
@@ -137,6 +141,7 @@ def render_serve(snapshot: dict, alerts=(),
             f"{num(q, '{:.0f}'):>4} {num(live, '{:.0f}'):>5} "
             f"{num(free, '{:.0f}'):>7} {num(util):>6} "
             f"{num(hit * 100 if hit is not None else None):>6} "
+            f"{num(spec * 100 if spec is not None else None):>6} "
             f"{num(evic, '{:.0f}'):>6} {num(stall):>6}m")
     if not serving:
         lines.append("  (no serving replicas report serve.* metrics)")

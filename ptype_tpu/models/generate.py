@@ -368,6 +368,238 @@ def prefill_paged_chunk(params: dict, tokens: jax.Array,
     return _head_logits(params, x_last, cfg), kb, vb
 
 
+# ------------------------------------------------- speculative decoding
+
+#: RNG domain separators for the speculative path: the draft's
+#: proposal draws and the acceptance test's uniforms/residual draws
+#: fold these into the row key FIRST, so the three streams (engine
+#: sampling, draft sampling, acceptance) can never collide at a shared
+#: fold index. Arbitrary constants; changing them changes sampled
+#: outputs (never greedy ones).
+_DRAFT_FOLD = 0x5bec
+_ACCEPT_FOLD = 0xacce
+
+
+def truncated_draft_params(params: dict, cfg: tfm.TransformerConfig,
+                           n_layers: int = 1
+                           ) -> tuple[dict, tfm.TransformerConfig]:
+    """The shared-prefix-truncated draft: reuse the target's embedding
+    / final norm / LM head and its FIRST ``n_layers`` transformer
+    blocks as a cheap same-family draft model. Zero extra parameter
+    memory (the returned tree aliases the target's arrays — blocks are
+    stacked on the scan axis, so truncation is one leading slice).
+    Returns ``(draft_params, draft_cfg)`` for
+    ``SpecConfig(draft_params=..., draft_cfg=...)``."""
+    if not 1 <= n_layers <= cfg.n_layers:
+        raise ValueError(
+            f"truncated draft needs 1 <= n_layers <= {cfg.n_layers}, "
+            f"got {n_layers}")
+    from dataclasses import replace
+
+    blocks = jax.tree_util.tree_map(lambda a: a[:n_layers],
+                                    params["blocks"])
+    return dict(params, blocks=blocks), replace(cfg, n_layers=n_layers)
+
+
+def verify_step_paged(params: dict, tokens: jax.Array,
+                      pos0: jax.Array, cfg: tfm.TransformerConfig,
+                      kb: jax.Array, vb: jax.Array, tables: jax.Array,
+                      wr_b: jax.Array, wr_o: jax.Array
+                      ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Target-model verification of one speculation window in ONE
+    batched forward — the speculative-decoding counterpart of
+    :func:`decode_step_paged`. ``tokens`` (B, W): each row's last
+    committed token followed by its draft proposals, at positions
+    ``pos0 + [0..W)``; every position's K/V scatters through the block
+    tables (``wr_b``/``wr_o`` (B, W) — the engine routes inactive
+    lanes and positions past a row's reserved span to the trash
+    block), and query ``j`` attends causally through position
+    ``pos0 + j`` via the same ragged per-slot gather path decode uses.
+    Returns ``(logits (B, W, V) f32, kb, vb)``: ``logits[:, j]`` is
+    the target distribution for the token AT position ``pos0 + j + 1``
+    given the prefix through ``tokens[:, j]`` — exactly the logits W
+    sequential :func:`decode_step_paged` calls would produce, which is
+    what makes greedy speculative acceptance bit-identical to the
+    non-speculative engine. Rejected positions need no KV cleanup:
+    their writes land inside the row's already-reserved blocks and the
+    position-limit mask hides them until a later token overwrites them
+    (rollback is a position rewind, never a reallocation)."""
+    B, W = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)  # (B, W, D)
+    pos = pos0[:, None] + jnp.arange(W)[None, :]   # (B, W)
+    sin, cos = tfm.rope_tables(cfg, positions=pos)
+    limits = pos + 1  # (B, W): per-query causal limits
+    # MoE: zero-drop capacity over the whole window (same reasoning
+    # as decode_step's B bound — dropping is a training regularizer).
+    cap = B * W if cfg.n_experts else None
+
+    def body(x, inputs):
+        layer, kc, vc = inputs
+        q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+        kc = kc.at[wr_b, wr_o].set(k)
+        vc = vc.at[wr_b, wr_o].set(v)
+        o = _paged_attention_gather(q, kc, vc, tables, limits, cfg)
+        x = tfm.attn_residual(x, o, layer, cfg)
+        x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=cap)
+        return x, (kc, vc)
+
+    x, (kb, vb) = lax.scan(body, x, (params["blocks"], kb, vb))
+    x = tfm.rms_norm(x, params["final_norm"])
+    return _head_logits(params, x, cfg), kb, vb
+
+
+def draft_propose_paged(params: dict, tok: jax.Array,
+                        pos0: jax.Array, cfg: tfm.TransformerConfig,
+                        kb: jax.Array, vb: jax.Array,
+                        tables: jax.Array, wr_b: jax.Array,
+                        wr_o: jax.Array, keys: jax.Array,
+                        steps0: jax.Array, temps: jax.Array,
+                        top_ks: jax.Array, top_ps: jax.Array,
+                        n_steps: int, sampled: bool = True
+                        ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                   jax.Array]:
+    """``n_steps`` draft decode steps through the draft model's own
+    block tables inside ONE program (a ``lax.scan`` — one dispatch per
+    window, not per proposal). Step ``j`` feeds the previous token at
+    position ``pos0 + j``, writes its K/V (``wr_b``/``wr_o``
+    (B, n_steps), trash-routed like the verify step), and draws the
+    next token from the draft distribution: greedy rows take the
+    argmax; sampled rows draw from the same filtered/temperature-
+    scaled logits the acceptance test will score, with a
+    draft-domain-separated key folded at ``steps0 + j`` per row
+    (:func:`sample_token_rows` — the one RNG home). The engine runs
+    ``n_steps = k + 1``: the last step's K/V write covers the
+    all-accepted case (the bonus token's context) and its proposal is
+    discarded. Returns ``(proposed (B, n_steps) int32, draft_logits
+    (B, n_steps, V) f32 raw, kb, vb)`` — ``proposed[:, j]`` is the
+    draft's token for position ``pos0 + j + 1`` and
+    ``draft_logits[:, j]`` the logits it was drawn from (acceptance
+    recomputes the filtered distribution from these, so q is scored
+    exactly as sampled)."""
+    B = tok.shape[0]
+    dkeys = jax.vmap(
+        lambda kk: jax.random.fold_in(kk, _DRAFT_FOLD))(keys)
+
+    def step(carry, inputs):
+        tok, kb, vb = carry
+        j, wb, wo = inputs
+        pos = pos0 + j  # (B,)
+        x = params["embed"][tok][:, None, :].astype(cfg.dtype)
+        sin, cos = tfm.rope_tables(cfg, positions=pos[:, None])
+
+        def body(x, inp):
+            layer, kc, vc = inp
+            q, k, v = tfm.qkv_proj(x, layer, cfg, sin, cos)
+            kc = kc.at[wb, wo].set(k[:, 0])
+            vc = vc.at[wb, wo].set(v[:, 0])
+            o = _paged_attention_gather(q, kc, vc, tables, pos + 1,
+                                        cfg)
+            x = tfm.attn_residual(x, o, layer, cfg)
+            x, _aux = tfm.mlp_residual(x, layer, cfg, moe_capacity=B)
+            return x, (kc, vc)
+
+        x, (kb, vb) = lax.scan(body, x, (params["blocks"], kb, vb))
+        x = tfm.rms_norm(x, params["final_norm"])
+        lg = _head_logits(params, x[:, 0], cfg)  # (B, V) f32
+        if sampled:
+            nxt = sample_token_rows(lg, dkeys, steps0 + j, temps,
+                                    top_ks, top_ps)
+        else:
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return (nxt, kb, vb), (nxt, lg)
+
+    (_, kb, vb), (toks, lgs) = lax.scan(
+        step, (tok, kb, vb),
+        (jnp.arange(n_steps), jnp.swapaxes(wr_b, 0, 1),
+         jnp.swapaxes(wr_o, 0, 1)))
+    return (jnp.swapaxes(toks, 0, 1), jnp.swapaxes(lgs, 0, 1), kb, vb)
+
+
+def spec_accept_rows(draft_toks: jax.Array, draft_logits: jax.Array,
+                     target_logits: jax.Array, keys: jax.Array,
+                     steps0: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array,
+                     sampled: bool = True
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Per-row acceptance sampling over one speculation window — the
+    exact-distribution contract (:func:`sample_token_rows`'s
+    draw-for-draw machinery extended to a residual-distribution
+    acceptance). ``draft_toks`` (B, k), ``draft_logits`` (B, k, V)
+    raw f32, ``target_logits`` (B, k+1, V) raw f32.
+
+    Greedy rows (``temps == 0``): accept the longest draft prefix
+    matching the target argmax chain, then emit the target argmax at
+    the first mismatch — bit-identical to sequential greedy decode,
+    whatever the draft proposed. Sampled rows: token ``j`` accepts
+    with probability ``min(1, p_j(d_j) / q_j(d_j))`` where ``p`` / ``q``
+    are the filtered, temperature-scaled target / draft distributions
+    (the SAME filtering the draws came from); the first rejection
+    draws the corrected token from the normalized residual
+    ``max(p_j − q_j, 0)``, and a fully-accepted window draws the bonus
+    token from ``p_k`` — the classic speculative-sampling identity, so
+    the emitted stream is distributed EXACTLY as sequential
+    ``jax.random.categorical`` sampling from the target
+    (contract-tested statistically; the residual draw rides an
+    acceptance-domain-separated key at ``steps0``/``steps0 + 1``).
+
+    Returns ``(out_toks (B, k+1), n_acc (B,))``: row ``b`` emits
+    ``out_toks[b, :n_acc[b] + 1]`` — its accepted draft prefix plus
+    one corrected/bonus token."""
+    k = draft_toks.shape[1]
+
+    if not sampled:
+        # All-greedy window: the argmax chain only — no softmax, no
+        # RNG, no filter machinery on the serving hot path.
+        def one_greedy(d_toks, t_lg):
+            gt = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)
+            match = (d_toks == gt[:k]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match))
+            out = jnp.concatenate(
+                [d_toks, jnp.zeros((1,), jnp.int32)])
+            return out.at[n_acc].set(gt[n_acc]), n_acc
+
+        return jax.vmap(one_greedy)(draft_toks, target_logits)
+
+    akeys = jax.vmap(
+        lambda kk: jax.random.fold_in(kk, _ACCEPT_FOLD))(keys)
+
+    def one(d_toks, d_lg, t_lg, key, step0, t, tk, tp):
+        gt = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)  # (k+1,)
+        match_g = d_toks == gt[:k]
+
+        def dist(lg):  # raw (V,) logits → filtered sampling probs
+            x = lg.astype(jnp.float32) / jnp.where(t > 0, t, 1.0)
+            return jax.nn.softmax(_filter_logits_traced(x, tk, tp))
+
+        p = jax.vmap(dist)(t_lg)  # (k+1, V)
+        q = jax.vmap(dist)(d_lg)  # (k, V)
+        idx = jnp.arange(k)
+        ratio = p[idx, d_toks] / jnp.maximum(q[idx, d_toks], 1e-30)
+        u = jax.random.uniform(jax.random.fold_in(key, step0), (k,))
+        ok = jnp.where(t > 0.0, u < jnp.minimum(ratio, 1.0), match_g)
+        n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+        # Residual at the rejection point; q padded with a zero row so
+        # a fully-accepted window (n_acc == k) draws the bonus token
+        # from the bare target distribution p_k.
+        q_pad = jnp.concatenate([q, jnp.zeros((1, q.shape[-1]),
+                                              q.dtype)])
+        res = jnp.maximum(p[n_acc] - q_pad[n_acc], 0.0)
+        rs = jnp.sum(res)
+        # A numerically-empty residual (p == q to float precision but
+        # the ratio test still rejected) falls back to p itself.
+        res = jnp.where(rs > 0, res / jnp.maximum(rs, 1e-30),
+                        p[n_acc])
+        c_s = jax.random.categorical(
+            jax.random.fold_in(key, step0 + 1),
+            jnp.log(jnp.maximum(res, 1e-38))).astype(jnp.int32)
+        c = jnp.where(t > 0.0, c_s, gt[n_acc])
+        out = jnp.concatenate([d_toks, jnp.zeros((1,), jnp.int32)])
+        return out.at[n_acc].set(c), n_acc
+
+    return jax.vmap(one)(draft_toks, draft_logits, target_logits,
+                         akeys, steps0, temps, top_ks, top_ps)
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_generate(cfg: tfm.TransformerConfig, B: int, S: int,
                        max_new_tokens: int, temperature: float,

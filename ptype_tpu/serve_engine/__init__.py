@@ -29,7 +29,8 @@ lives in :mod:`ptype_tpu.ops.paged_attention`, gated behind the same
 
 from ptype_tpu.serve_engine.blocks import (BlockPool, block_hashes,
                                            prefix_affinity_key)
-from ptype_tpu.serve_engine.engine import PagedGeneratorActor
+from ptype_tpu.serve_engine.engine import (PagedGeneratorActor,
+                                           SpecConfig)
 
 __all__ = ["BlockPool", "block_hashes", "prefix_affinity_key",
-           "PagedGeneratorActor"]
+           "PagedGeneratorActor", "SpecConfig"]

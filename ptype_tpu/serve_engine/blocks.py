@@ -251,10 +251,29 @@ class BlockPool:
                 if self.capacity else 0.0,
             }
 
-    def check_invariants(self) -> list[str]:
+    def check_invariants(self, spec_rows=()) -> list[str]:
         """Consistency audit for tests: every block in exactly one
-        lifetime, index bijective, reservation covered."""
+        lifetime, index bijective, reservation covered.
+
+        ``spec_rows`` (speculative decoding, ISSUE 12): per-live-row
+        ``(pos, nalloc, reserve_left, advance)`` tuples — asserts each
+        row's remaining reservation covers its worst-case
+        ``advance``-token speculative window (positions
+        ``[pos, pos + advance)``, including decode-boundary block
+        crossings mid-speculation), so a verify step can never find
+        the pool empty. The engine builds these via
+        ``PagedGeneratorActor.check_spec_reservations()``."""
         bad: list[str] = []
+        bt = self.block_tokens
+        for i, (pos, nalloc, reserve_left, advance) in \
+                enumerate(spec_rows):
+            need = -(-(int(pos) + int(advance)) // bt) - int(nalloc)
+            if need > int(reserve_left):
+                bad.append(
+                    f"row {i}: reservation does not cover a "
+                    f"{advance}-token advance from pos {pos} "
+                    f"(needs {need} new blocks past its {nalloc} "
+                    f"allocated, holds {reserve_left} reserved)")
         with self._lock:
             free, cached, active = (set(self._free), set(self._cached),
                                     set(self._ref))

@@ -49,6 +49,7 @@ answers "where did this request's latency go" across processes.
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,47 @@ from ptype_tpu.serve_engine.blocks import BlockPool, block_hashes
 log = logs.get_logger("serve_engine")
 
 
+@dataclass
+class SpecConfig:
+    """Speculative decoding on the paged engine (ISSUE 12).
+
+    A small same-family draft transformer proposes ``k`` tokens per
+    live slot (its own paged KV tables in a second :class:`BlockPool`;
+    :func:`~ptype_tpu.models.generate.truncated_draft_params` builds
+    the zero-extra-memory layer-truncated variant), the target model
+    scores all ``k + 1`` positions in ONE batched forward through the
+    ragged per-slot gather path, and acceptance sampling commits the
+    accepted prefix plus one corrected token — greedy output
+    bit-identical to the non-speculative engine, sampled output
+    distributed exactly as the target (the residual-acceptance
+    contract in ``generate.spec_accept_rows``).
+
+    ``adaptive``: back speculation off when the measured accept rate
+    makes it a loss — the accept-rate EWMA under ``accept_floor``
+    sheds one proposal depth per window; at depth 1 and under
+    ``accept_floor / 2`` speculation disables outright and re-probes
+    with one k=1 window every ``probe_every`` plain decode iterations
+    (a draft gone stale against new traffic re-earns its depth instead
+    of taxing every token forever). Above ``accept_floor + 0.15`` the
+    depth climbs back toward ``k``.
+    """
+
+    #: Draft model params pytree (same family: embed/blocks/head).
+    draft_params: dict
+    #: Draft model config; vocab must match the target's.
+    draft_cfg: tfm.TransformerConfig
+    #: Proposal depth per window (the max tokens drafted per slot).
+    k: int = 4
+    #: Back off / re-probe on the measured accept rate.
+    adaptive: bool = True
+    #: Accept-rate EWMA floor under which depth backs off.
+    accept_floor: float = 0.35
+    #: Plain iterations between re-probes once speculation disabled.
+    probe_every: int = 32
+    #: Accept-rate EWMA smoothing.
+    ewma_alpha: float = 0.2
+
+
 class _PagedRow:
     """One prompt ROW moving through the engine: queued → admitting
     (chunked prefill) → active slot → done."""
@@ -73,7 +115,8 @@ class _PagedRow:
     __slots__ = ("prompt", "max_new", "stop_token", "temperature",
                  "top_k", "top_p", "key", "emitted", "done", "err",
                  "table", "hashes", "reused", "prefill_pos",
-                 "reserve_left", "rec", "cancelled")
+                 "reserve_left", "rec", "cancelled", "draft_table",
+                 "draft_reserve_left")
 
     def __init__(self, prompt, max_new, stop_token, temperature,
                  top_k, top_p, key):
@@ -97,6 +140,11 @@ class _PagedRow:
         #: (lint PT010: no raw timers in serve_engine/).
         self.rec = None
         self.cancelled = False
+        #: Draft-model block table + reservation (speculative
+        #: decoding only; mirrors table/reserve_left on the target
+        #: pool — the draft's KV state rides its own BlockPool).
+        self.draft_table: list[int] = []
+        self.draft_reserve_left = 0
 
 
 class PagedGeneratorActor(GeneratorActor):
@@ -115,7 +163,12 @@ class PagedGeneratorActor(GeneratorActor):
     (pool exhaustion becomes a routing signal instead of a gateway
     deadline burn; 0 = wait forever); ``attn`` "gather" (XLA,
     default) or "kernel" (Pallas paged attention, TPU backends gated
-    by its ``check_tpu_lowering``).
+    by its ``check_tpu_lowering``); ``spec`` a :class:`SpecConfig`
+    arming speculative decoding — draft-propose, one batched
+    target-verify, exact-distribution acceptance (greedy output stays
+    bit-identical to the non-speculative engine; per-slot accept
+    lengths make iterations ragged, which the retirement path already
+    tolerates).
     """
 
     def __init__(self, cfg: tfm.TransformerConfig, params=None,
@@ -125,6 +178,7 @@ class PagedGeneratorActor(GeneratorActor):
                  prefill_chunk: int | None = 64,
                  max_queue: int = 64, admit_timeout_s: float = 10.0,
                  attn: str = "gather",
+                 spec: SpecConfig | None = None,
                  metrics_registry: metrics_mod.MetricsRegistry | None
                  = None):
         super().__init__(cfg, params, rng)
@@ -168,6 +222,33 @@ class PagedGeneratorActor(GeneratorActor):
                     "config: " + "; ".join(bad))
         self.attn = attn
 
+        # Speculative decoding (ISSUE 12): the draft model's own paged
+        # KV tables ride a second BlockPool (same block geometry, its
+        # own reservation discipline — admission reserves BOTH pools'
+        # worst case so a mid-window boundary crossing can never find
+        # either empty).
+        self._spec = spec
+        self._dpool: BlockPool | None = None
+        if spec is not None:
+            if spec.draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"spec draft vocab {spec.draft_cfg.vocab_size} != "
+                    f"target vocab {cfg.vocab_size}")
+            if int(spec.k) < 1:
+                raise ValueError(f"spec.k must be >= 1, got {spec.k}")
+            self._dpool = BlockPool(spec.draft_cfg, n_blocks, bt)
+        #: Current proposal depth (adaptive-k backs this off; 0 =
+        #: speculation disabled pending a re-probe).
+        self._k_cur = int(spec.k) if spec is not None else 0
+        self._spec_ewma = 0.0
+        self._spec_windows = 0
+        self._spec_probe_left = 0
+        self._window_progs: dict = {}
+        self._draft_chunk_progs: dict = {}
+        #: Device mirror of the slow-moving spec slot state; None =
+        #: re-upload (admission, retire, boundary allocation).
+        self._sdev: dict | None = None
+
         ns = self.n_slots
         self._tables = np.zeros((ns, self.nb), np.int32)
         self._nalloc = np.zeros(ns, np.int32)
@@ -179,6 +260,19 @@ class PagedGeneratorActor(GeneratorActor):
         self._topk = np.zeros(ns, np.int32)
         self._topp = np.ones(ns, np.float32)
         self._eidx = np.zeros(ns, np.int32)
+        # Draft-side slot mirrors + the per-slot speculative RNG
+        # counter (advances by k+2 per window: k+1 draft draws plus
+        # the acceptance pair ride domain-separated folds of it).
+        self._dtables = np.zeros((ns, self.nb), np.int32)
+        self._dnalloc = np.zeros(ns, np.int32)
+        self._sctr = np.zeros(ns, np.int32)
+        #: First position whose draft KV is NOT yet written — plain
+        #: decode steps (chaos reject, adaptive k=0, remaining-1
+        #: windows) advance the target without touching the draft
+        #: pool, and the next window catches the draft up from here
+        #: (a cold draft cache would silently bias every later accept
+        #: rate, including the re-probe that decides recovery).
+        self._dpos = np.zeros(ns, np.int32)
         self._slot_state: dict[int, _PagedRow] = {}
         self._queue: list[_PagedRow] = []
         self._admitting: _PagedRow | None = None
@@ -435,10 +529,12 @@ class PagedGeneratorActor(GeneratorActor):
             with metrics_mod.annotate("serve.step"):
                 # The iteration meter is the batch-composition seam:
                 # step wall, active slots, this round's prefill split,
-                # and the co-batched stall — one record per iteration.
+                # and the co-batched stall — one record per iteration
+                # (a speculative window sets its ragged emitted total
+                # on the meter before the scope closes).
                 with self.ledger.iteration(int(self._active.sum()),
-                                           stall_ms):
-                    self._step()
+                                           stall_ms) as it:
+                    self._step(it)
 
     def _admission_round(self) -> float:
         """Prefill up to ``prefill_chunk`` prompt tokens; returns the
@@ -472,7 +568,14 @@ class PagedGeneratorActor(GeneratorActor):
             return  # no slot to land in
         row = self._queue[0]
         need = -(-(len(row.prompt) + row.max_new) // self.block_tokens)
-        if not self.pool.try_reserve(need):
+        reserved = self.pool.try_reserve(need)
+        if reserved and self._dpool is not None \
+                and not self._dpool.try_reserve(need):
+            # Both pools or neither: a row admitted against the target
+            # pool only would dead-end at its first draft write.
+            self.pool.unreserve(need)
+            reserved = False
+        if not reserved:
             # Blocks come back at retire; re-checked each loop. But a
             # bounded wait only: past admit_timeout_s AT THE QUEUE
             # HEAD (not counting time spent behind other requests —
@@ -493,6 +596,8 @@ class PagedGeneratorActor(GeneratorActor):
                 row.done.set()
             return
         row.reserve_left = need
+        if self._dpool is not None:
+            row.draft_reserve_left = need
         self._queue.pop(0)
         self.ledger.admitted(row.rec)
         self._admitting = row
@@ -580,6 +685,13 @@ class PagedGeneratorActor(GeneratorActor):
                         jnp.float32(row.temperature),
                         jnp.int32(row.top_k),
                         jnp.float32(row.top_p)))
+                if (self._dpool is not None and row.max_new > 1
+                        and not (row.stop_token >= 0
+                                 and first == row.stop_token)):
+                    # The row will take a slot: give the draft model
+                    # its prompt KV (inside this chunk's meter, so the
+                    # activation cost is a charged stall, not free).
+                    self._draft_prefill(row, toks, L)
         self._prefill_chunks += 1
         self._prefill_tokens += n
         if not done:
@@ -609,10 +721,39 @@ class PagedGeneratorActor(GeneratorActor):
         self._topk[slot] = row.top_k
         self._topp[slot] = row.top_p
         self._eidx[slot] = 1
+        if self._dpool is not None:
+            self._dtables[slot] = 0
+            self._dtables[slot, :len(row.draft_table)] = \
+                row.draft_table
+            self._dnalloc[slot] = len(row.draft_table)
+            self._sctr[slot] = 0
+            self._dpos[slot] = L  # draft prefill wrote 0..L-1
         self._dev = None  # slot state changed: re-upload next step
+        self._sdev = None
         return n, cm.dur_s
 
-    def _step(self) -> None:
+    def _step(self, meter=None) -> None:
+        """One engine iteration over the live slots: a speculative
+        window when speculation is armed and earns its depth, else the
+        plain one-token batched decode step."""
+        if self._spec is not None:
+            k_eff = self._spec_k_eff()
+            if k_eff >= 1:
+                # The speculation chaos seam: "reject" poisons the
+                # window (this iteration falls back to the plain step
+                # — correct tokens, just slower), "delay" stalls the
+                # draft forward; the next committed window beacons the
+                # paired recovery.
+                f = chaos.hit("serve.spec", f"k={k_eff}")
+                if f is not None and f.action == "delay":
+                    f.sleep()
+                    f = None
+                if f is None:
+                    self._spec_step(k_eff, meter)
+                    return
+        self._plain_step()
+
+    def _plain_step(self) -> None:
         # Boundary crossings first: a slot whose next write lands past
         # its allocated blocks materializes one from its reservation
         # (guaranteed — admission reserved the worst case).
@@ -625,6 +766,7 @@ class PagedGeneratorActor(GeneratorActor):
                 self._tables[slot, self._nalloc[slot]] = bid
                 self._nalloc[slot] += 1
                 self._dev = None  # tables changed: re-upload
+                self._sdev = None
         sampled = bool((self._temps[self._active] > 0.0).any())
         if self._dev is None:
             self._dev = {
@@ -671,10 +813,325 @@ class PagedGeneratorActor(GeneratorActor):
             #                        retire/admission exports keep the
             #                        block gauges fresh between these.
 
+    # ------------------------------------------------------ speculation
+
+    def _draft_prefill(self, row: _PagedRow, toks, L: int) -> None:
+        """Whole-prompt draft prefill into the row's draft tables at
+        activation (no prefix reuse — draft KV is draft-params
+        specific, and the draft model is the cheap one). Runs inside
+        the final chunk's meter; the trailing block wait pins the
+        draft compute's wall there instead of deferring it into the
+        first speculation window under async dispatch."""
+        bt = self.block_tokens
+        while len(row.draft_table) * bt < L:
+            row.draft_table.append(self._dpool.alloc())
+            row.draft_reserve_left -= 1
+        table_arr = np.zeros(self.nb, np.int32)
+        table_arr[:len(row.draft_table)] = row.draft_table
+        C = max(16, _pow2(L))
+        padded = np.zeros((1, C), np.int32)
+        padded[0, :L] = toks
+        _, self._dpool.k, self._dpool.v = self._draft_chunk_prog(C)(
+            self._spec.draft_params, self._dpool.k, self._dpool.v,
+            jnp.asarray(padded), jnp.int32(0), jnp.int32(L),
+            jnp.asarray(table_arr))
+        self._dpool.k.block_until_ready()
+
+    def _draft_chunk_prog(self, C: int):
+        prog = self._draft_chunk_progs.get(C)
+        if prog is None:
+            dcfg = self._spec.draft_cfg
+
+            def run(params, kb, vb, tokens, start, length, table):
+                return gen.prefill_paged_chunk(
+                    params, tokens, start, length, dcfg, kb, vb,
+                    table)
+
+            prog = jax.jit(run, donate_argnums=(1, 2))
+            self._draft_chunk_progs[C] = prog
+        return prog
+
+    def _draft_catch_up(self, slot: int, row: _PagedRow) -> None:
+        """Backfill the draft pool's KV for positions the row
+        committed through PLAIN decode steps (chaos-rejected windows,
+        adaptive k=0 stretches, remaining-1 tails): one chunked draft
+        pass over the known committed tokens in
+        ``[_dpos, pos)`` — without it, every later window's draft
+        forward attends through garbage at those positions, silently
+        depressing the accept rate (including the k=1 re-probe that
+        decides whether a backed-off draft re-earns its depth)."""
+        start = int(self._dpos[slot])
+        end = int(self._pos[slot])
+        if start >= end:
+            return
+        seq = np.concatenate(
+            [np.asarray(row.prompt, np.int32),
+             np.asarray(row.emitted, np.int32)])
+        n = end - start
+        C = max(16, _pow2(n))
+        padded = np.zeros((1, C), np.int32)
+        padded[0, :n] = seq[start:end]
+        table_arr = np.zeros(self.nb, np.int32)
+        table_arr[:len(row.draft_table)] = row.draft_table
+        _, self._dpool.k, self._dpool.v = self._draft_chunk_prog(C)(
+            self._spec.draft_params, self._dpool.k, self._dpool.v,
+            jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
+            jnp.asarray(table_arr))
+        self._dpos[slot] = end
+
+    def _spec_k_eff(self) -> int:
+        """Proposal depth for this iteration: the adaptive depth,
+        capped so no window can overshoot the deepest live row's
+        remaining budget (k ≤ remaining − 1 keeps every write inside
+        the reservation the row admitted with — the worst-case cover
+        the extended pool audit asserts). 0 = plain decode (depth
+        backed off to nothing, or every live row is one token from
+        done); while disabled, a k=1 probe window re-runs every
+        ``probe_every`` plain iterations."""
+        if self._k_cur == 0:
+            self._spec_probe_left -= 1
+            if self._spec_probe_left > 0:
+                return 0
+            self._k_cur = 1
+            # Fresh evidence decides: park the EWMA at the floor so
+            # the probe window's own accept rate dominates via alpha.
+            self._spec_ewma = self._spec.accept_floor
+        live = [self._slot_state[s]
+                for s in np.flatnonzero(self._active)]
+        if not live:
+            return 0
+        max_r = max(r.max_new - len(r.emitted) for r in live)
+        return max(0, min(self._k_cur, max_r - 1))
+
+    def _spec_adapt(self) -> None:
+        """Adaptive k (docs/PERF.md "Speculative decoding"): shed one
+        proposal depth per window while the accept-rate EWMA sits
+        under the floor; at depth 1 and under half the floor, disable
+        outright (plain decode + periodic k=1 re-probe — a stale
+        draft must not tax every token forever); climb back one depth
+        at a time once the rate clears the floor with margin."""
+        sp = self._spec
+        ew = self._spec_ewma
+        if ew < sp.accept_floor:
+            if self._k_cur > 1:
+                self._k_cur -= 1
+            elif self._k_cur == 1 and ew < sp.accept_floor / 2:
+                self._k_cur = 0
+                self._spec_probe_left = int(sp.probe_every)
+        elif ew > sp.accept_floor + 0.15 and self._k_cur < sp.k:
+            self._k_cur += 1
+
+    def _window_prog(self, W: int, sampled: bool):
+        """ONE fused program per (window width, sampled): draft scan →
+        batched target verify → acceptance, with the write routing
+        computed in-graph from the device-resident tables — a window
+        costs one dispatch and one host sync, whatever k is. That
+        amortization (weights read once per window on memory-bound
+        hardware, dispatch+sync paid once per window on a host mesh)
+        is the whole speedup; three separate dispatches plus
+        host-built routing arrays measurably gave it back."""
+        key = (W, sampled)
+        prog = self._window_progs.get(key)
+        if prog is None:
+            dcfg = self._spec.draft_cfg
+            bt = self.block_tokens
+            nb = self.nb
+
+            def run(tparams, dparams, tok, pos, kb, vb, dkb, dvb,
+                    tables, dtables, nalloc, dnalloc, active, keys,
+                    sctr, temps, topk, topp):
+                ap = pos[:, None] + jnp.arange(W)[None, :]  # (B, W)
+                blk = jnp.minimum(ap // bt, nb - 1)
+                wr_o = ap % bt
+                # Inactive lanes and positions past a row's allocated
+                # span (an overshooting window on a nearly-done row)
+                # scatter to the trash block.
+                ok_t = active[:, None] & (ap // bt < nalloc[:, None])
+                wr_b = jnp.where(
+                    ok_t, jnp.take_along_axis(tables, blk, axis=1), 0)
+                ok_d = active[:, None] & (ap // bt < dnalloc[:, None])
+                dwr_b = jnp.where(
+                    ok_d, jnp.take_along_axis(dtables, blk, axis=1),
+                    0)
+                prop, dlg, dkb, dvb = gen.draft_propose_paged(
+                    dparams, tok, pos, dcfg, dkb, dvb, dtables,
+                    dwr_b, wr_o, keys, sctr, temps, topk, topp,
+                    n_steps=W, sampled=sampled)
+                toks_w = jnp.concatenate(
+                    [tok[:, None], prop[:, :W - 1]], axis=1)
+                tlg, kb, vb = gen.verify_step_paged(
+                    tparams, toks_w, pos, self.cfg, kb, vb, tables,
+                    wr_b, wr_o)
+                out, n_acc = gen.spec_accept_rows(
+                    prop[:, :W - 1], dlg[:, :W - 1], tlg, keys, sctr,
+                    temps, topk, topp, sampled=sampled)
+                return out, n_acc, kb, vb, dkb, dvb
+
+            prog = jax.jit(run, donate_argnums=(4, 5, 6, 7))
+            self._window_progs[key] = prog
+        return prog
+
+    def _spec_step(self, k_eff: int, meter=None) -> None:
+        """One speculation window over the live slots: the draft
+        proposes ``k_eff`` tokens per slot (one scanned program), the
+        target verifies all ``k_eff + 1`` positions in ONE batched
+        forward through the per-slot gather path, and acceptance
+        sampling commits each row's accepted prefix plus one
+        corrected/bonus token — ONE dispatch and ONE host sync per
+        window instead of one per token. Rejected positions roll back
+        as a position rewind (their writes sit in the row's own
+        reserved blocks, masked by the position limit until
+        overwritten) — block tables are never
+        truncated-and-reallocated."""
+        W = k_eff + 1
+        bt = self.block_tokens
+        live = [int(s) for s in np.flatnonzero(self._active)]
+        # Worst-case block cover for the window, BOTH pools: every
+        # allocation consumes a unit the row reserved at admission
+        # (the span cap keeps pos + W inside ceil(span / bt) blocks,
+        # so reservation exhaustion is structurally impossible — the
+        # extended check_invariants audit pins that).
+        for slot in live:
+            row = self._slot_state[slot]
+            span = len(row.prompt) + row.max_new
+            need_tokens = min(int(self._pos[slot]) + W, span)
+            while self._nalloc[slot] * bt < need_tokens:
+                bid = self.pool.alloc()
+                row.reserve_left -= 1
+                self._tables[slot, self._nalloc[slot]] = bid
+                self._nalloc[slot] += 1
+                row.table.append(bid)
+                self._sdev = None  # tables changed: re-mirror
+            while self._dnalloc[slot] * bt < need_tokens:
+                bid = self._dpool.alloc()
+                row.draft_reserve_left -= 1
+                self._dtables[slot, self._dnalloc[slot]] = bid
+                self._dnalloc[slot] += 1
+                row.draft_table.append(bid)
+                self._sdev = None
+            # Positions committed through plain steps left draft-KV
+            # holes: backfill before this window's draft attends
+            # through them.
+            self._draft_catch_up(slot, row)
+        if self._sdev is None:
+            # Device mirror of the SLOW-moving slot state (tables,
+            # routing bounds, sampling params): refreshed only on
+            # admission/retire/boundary allocation — the steady-state
+            # window uploads just tok/pos/sctr.
+            self._sdev = {
+                "tables": jnp.asarray(self._tables),
+                "dtables": jnp.asarray(self._dtables),
+                "nalloc": jnp.asarray(self._nalloc),
+                "dnalloc": jnp.asarray(self._dnalloc),
+                "active": jnp.asarray(self._active),
+                "keys": jnp.asarray(self._keys),
+                "temps": jnp.asarray(self._temps),
+                "topk": jnp.asarray(self._topk),
+                "topp": jnp.asarray(self._topp),
+            }
+        sd = self._sdev
+        sampled = bool((self._temps[self._active] > 0.0).any())
+        with self._lock:
+            self._steps += 1
+            self._max_live = max(self._max_live, len(live))
+            (out_toks, n_acc, self.pool.k, self.pool.v,
+             self._dpool.k, self._dpool.v) = \
+                self._window_prog(W, sampled)(
+                    self.params, self._spec.draft_params,
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    self.pool.k, self.pool.v, self._dpool.k,
+                    self._dpool.v, sd["tables"], sd["dtables"],
+                    sd["nalloc"], sd["dnalloc"], sd["active"],
+                    sd["keys"], jnp.asarray(self._sctr), sd["temps"],
+                    sd["topk"], sd["topp"])
+        out_host = np.asarray(out_toks)   # the window's ONE host sync
+        acc_host = np.asarray(n_acc)
+        emit_recs, emit_counts = [], []
+        retires: list[tuple[int, str]] = []
+        total_acc = total_emit = 0
+        for slot in live:
+            row = self._slot_state[slot]
+            remaining = row.max_new - len(row.emitted)
+            a = int(acc_host[slot])
+            toks = [int(t) for t in out_host[slot, :min(a + 1,
+                                                        remaining)]]
+            reason = None
+            if row.stop_token >= 0 and row.stop_token in toks:
+                # Stop mid-window: commit through the stop token only
+                # (the tail past it was never part of the sequence).
+                toks = toks[:toks.index(row.stop_token) + 1]
+                reason = "stop"
+            row.emitted.extend(toks)
+            n = len(toks)
+            self._pos[slot] += n
+            self._eidx[slot] += n
+            self._tok[slot] = toks[-1]
+            # Draft KV is correct through the accepted prefix; the
+            # new position's token (this window's corrected/bonus, or
+            # a rejected slot's overwrite) is written by the NEXT
+            # window's first draft step.
+            self._dpos[slot] = self._pos[slot]
+            self._sctr[slot] += W + 1
+            total_acc += a
+            total_emit += n
+            emit_recs.append(row.rec)
+            emit_counts.append(n)
+            if reason is None and len(row.emitted) >= row.max_new:
+                reason = "complete"
+            if reason is not None:
+                retires.append((slot, reason))
+        self.ledger.tokens_emitted(emit_recs, emit_counts)
+        rate = total_acc / max(1, k_eff * len(live))
+        al = self._spec.ewma_alpha
+        self._spec_ewma = (rate if self._spec_windows == 0
+                           else al * rate + (1 - al) * self._spec_ewma)
+        self._spec_windows += 1
+        self.ledger.spec_window(k_eff * len(live), total_acc,
+                                total_emit, self._spec_ewma)
+        if meter is not None:
+            meter.decode_tokens = total_emit
+        chaos.note_ok("serve.spec")
+        for slot, reason in retires:
+            self._retire(slot, reason)
+        if self._spec.adaptive:
+            self._spec_adapt()
+        # Ragged per-slot advances: the host copy is authoritative.
+        self._dev = None
+        if self._steps % 32 == 0:
+            self._export_gauges()
+
+    def check_spec_reservations(self) -> list[str]:
+        """Audit both pools' reservation discipline against the
+        worst-case speculative advance of every live row (the ISSUE 12
+        :meth:`BlockPool.check_invariants` extension). Call from the
+        engine thread (tests wrap ``_spec_step``) — row state is
+        mid-mutation on any other thread."""
+        if self._spec is None:
+            return []
+        rows_t, rows_d = [], []
+        for slot in np.flatnonzero(self._active):
+            row = self._slot_state.get(int(slot))
+            if row is None:
+                continue
+            remaining = row.max_new - len(row.emitted)
+            adv = min(self._k_cur, max(0, remaining - 1)) + 1
+            rows_t.append((int(self._pos[slot]),
+                           int(self._nalloc[slot]),
+                           row.reserve_left, adv))
+            rows_d.append((int(self._pos[slot]),
+                           int(self._dnalloc[slot]),
+                           row.draft_reserve_left, adv))
+        bad = self.pool.check_invariants(spec_rows=rows_t)
+        bad += [f"draft: {b}"
+                for b in self._dpool.check_invariants(
+                    spec_rows=rows_d)]
+        return bad
+
     def _retire(self, slot: int, reason: str = "complete") -> None:
         self._active[slot] = False
         self._temps[slot] = 0.0
         self._dev = None  # slot state changed: re-upload next step
+        self._sdev = None
         self._finish_row(self._slot_state.pop(slot), reason)
         self._export_gauges()
 
@@ -685,6 +1142,13 @@ class PagedGeneratorActor(GeneratorActor):
         if row.reserve_left > 0:
             self.pool.unreserve(row.reserve_left)
         row.reserve_left = 0
+        if self._dpool is not None:
+            for bid in row.draft_table:
+                self._dpool.deref(bid)
+            row.draft_table = []
+            if row.draft_reserve_left > 0:
+                self._dpool.unreserve(row.draft_reserve_left)
+            row.draft_reserve_left = 0
         self.ledger.retired(row.rec, reason)
         row.done.set()
 
@@ -738,6 +1202,27 @@ class PagedGeneratorActor(GeneratorActor):
         # tracker (sequence-tagged so probes never double-count).
         info.update(self.ledger.summary())
         info["ttft_recent"] = self.ledger.ttft_recent()
+        if self._spec is not None:
+            # Speculation surface (ISSUE 12): the accept rate the
+            # gateway probes carry fleet-wide (same plumbing as
+            # kv_free_blocks / prefix_hit_rate) plus the adaptive-k
+            # state an operator diagnoses a collapse with. Totals
+            # come from the ledger (the one accumulation home).
+            prop, acc, toks = self.ledger.spec_totals()
+            info["spec_k"] = int(self._spec.k)
+            info["spec_k_cur"] = self._k_cur
+            info["spec_windows"] = self._spec_windows
+            info["spec_proposed"] = prop
+            info["spec_accepted"] = acc
+            info["spec_tokens"] = toks
+            if prop:
+                # Only once speculation actually RAN: the gateway
+                # snapshot / runbook contract distinguishes "never
+                # speculated" (absent, renders "-") from "collapsed
+                # to 0" — a fresh idle replica must not fake a 0.0.
+                info["spec_accept_rate"] = round(acc / prop, 4)
+            info["spec_accept_ewma"] = round(self._spec_ewma, 4)
+            info["kv_draft_free_blocks"] = self._dpool.free_blocks()
         return info
 
     def close(self) -> None:

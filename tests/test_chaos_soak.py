@@ -429,12 +429,19 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
       the gateway re-routes its typed sheds to siblings (no request
       lost, the shedding replica not evicted), and later successful
       admissions beacon the recoveries;
+    - replica 1 runs SPECULATIVE decoding (ISSUE 12), and the
+      ``serve.spec`` seam force-rejects speculation windows / delays
+      the draft forward mid-soak: a replica with poisoned speculation
+      still serves correct tokens (the poisoned iteration falls back
+      to the plain decode step — just slower), and committed windows
+      beacon the paired recoveries;
     - gateway-path fault firings (admit sheds, route vetoes, dropped
       sends) land as chaos.fault span events on the afflicted
       request's gateway.request trace (ISSUE 4).
     """
     from unittest import mock
 
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -480,10 +487,25 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
         FaultSpec("serve.admit", "shed", after=2, times=2),
         FaultSpec("serve.admit", "delay", after=8, times=1,
                   delay_s=0.02),
+        # The speculation seam (ISSUE 12): poisoned windows fall back
+        # to the plain step — the replica keeps serving correct
+        # tokens, just slower — and committed windows pair.
+        FaultSpec("serve.spec", "reject", after=1, times=2),
+        FaultSpec("serve.spec", "delay", after=6, times=1,
+                  delay_s=0.01),
     ], seed=3, name="gateway-soak"))
+    from ptype_tpu.models import generate as gen_mod
+    from ptype_tpu.serve_engine import SpecConfig
+
+    tiny = tfm.preset("tiny", dtype=jnp.float32)
+    spec_params = jax.jit(
+        lambda r: tfm.init_params(r, tiny))(jax.random.PRNGKey(0))
+    draft_params, draft_cfg = gen_mod.truncated_draft_params(
+        spec_params, tiny, n_layers=1)
     paged = PagedGeneratorActor(
-        tfm.preset("tiny", dtype=jnp.float32), n_slots=4,
-        block_tokens=16)
+        tiny, params=spec_params, n_slots=4, block_tokens=16,
+        spec=SpecConfig(draft_params=draft_params,
+                        draft_cfg=draft_cfg, k=3, adaptive=False))
     actors, servers, regs = [], [], []
     gw = None
     # Real TCP end to end: the in-process fast path has no socket for

@@ -679,3 +679,97 @@ def test_serve_engine_package_is_pt010_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt010 = [f for f in findings if "PT010" in f]
     assert not pt010, pt010
+
+
+# --------------------------------------------------------------- PT011
+
+
+PT011_RAW_SAMPLING = (
+    "import jax\n"
+    "def pick(key, logits):\n"
+    "    a = jax.random.categorical(key, logits)\n"
+    "    g = jax.random.gumbel(key, logits.shape)\n"
+    "    return a, g\n")
+
+
+def test_pt011_flags_raw_sampling_in_serve_engine(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sneak.py",
+                      PT011_RAW_SAMPLING)
+    assert sum("PT011" in f for f in findings) == 2, findings
+
+
+def test_pt011_flags_aliased_and_from_import_forms(tmp_path):
+    src = ("from jax import random\n"
+           "import jax.random as jr\n"
+           "from jax.random import categorical as cat, gumbel\n"
+           "def pick(key, lg):\n"
+           "    a = random.categorical(key, lg)\n"
+           "    b = jr.gumbel(key, lg.shape)\n"
+           "    c = cat(key, lg)\n"
+           "    d = gumbel(key, lg.shape)\n"
+           "    return a, b, c, d\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/forms.py", src)
+    assert sum("PT011" in f for f in findings) == 4, findings
+
+
+def test_pt011_silent_outside_serve_engine(tmp_path):
+    # models/generate.py IS the RNG home; tests/examples sample
+    # deliberately.
+    for rel in ("ptype_tpu/models/generate.py", "ptype_tpu/serve.py",
+                "tests/t11.py", "examples/demo11.py"):
+        findings = _check(tmp_path, rel, PT011_RAW_SAMPLING)
+        assert not any("PT011" in f for f in findings), (rel, findings)
+
+
+def test_pt011_ignores_non_sampling_random_apis(tmp_path):
+    # fold_in/PRNGKey/uniform are key plumbing, not the acceptance
+    # draws the rule guards; np.random-style .choice is unrelated.
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "def keys(seed, rng):\n"
+           "    k = jax.random.fold_in(jax.random.PRNGKey(seed), 1)\n"
+           "    u = jax.random.uniform(k, (4,))\n"
+           "    return k, u, rng.choice(4)\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/ok11.py", src)
+    assert not any("PT011" in f for f in findings), findings
+
+
+def test_pt011_ignores_unrelated_receivers(tmp_path):
+    # A bare name not bound to jax.random, a .gumbel attr on a
+    # non-random base, and NON-jax `*.random` chains (np.random's
+    # legacy sampling API) are not flagged — the rule guards the jax
+    # RNG the exactness contract rides, conservatively.
+    src = ("import numpy as np\n"
+           "def f(rng, dist):\n"
+           "    a = rng.categorical(3)\n"
+           "    b = dist.gumbel()\n"
+           "    c = np.random.gumbel()\n"
+           "    return a, b, c\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sim11.py", src)
+    assert not any("PT011" in f for f in findings), findings
+
+
+def test_pt011_honors_noqa(tmp_path):
+    src = ("import jax\n"
+           "def pick(key, lg):\n"
+           "    return jax.random.categorical(key, lg)  # noqa: ok\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sup11.py", src)
+    assert not any("PT011" in f for f in findings), findings
+
+
+def test_serve_engine_package_is_pt011_clean():
+    """Every sampling draw behind the speculative path lives in
+    models/generate.py's contract-tested helpers (ISSUE 12): no
+    direct categorical/gumbel calls in serve_engine/."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "serve_engine")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt011 = [f for f in findings if "PT011" in f]
+    assert not pt011, pt011

@@ -43,6 +43,14 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   ±127 saturate, sub-1 magnitudes round to zero); int8 wires must go
   through the block-scaled quantizers (``_q_int8_blockwise`` /
   ``quantize_leaf``), which pair every payload with its absmax scales
+- PT011 (ptype_tpu/serve_engine/ only): a direct
+  ``jax.random.categorical`` / ``jax.random.gumbel`` sampling call
+  (bare, module-aliased, or from-imported) — acceptance sampling has
+  ONE RNG home, models/generate.py's sampling helpers
+  (``sample_token_rows`` / ``draft_propose_paged`` /
+  ``spec_accept_rows``); an ad-hoc draw beside them silently rots the
+  exactness contract (greedy bit-parity, residual-acceptance
+  distribution) those helpers are contract-tested for
 - PT010 (ptype_tpu/serve_engine/ only): a raw ``time.perf_counter()``
   / ``time.time()`` call (bare, module-aliased, or from-imported) —
   the engine's latency math lives in exactly one place, the serving
@@ -634,6 +642,74 @@ class _RawTimerCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawSamplingCheck(ast.NodeVisitor):
+    """PT011: ``jax.random.categorical`` / ``jax.random.gumbel``
+    anywhere in ptype_tpu/serve_engine/ — the ``*.random.<verb>``
+    attribute chain (``jax.random.categorical(...)``), a module alias
+    (``from jax import random``, ``import jax.random as jr``), or a
+    from-import (``from jax.random import categorical [as c]``).
+    Acceptance sampling must have exactly one RNG home —
+    models/generate.py's sampling helpers (``sample_token_rows``,
+    ``draft_propose_paged``, ``spec_accept_rows``), whose draw-for-draw
+    and residual-acceptance contracts are what the spec-decoding
+    exactness tests pin; a raw draw in the engine beside them is
+    unpriced drift the contract tests can't see."""
+
+    _VERBS = frozenset({"categorical", "gumbel"})
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+        #: Local names bound to the jax.random module.
+        self.rand_mods: set[str] = set()
+        #: Local name → original verb for from-imports.
+        self.funcs: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "jax.random" and a.asname:
+                self.rand_mods.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    self.rand_mods.add(a.asname or "random")
+        elif node.module == "jax.random":
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.funcs[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, verb: str) -> None:
+        self.findings.append(
+            f"{self.path}:{node.lineno}: PT011 direct jax.random."
+            f"{verb} sampling in serve_engine/ — acceptance sampling "
+            f"has one RNG home (models/generate.py: sample_token_rows/"
+            f"draft_propose_paged/spec_accept_rows, the contract-"
+            f"tested helpers); a raw draw here silently rots the "
+            f"exact-distribution contract")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in self._VERBS:
+            base = fn.value
+            if (isinstance(base, ast.Attribute)
+                    and base.attr == "random"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "jax"):
+                self._flag(node, fn.attr)   # jax.random.categorical
+                # (rooted at `jax` only — np.random.gumbel and other
+                # *.random receivers are not the guarded RNG)
+            elif (isinstance(base, ast.Name)
+                    and base.id in self.rand_mods):
+                self._flag(node, fn.attr)   # random.categorical / jr.
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node, self.funcs[fn.id])
+        self.generic_visit(node)
+
+
 class _SleepInLoopCheck(ast.NodeVisitor):
     """PT002: ``time.sleep`` (any ``time``/``_time`` alias) inside a
     loop body. Fixed-interval sleeps in retry/poll loops are the
@@ -713,6 +789,10 @@ def check_file(path: str, findings: list[str]) -> None:
         # timing home: raw timers beside its seams drift from the
         # histograms/spans and escape the seam-cost overhead probe.
         _RawTimerCheck(path, raw).visit(tree)
+        # models/generate.py's sampling helpers are the one RNG home:
+        # an ad-hoc categorical/gumbel draw in the engine rots the
+        # speculative-decoding exactness contract silently.
+        _RawSamplingCheck(path, raw).visit(tree)
     if ("ptype_tpu" in parts and "serve_engine" not in parts
             and "models" not in parts):
         # serve_engine/ IS the paged pool; models/ holds init_cache
