@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench spec-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench scale-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -33,6 +33,15 @@ serve-bench:
 # serve-bench tail.
 spec-bench:
 	JAX_PLATFORMS=cpu python bench.py --spec
+
+# Elastic-reconciler microbench (docs/OPERATIONS.md "Elastic
+# serving"): a reconciler-managed fleet behind the gateway — the JSON
+# tail carries scale_up_latency_s (first shed -> new replica
+# answering, the spike-to-capacity lag) and drain_lost_requests
+# (graceful drain under continuous traffic; the bar is 0) — the
+# ISSUE 13 acceptance numbers.
+scale-bench:
+	JAX_PLATFORMS=cpu python bench.py --scale
 
 # Gradient-wire microbench on the 8-device virtual host mesh
 # (docs/PERF.md "Quantized + overlapped collectives"): bucketed

@@ -45,6 +45,15 @@ operations need. Commands:
                per-replica TTFT/TPOT/e2e tails, queue + batch
                occupancy, and KV-pool pressure from the serving
                ledger ($TOP_ITERS bounds refreshes; ^C exits).
+- ``obs scale`` — LIVE elastic-fleet view (ISSUE 13): re-pull the
+               cluster telemetry every $TOP_INTERVAL and repaint
+               every reconciler's desired-vs-actual fleet size, warm/
+               draining/pending counts, and decision/spawn/drain/
+               escalation counters, plus every serving replica's
+               lifecycle state (spawning/warm/active/draining) —
+               the autoscaling loop and its effect in one screen
+               ($TOP_ITERS bounds refreshes; ^C exits).
+               docs/OPERATIONS.md "Elastic serving" has the runbook.
 - ``obs profile`` — cluster-wide device profiling: simultaneous
                jax.profiler XPlane capture on every registered node
                via the built-in ptype.Profile endpoint
@@ -105,13 +114,18 @@ def _join() -> None:
 def _serve() -> None:
     import os
 
-    from ptype_tpu import ActorServer, config_from_env, join
+    from ptype_tpu import config_from_env, join
     from ptype_tpu.models import transformer as tfm
+    # Replica lifecycle has ONE home (lint PT012): the server that
+    # fronts a serving replica is constructed by reconciler/replica.py
+    # — the same code path the elastic reconciler's spawned workers
+    # use, so an operator-launched replica and an autoscaled one are
+    # the same thing.
+    from ptype_tpu.reconciler.replica import serve_actor
     from ptype_tpu.serve import BatchingGeneratorActor
 
     cfg = config_from_env()
     model_cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
-    server = ActorServer(port=cfg.port)
     # $SERVE_MODE=continuous: slot-based continuous batching (requests
     # join/leave the one running decode loop at step boundaries;
     # $SERVE_SLOTS caches). Default: dynamic batching — concurrent
@@ -129,8 +143,7 @@ def _serve() -> None:
             model_cfg,
             window_ms=float(os.environ.get("SERVE_WINDOW_MS", "5")),
             max_batch=int(os.environ.get("SERVE_MAX_BATCH", "32")))
-    server.register(actor, "Generator")
-    server.serve()
+    server = serve_actor(actor, "Generator", port=cfg.port)
     cfg.port = server.port
     cluster = join(cfg)
     print(f"serving Generator.{{Generate,Logits,Info}} on :{server.port}",
@@ -379,6 +392,17 @@ def _obs() -> None:
 
             try:
                 run_serve(CoordRegistry(coord),
+                          iters=int(os.environ.get("TOP_ITERS", "0")),
+                          interval_s=float(
+                              os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "scale":
+            from ptype_tpu.health import run_scale
+
+            try:
+                run_scale(CoordRegistry(coord),
                           iters=int(os.environ.get("TOP_ITERS", "0")),
                           interval_s=float(
                               os.environ.get("TOP_INTERVAL", "2")))

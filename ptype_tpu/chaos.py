@@ -35,6 +35,13 @@ site                    actions
                         iteration falls back to the plain decode step:
                         correct tokens, just slower) / ``delay`` (stall
                         the draft forward) (serve_engine)
+``scale.spawn``         ``fail`` (the replica process/host dies before it
+                        comes up — the reconciler retries next tick) /
+                        ``delay`` (slow spawn) (reconciler/replica.py)
+``scale.drain``         ``wedge`` (hold a drain open past ``delay_s`` —
+                        drive it past its deadline so the reconciler's
+                        escalation path fires) / ``delay``
+                        (reconciler/replica.py)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

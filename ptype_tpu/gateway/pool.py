@@ -110,6 +110,17 @@ class Replica:
             v = self.reported.get("kv_free_blocks")
             return None if v is None else int(v)
 
+    def lifecycle(self) -> str | None:
+        """Replica-reported lifecycle (ISSUE 13: spawning/warm/active/
+        draining — the reconciler's state machine, surfaced through
+        ``Info()``). None until a probe observes it. A draining
+        replica sheds every new request typed, so routing sorts it
+        last and affinity yields past it — the same treatment as an
+        exhausted KV pool."""
+        with self.lock:
+            v = self.reported.get("lifecycle")
+            return None if v is None else str(v)
+
     def snapshot(self) -> dict:
         with self.lock:
             snap = {"key": self.key, "up": self.up,
@@ -123,6 +134,11 @@ class Replica:
                         int(self.reported.get("queue_depth", 0) or 0),
                     "reported_in_flight":
                         int(self.reported.get("in_flight", 0) or 0)}
+            # Lifecycle column (ISSUE 13): the fleet view matches the
+            # reconciler's state machine — only when reported, so a
+            # bare actor with no lifecycle story stays distinguishable.
+            if "lifecycle" in self.reported:
+                snap["lifecycle"] = str(self.reported["lifecycle"])
             # Paged-engine load signal (ISSUE 9): pool headroom and
             # prefix-cache effectiveness, when the replica reports it.
             if "kv_free_blocks" in self.reported:
@@ -391,11 +407,13 @@ class ReplicaPool:
             fresh = [r for r in candidates if r.key not in exclude]
             if fresh:
                 candidates = fresh
-        # An exhausted KV pool (kv_free_blocks == 0) sorts LAST: any
-        # request routed there earns a typed shed, so a replica with
-        # headroom wins at any latency score; non-paged replicas
-        # report None and are unaffected.
-        candidates.sort(key=lambda r: (r.kv_free_blocks() == 0,
+        # A DRAINING replica (lifecycle, ISSUE 13) and an exhausted KV
+        # pool (kv_free_blocks == 0) both sort LAST: any request
+        # routed there earns a typed shed, so a replica that can
+        # actually serve wins at any latency score; replicas that
+        # report neither signal are unaffected.
+        candidates.sort(key=lambda r: (r.lifecycle() == "draining",
+                                       r.kv_free_blocks() == 0,
                                        r.score(), r.key))
         chosen = candidates[0]
         if affinity_key is not None and len(candidates) > 1:
@@ -408,7 +426,11 @@ class ReplicaPool:
             # admission headroom): routing there earns a typed shed,
             # not a cache hit — a cold miss on a replica with room
             # strictly beats it.
-            exhausted = pinned.kv_free_blocks() == 0
+            # ... and when the pinned replica is DRAINING: its warm
+            # prefix cache is about to be freed anyway, and every
+            # request routed there sheds.
+            exhausted = (pinned.kv_free_blocks() == 0
+                         or pinned.lifecycle() == "draining")
             if (not exhausted
                     and pinned.score()
                     <= chosen.score() * self.affinity_slack + 10.0):

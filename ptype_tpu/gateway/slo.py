@@ -5,9 +5,11 @@ count), shed (by cause), expired, or errored. The tracker exports the
 serving tail through the shared :class:`~ptype_tpu.metrics
 .MetricsRegistry` (counters, gauges, and a latency histogram with
 p50/p95/p99) and distills the state into a :class:`ScaleHint` — the
-one-number signal an elastic layer (ptype_tpu.elastic, an operator
-loop, or an external autoscaler polling ``Gateway.Info``) can consume
-without understanding the gateway's internals.
+one-number signal the elastic replica reconciler
+(:mod:`ptype_tpu.reconciler`, which polls ``gateway.scale_hint`` and
+folds it through its hysteresis policy) or an external autoscaler
+polling ``Gateway.Info`` consumes without understanding the
+gateway's internals.
 
 Metric names (under the process-global registry by default):
 

@@ -33,7 +33,8 @@ from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
                                      telemetry_endpoint)
 from ptype_tpu.health.serving import (RequestRecord, ServingLedger,
                                       measure_seam_cost_us)
-from ptype_tpu.health.top import (render_serve, render_top, run_serve,
+from ptype_tpu.health.top import (render_scale, render_serve,
+                                  render_top, run_scale, run_serve,
                                   run_top)
 
 __all__ = [
@@ -49,4 +50,5 @@ __all__ = [
     "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
     "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
+    "render_scale", "run_scale",
 ]

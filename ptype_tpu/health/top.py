@@ -92,6 +92,23 @@ def _hist(telem: dict, name: str) -> dict:
         or {}
 
 
+#: Code → name for the ``serve.lifecycle`` gauge (metric series carry
+#: floats; the reconciler's state machine carries names). Mirrors
+#: ``ptype_tpu.serve.LIFECYCLES`` — kept inline so the operator views
+#: stay importable without the serving stack; a test pins the two in
+#: sync.
+_LIFECYCLE_NAMES = ("spawning", "warm", "active", "draining",
+                    "drained")
+
+
+def _lifecycle_name(code) -> str | None:
+    if code is None:
+        return None
+    i = int(code)
+    return (_LIFECYCLE_NAMES[i] if 0 <= i < len(_LIFECYCLE_NAMES)
+            else "?")
+
+
 def render_serve(snapshot: dict, alerts=(),
                  max_nodes: int = 32) -> str:
     """``obs serve``: the serving-plane one-pager — per-replica
@@ -110,9 +127,10 @@ def render_serve(snapshot: dict, alerts=(),
         f"ptype serving @ {snapshot.get('ts')} — "
         f"{len(serving)} serving replicas "
         f"({len(nodes)} nodes, {len(errors)} unreachable)",
-        f"{'replica':<28} {'ttft99':>8} {'tpot':>7} {'e2e99':>8} "
-        f"{'q':>4} {'live':>5} {'kvfree':>7} {'util%':>6} "
-        f"{'hit%':>6} {'spec%':>6} {'evic':>6} {'stall':>7}",
+        f"{'replica':<28} {'state':>9} {'ttft99':>8} {'tpot':>7} "
+        f"{'e2e99':>8} {'q':>4} {'live':>5} {'kvfree':>7} "
+        f"{'util%':>6} {'hit%':>6} {'spec%':>6} {'evic':>6} "
+        f"{'stall':>7}",
     ]
 
     def num(v, fmt="{:.1f}", dash="-"):
@@ -135,8 +153,12 @@ def render_serve(snapshot: dict, alerts=(),
         evic = (t.get("metrics", {}).get("counters", {})
                 .get("kv.evictions"))
         stall = _gauge(t, "serve.stall_ms")
+        # Lifecycle column (ISSUE 13): the fleet view matches the
+        # reconciler's state machine; "-" = the replica predates the
+        # lifecycle story (no serve.lifecycle gauge).
+        state = _lifecycle_name(_gauge(t, "serve.lifecycle")) or "-"
         lines.append(
-            f"{key[:28]:<28} {num(ttft, '{:.0f}'):>7}m "
+            f"{key[:28]:<28} {state:>9} {num(ttft, '{:.0f}'):>7}m "
             f"{num(tpot):>6}m {num(e2e, '{:.0f}'):>7}m "
             f"{num(q, '{:.0f}'):>4} {num(live, '{:.0f}'):>5} "
             f"{num(free, '{:.0f}'):>7} {num(util):>6} "
@@ -159,6 +181,95 @@ def render_serve(snapshot: dict, alerts=(),
     else:
         lines.append("no alerts")
     return "\n".join(lines)
+
+
+def render_scale(snapshot: dict, alerts=(),
+                 max_nodes: int = 32) -> str:
+    """``obs scale``: the elastic-fleet one-pager (ISSUE 13). Top:
+    every node exporting ``scale.*`` gauges (the reconcilers) with
+    desired vs actual, warm/draining/pending counts, and the
+    lifetime decision/spawn/drain/escalation counters. Below: every
+    serving replica with its lifecycle state and queue/live occupancy
+    — the same fleet the reconciler is steering, so a scale decision
+    and its effect sit in one screen."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+    recs = {k: t for k, t in nodes.items()
+            if _gauge(t, "scale.desired") is not None}
+    serving = {k: t for k, t in nodes.items()
+               if _gauge(t, "serve.lifecycle") is not None
+               or _hist(t, "serve.ttft_ms")}
+
+    def num(v, fmt="{:.0f}", dash="-"):
+        return fmt.format(v) if v is not None else dash
+
+    def cnt(t, name):
+        return t.get("metrics", {}).get("counters", {}).get(name)
+
+    lines = [
+        f"ptype scale @ {snapshot.get('ts')} — {len(recs)} "
+        f"reconcilers, {len(serving)} serving replicas "
+        f"({len(nodes)} nodes, {len(errors)} unreachable)",
+        f"{'reconciler':<28} {'want':>5} {'have':>5} {'warm':>5} "
+        f"{'drng':>5} {'pend':>5} {'dec':>5} {'spawn':>6} "
+        f"{'drain':>6} {'esc':>4} {'dead':>5} {'fail':>5}",
+    ]
+    for key in sorted(recs)[:max_nodes]:
+        t = recs[key]
+        lines.append(
+            f"{key[:28]:<28} {num(_gauge(t, 'scale.desired')):>5} "
+            f"{num(_gauge(t, 'scale.actual')):>5} "
+            f"{num(_gauge(t, 'scale.warm')):>5} "
+            f"{num(_gauge(t, 'scale.draining')):>5} "
+            f"{num(_gauge(t, 'scale.pending_spawns')):>5} "
+            f"{num(cnt(t, 'scale.decisions')):>5} "
+            f"{num(cnt(t, 'scale.spawns')):>6} "
+            f"{num(cnt(t, 'scale.drains')):>6} "
+            f"{num(cnt(t, 'scale.drain_escalations')):>4} "
+            f"{num(cnt(t, 'scale.deaths')):>5} "
+            f"{num(cnt(t, 'scale.spawn_failures')):>5}")
+    if not recs:
+        lines.append("  (no node exports scale.* — no reconciler "
+                     "running, or its telemetry is not registered)")
+    lines.append("")
+    lines.append(f"{'replica':<28} {'state':>9} {'q':>4} {'live':>5} "
+                 f"{'kvfree':>7} {'ttft99':>8}")
+    for key in sorted(serving)[:max_nodes]:
+        t = serving[key]
+        state = _lifecycle_name(_gauge(t, "serve.lifecycle")) or "-"
+        lines.append(
+            f"{key[:28]:<28} {state:>9} "
+            f"{num(_gauge(t, 'serve.queue_depth')):>4} "
+            f"{num(_gauge(t, 'serve.active_slots')):>5} "
+            f"{num(_gauge(t, 'serve.kv_free_blocks')):>7} "
+            f"{num(_hist(t, 'serve.ttft_ms').get('p99')):>7}m")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
+def run_scale(registry, iters: int = 0, interval_s: float = 2.0,
+              engine: AlertEngine | None = None,
+              services: list[str] | None = None,
+              include_local: bool = False, out=None,
+              clear: bool = True) -> AlertEngine:
+    """The ``obs scale`` loop: :func:`run_top`'s poll contract with
+    the elastic-fleet rendering."""
+    return run_top(registry, iters=iters, interval_s=interval_s,
+                   engine=engine, services=services,
+                   include_local=include_local, out=out, clear=clear,
+                   render=render_scale)
 
 
 def run_serve(registry, iters: int = 0, interval_s: float = 2.0,

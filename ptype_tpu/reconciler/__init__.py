@@ -1,0 +1,37 @@
+"""Elastic replica lifecycle (ISSUE 13; ROADMAP item 1).
+
+The control loop that ACTS on the cluster's serving signals: the
+gateway's :class:`~ptype_tpu.gateway.slo.ScaleHint` stream and the
+health plane's pages drive a reconciler that spawns, drains, and
+replaces serving replicas — with hysteresis (cooldown + hint-majority
+voting), min/max bounds, warm standbys, and a drain-deadline
+escalation path. See docs/OPERATIONS.md "Elastic serving".
+
+- :mod:`~ptype_tpu.reconciler.policy` — the pure decision math;
+- :mod:`~ptype_tpu.reconciler.replica` — replica lifecycle's one home
+  (host, control endpoints, launchers; lint PT012);
+- :mod:`~ptype_tpu.reconciler.worker` — the OS-process replica entry;
+- :mod:`~ptype_tpu.reconciler.core` — the reconcile loop.
+"""
+
+from ptype_tpu.reconciler.core import (SCALE_UP_RULES, Reconciler,
+                                       ReconcilerConfig)
+from ptype_tpu.reconciler.policy import (URGENT_REASONS,
+                                         HysteresisPolicy,
+                                         ScaleDecision)
+from ptype_tpu.reconciler.replica import (FakeGeneratorActor,
+                                          LocalLauncher,
+                                          LocalReplicaHandle,
+                                          ProcessLauncher,
+                                          ProcessReplicaHandle,
+                                          ReplicaCtl, ReplicaHandle,
+                                          ReplicaHost, serve_actor)
+
+__all__ = [
+    "Reconciler", "ReconcilerConfig", "SCALE_UP_RULES",
+    "HysteresisPolicy", "ScaleDecision", "URGENT_REASONS",
+    "ReplicaHost", "ReplicaCtl", "ReplicaHandle",
+    "LocalReplicaHandle", "ProcessReplicaHandle",
+    "LocalLauncher", "ProcessLauncher", "FakeGeneratorActor",
+    "serve_actor",
+]

@@ -18,10 +18,19 @@ import jax.numpy as jnp
 
 from ptype_tpu import logs
 from ptype_tpu import metrics as metrics_mod
+from ptype_tpu.errors import ShedError
 from ptype_tpu.models import generate as gen
 from ptype_tpu.models import transformer as tfm
 
 log = logs.get_logger("serve")
+
+#: Replica lifecycle states (ISSUE 13): the reconciler's state machine,
+#: reported through ``Info()`` so the gateway pool's snapshots and
+#: ``obs serve``/``obs scale`` render the same view the reconciler
+#: acts on. Numeric codes back the ``serve.lifecycle`` gauge (metric
+#: series carry floats; the views map them back).
+LIFECYCLES = ("spawning", "warm", "active", "draining", "drained")
+LIFECYCLE_CODES = {name: i for i, name in enumerate(LIFECYCLES)}
 
 
 def _norm_prompt(prompt) -> jnp.ndarray:
@@ -53,6 +62,11 @@ class GeneratorActor:
         #: and Info() must answer while one is in flight.
         self._load_lock = threading.Lock()
         self._in_flight = 0
+        #: Replica lifecycle (ISSUE 13): "active" for a bare actor;
+        #: the reconciler's ReplicaHost moves it through spawning →
+        #: warm → active, and :meth:`begin_drain` to "draining".
+        self.lifecycle = "active"
+        self._draining = False
         self._forward = jax.jit(
             lambda p, t: tfm.forward(p, t, self.cfg))
 
@@ -64,6 +78,37 @@ class GeneratorActor:
         with self._load_lock:
             self._in_flight -= 1
 
+    # ------------------------------------------------------------- drain
+
+    def _check_draining(self) -> None:
+        """The drain gate: a draining replica refuses NEW work with a
+        typed shed (the gateway's frontdoor re-routes it to a sibling
+        — no eviction, no lost request) while already-admitted work
+        runs to completion. MUST be called AFTER ``_enter_request``
+        (inside its try/finally): a request checked before it is
+        counted could pass the gate, get preempted, and be invisible
+        to ``drained()`` — the replica would deregister and exit with
+        the request still executing, exactly the lost request the
+        drain contract forbids."""
+        if self._draining:
+            raise ShedError("replica draining (scale-down in "
+                            "progress); route elsewhere",
+                            retry_after_s=0.05)
+
+    def begin_drain(self) -> None:
+        """Stop admitting; in-flight requests finish normally. The
+        reconciler (or operator) polls :meth:`drained` and
+        deregisters/exits the replica once it reports True."""
+        self._draining = True
+        self.lifecycle = "draining"
+        log.info("replica draining", kv={"in_flight": self._in_flight})
+
+    def drained(self) -> bool:
+        """True once a drain was requested AND no request is in
+        flight — the point where deregister-and-exit loses nothing."""
+        with self._load_lock:
+            return self._draining and self._in_flight == 0
+
     def Generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0,
                  top_k: int = 0, top_p: float = 1.0,
@@ -73,6 +118,7 @@ class GeneratorActor:
         prompt = _norm_prompt(prompt)
         self._enter_request()
         try:
+            self._check_draining()
             with self._lock:
                 self._calls += 1
                 out = gen.generate(
@@ -91,6 +137,7 @@ class GeneratorActor:
         tokens = _norm_prompt(tokens)
         self._enter_request()
         try:
+            self._check_draining()
             with self._lock:
                 return self._forward(self.params, tokens)
         finally:
@@ -106,6 +153,11 @@ class GeneratorActor:
             "vocab_size": self.cfg.vocab_size,
             "max_seq": self.cfg.max_seq,
             "calls": self._calls,
+            # Lifecycle (ISSUE 13): the reconciler's state machine,
+            # surfaced so the gateway pool's snapshots (and `obs
+            # serve`) render the same fleet view the reconciler acts
+            # on — routing sorts draining replicas last.
+            "lifecycle": self.lifecycle,
             # Load telemetry (the gateway's least-loaded signal): the
             # serialized actor's backlog is everyone parked on _lock.
             "in_flight": in_flight,
@@ -186,6 +238,7 @@ class BatchingGeneratorActor(GeneratorActor):
         req = _Pending(_norm_prompt(prompt), int(max_new_tokens))
         self._enter_request()
         try:
+            self._check_draining()
             with self._cond:
                 if self._closed:
                     raise RuntimeError("generator actor is closed")

@@ -61,7 +61,8 @@ from ptype_tpu.errors import ShedError
 from ptype_tpu.health.serving import ServingLedger
 from ptype_tpu.models import generate as gen
 from ptype_tpu.models import transformer as tfm
-from ptype_tpu.serve import GeneratorActor, _norm_prompt, _pow2
+from ptype_tpu.serve import (LIFECYCLE_CODES, GeneratorActor, _norm_prompt,
+                             _pow2)
 from ptype_tpu.serve_engine.blocks import BlockPool, block_hashes
 
 log = logs.get_logger("serve_engine")
@@ -367,32 +368,46 @@ class PagedGeneratorActor(GeneratorActor):
             raise ValueError(
                 f"request needs {blocks_per_row} blocks; pool holds "
                 f"{self.pool.capacity}")
-        # The admission seam: chaos can force a shed/delay here; real
-        # sheds (queue full) ride the same typed contract.
-        f = chaos.hit("serve.admit", f"rows={prompt.shape[0]}")
-        if f is not None:
-            if f.action == "delay":
-                f.sleep()
-            elif f.action == "shed":
-                self.ledger.shed_untracked()
-                raise ShedError("chaos: serve.admit shed",
-                                retry_after_s=self._retry_after())
-        key = (np.asarray(jax.random.PRNGKey(int(seed)))
-               if float(temperature) != 0.0
-               else np.zeros(2, np.uint32))
-        rows = [_PagedRow(np.asarray(prompt[i]), max_new,
-                          int(stop_token), float(temperature),
-                          int(top_k), float(top_p), key)
-                for i in range(prompt.shape[0])]
-        # One traceparent per call: the actor handler span (when the
-        # request arrived over a traced RPC) — the synthesized
-        # admit/prefill/decode span tree parents under it, which is
-        # what stitches gateway.request → ... → serve.decode.
-        tp = trace.traceparent()
-        for r in rows:
-            r.rec = self.ledger.enqueued(len(r.prompt), max_new, tp=tp)
         self._enter_request()
         try:
+            # The drain seam (ISSUE 13): a draining replica refuses
+            # NEW work typed — the frontdoor re-routes to a sibling —
+            # while the engine runs already-admitted rows to
+            # completion. Checked INSIDE _enter_request (see its
+            # docstring): a request must be counted in in_flight
+            # before it passes the gate, or drained() could flip true
+            # with this request still executing.
+            if self._draining:
+                self.ledger.shed_untracked()
+                raise ShedError("replica draining (scale-down in "
+                                "progress); route elsewhere",
+                                retry_after_s=0.05)
+            # The admission seam: chaos can force a shed/delay here;
+            # real sheds (queue full) ride the same typed contract.
+            f = chaos.hit("serve.admit", f"rows={prompt.shape[0]}")
+            if f is not None:
+                if f.action == "delay":
+                    f.sleep()
+                elif f.action == "shed":
+                    self.ledger.shed_untracked()
+                    raise ShedError("chaos: serve.admit shed",
+                                    retry_after_s=self._retry_after())
+            key = (np.asarray(jax.random.PRNGKey(int(seed)))
+                   if float(temperature) != 0.0
+                   else np.zeros(2, np.uint32))
+            rows = [_PagedRow(np.asarray(prompt[i]), max_new,
+                              int(stop_token), float(temperature),
+                              int(top_k), float(top_p), key)
+                    for i in range(prompt.shape[0])]
+            # One traceparent per call: the actor handler span (when
+            # the request arrived over a traced RPC) — the
+            # synthesized admit/prefill/decode span tree parents
+            # under it, which is what stitches gateway.request → ...
+            # → serve.decode.
+            tp = trace.traceparent()
+            for r in rows:
+                r.rec = self.ledger.enqueued(len(r.prompt), max_new,
+                                             tp=tp)
             with self._lock:
                 self._calls += 1
             with self._cond:
@@ -1159,8 +1174,33 @@ class PagedGeneratorActor(GeneratorActor):
         if stall_ms > self._max_stall_ms:
             self._max_stall_ms = stall_ms
 
+    def begin_drain(self) -> None:
+        """Engine drain seam (ISSUE 13): flip the admission gate —
+        Generate sheds typed from here on — and let the engine loop
+        run the queue + live slots dry. Lifecycle lands in Info() and
+        the ``serve.lifecycle`` gauge so the gateway pool (which sorts
+        draining replicas last) and ``obs serve`` both see it."""
+        super().begin_drain()
+        self._export_gauges()
+
+    def drained(self) -> bool:
+        """True once draining AND nothing is admitted, queued, live
+        in a slot, or still blocked in a caller thread — the exact
+        point where deregister-and-exit loses zero requests."""
+        if not self._draining:
+            return False
+        with self._load_lock:
+            if self._in_flight:
+                return False
+        with self._cond:
+            if self._queue or self._admitting is not None:
+                return False
+        return not self._active.any()
+
     def _export_gauges(self) -> None:
         reg = self._reg
+        reg.gauge("serve.lifecycle").set(
+            LIFECYCLE_CODES.get(self.lifecycle, 2))
         st = self.pool.stats()
         reg.gauge("serve.kv_free_blocks").set(st["kv_free_blocks"])
         reg.gauge("serve.kv_util_pct").set(st["kv_util_pct"])

@@ -40,7 +40,7 @@ SLOW_FILES = {
     "test_models.py", "test_moe.py", "test_mp_train.py",
     "test_multihost_walkthrough.py",
     "test_overlap.py", "test_param_server.py", "test_pipeline.py",
-    "test_quantized_train.py",
+    "test_quantized_train.py", "test_reconciler_mp.py",
     "test_race.py", "test_resnet.py", "test_ring_attention.py",
     "test_scale.py", "test_serve.py", "test_store_bench.py",
     "test_tpu_smoke.py", "test_train.py", "test_zero_train.py",

@@ -620,6 +620,181 @@ def test_gateway_serves_through_replica_death_and_slow_replies(tmp_path):
             state.close()
 
 
+def test_elastic_soak_scale_seams_under_gateway_chaos():
+    """The elastic-fleet soak (ISSUE 13): a reconciler-managed fleet
+    behind the gateway over REAL sockets, under a seeded plan that
+    fails/delays spawns (``scale.spawn``), wedges a drain past its
+    deadline (``scale.drain``), sheds admissions and drops sends —
+    while the reconciler bootstraps the fleet, scales up on an urgent
+    vote, and scales down through the wedged drain. Invariants:
+
+    - zero requests lost: every request is answered or typed-shed;
+    - the failed spawn is retried next tick (the fleet still reaches
+      its bootstrap size);
+    - the wedged drain is ESCALATED at its deadline (victim killed,
+      fleet converges to the desired size anyway);
+    - every injected fault drains to a paired recovery
+      (``chaos.unrecovered() == {}``) — the scale-class faults pair
+      on later successful spawns and the escalation."""
+    from unittest import mock
+
+    import numpy as np
+
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.errors import ShedError
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.reconciler import (FakeGeneratorActor,
+                                      LocalLauncher, Reconciler,
+                                      ReconcilerConfig)
+    from ptype_tpu.registry import CoordRegistry
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    prompt = np.zeros((1, 4), np.int32)
+    mreg = MetricsRegistry()
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("scale.spawn", "fail", times=1),
+        FaultSpec("scale.spawn", "delay", after=1, times=1,
+                  delay_s=0.05),
+        FaultSpec("scale.drain", "wedge", times=1, delay_s=30.0),
+        FaultSpec("gateway.admit", "shed", after=4, times=2),
+        FaultSpec("gateway.route", "drop", after=6, times=2),
+        FaultSpec("rpc.send", "drop", match="Generator.Generate",
+                  after=8, times=2),
+    ], seed=13, name="elastic-soak"))
+    launcher = LocalLauncher(
+        registry, lambda: FakeGeneratorActor(delay_s=0.03),
+        service="llm-elastic")
+    rec = Reconciler(
+        registry, "llm-elastic", launcher,
+        cfg=ReconcilerConfig(min_replicas=2, max_replicas=4,
+                             cooldown_s=0.3, vote_quorum=1,
+                             tick_interval_s=0.05,
+                             drain_deadline_s=1.0),
+        metrics_registry=mreg)
+    gw = None
+    # Real TCP end to end: the in-process fast path has no socket for
+    # rpc.send faults to injure.
+    with mock.patch.object(actor_mod, "lookup_local",
+                           lambda a, p: None):
+        try:
+            # Bootstrap THROUGH the spawn chaos: attempt 1 dies, the
+            # next tick retries, the delay fault slows another — the
+            # fleet still reaches 2.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                rec.tick()
+                if len(registry.nodes("llm-elastic")) == 2:
+                    break
+                time.sleep(0.05)
+            assert len(registry.nodes("llm-elastic")) == 2
+            assert mreg.counter("scale.spawn_failures").value == 1
+
+            gw = InferenceGateway(
+                registry, "llm-elastic",
+                GatewayConfig(probe_interval_s=0.1,
+                              probe_timeout_s=1.0,
+                              default_deadline_s=8.0,
+                              max_queue_depth=32,
+                              per_replica_inflight=2,
+                              generate_method="Generator.Generate"))
+            deadline = time.monotonic() + 10
+            while (gw.pool.n_healthy() < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert gw.pool.n_healthy() == 2
+
+            answered, shed, lost = [], [], []
+
+            def fire(i):
+                try:
+                    out = gw.generate(prompt, 8)
+                    assert np.asarray(out).shape == (1, 8)
+                    answered.append(i)
+                except ShedError:
+                    shed.append(i)
+                except Exception as e:  # noqa: BLE001 — lost bucket
+                    lost.append((i, repr(e)))
+
+            class _Urgent:
+                delta, reason = 1, "shedding load (soak vote)"
+
+            stop_ticks = threading.Event()
+
+            def tick_loop():
+                while not stop_ticks.is_set():
+                    rec.tick()
+                    stop_ticks.wait(0.05)
+
+            ticker = threading.Thread(target=tick_loop, daemon=True)
+            ticker.start()
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(36)]
+            for t in threads[:12]:
+                t.start()
+            # Mid-traffic scale-UP on an urgent vote...
+            with rec._lock:
+                rec._alert_votes.append(_Urgent())
+            for t in threads[12:24]:
+                t.start()
+            deadline = time.monotonic() + 15
+            while (len(registry.nodes("llm-elastic")) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert len(registry.nodes("llm-elastic")) == 3
+            # ... then scale-DOWN into the wedged drain: the deadline
+            # escalation kills the victim and the fleet converges.
+            rec.desired = 2
+            for t in threads[24:]:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            deadline = time.monotonic() + 15
+            while (mreg.counter("scale.drain_escalations").value < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert mreg.counter("scale.drain_escalations").value == 1
+            deadline = time.monotonic() + 10
+            while (len(registry.nodes("llm-elastic")) != 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert len(registry.nodes("llm-elastic")) == 2
+            stop_ticks.set()
+            ticker.join(timeout=5)
+
+            assert not lost, f"requests lost: {lost}"
+            assert len(answered) + len(shed) == 36
+            assert answered, "nothing was ever answered"
+
+            chaos.pause()  # drain: pair anything still outstanding
+            deadline = time.monotonic() + 15
+            while chaos.unrecovered() and time.monotonic() < deadline:
+                try:
+                    gw.generate(prompt, 8)
+                except ShedError:
+                    pass
+                time.sleep(0.05)
+            fired_sites = {e.site for e in plan.fired()}
+            assert "scale.spawn" in fired_sites
+            assert "scale.drain" in fired_sites
+            assert chaos.unrecovered() == {}, (
+                f"unpaired: {chaos.unrecovered()}: {plan.trace()}")
+        except BaseException:
+            print(f"\nELASTIC CHAOS SOAK FAILED; plan: "
+                  f"{plan.to_json()}")
+            raise
+        finally:
+            chaos.disarm()
+            if gw is not None:
+                gw.close()
+            rec.close(stop_fleet=True)
+            launcher.close()
+            state.close()
+
+
 # --------------------------------------------------- health plane (ISSUE 5)
 
 

@@ -773,3 +773,80 @@ def test_serve_engine_package_is_pt011_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt011 = [f for f in findings if "PT011" in f]
     assert not pt011, pt011
+
+
+# --------------------------------------------------------------- PT012
+
+
+PT012_RAW_SERVER = (
+    "from ptype_tpu.actor import ActorServer\n"
+    "def up(actor):\n"
+    "    s = ActorServer('127.0.0.1', 0)\n"
+    "    s.register(actor, 'Generator')\n"
+    "    return s.serve()\n")
+
+
+def test_pt012_flags_direct_server_construction_in_package(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/sneaky_serve.py",
+                      PT012_RAW_SERVER)
+    assert sum("PT012" in f for f in findings) == 1, findings
+
+
+def test_pt012_flags_attribute_form(tmp_path):
+    src = ("from ptype_tpu import actor\n"
+           "import ptype_tpu.actor as actor_mod\n"
+           "def up():\n"
+           "    a = actor.ActorServer('0.0.0.0', 0)\n"
+           "    b = actor_mod.ActorServer('0.0.0.0', 0)\n"
+           "    return a, b\n")
+    findings = _check(tmp_path, "ptype_tpu/gateway/attr12.py", src)
+    assert sum("PT012" in f for f in findings) == 2, findings
+
+
+def test_pt012_silent_in_lifecycle_home_and_outside_package(tmp_path):
+    # reconciler/ IS the home; serve.py is its actor library; tests,
+    # examples, and bench build ad-hoc fleets deliberately.
+    for rel in ("ptype_tpu/reconciler/replica.py",
+                "ptype_tpu/reconciler/nested/deep.py",
+                "ptype_tpu/serve.py",
+                "tests/t12.py", "examples/fleet12.py", "bench.py"):
+        findings = _check(tmp_path, rel, PT012_RAW_SERVER)
+        assert not any("PT012" in f for f in findings), (rel, findings)
+
+
+def test_pt012_ignores_non_construction_uses(tmp_path):
+    # Type annotations, isinstance checks, and unrelated .ActorServer
+    # attributes that are not CALLS stay silent — the rule flags
+    # construction only.
+    src = ("from ptype_tpu.actor import ActorServer\n"
+           "def check(x) -> 'ActorServer | None':\n"
+           "    if isinstance(x, ActorServer):\n"
+           "        return x\n"
+           "    return None\n")
+    findings = _check(tmp_path, "ptype_tpu/ok12.py", src)
+    assert not any("PT012" in f for f in findings), findings
+
+
+def test_pt012_honors_noqa(tmp_path):
+    src = ("from ptype_tpu.actor import ActorServer\n"
+           "def up():\n"
+           "    return ActorServer('127.0.0.1', 0)  # noqa: special\n")
+    findings = _check(tmp_path, "ptype_tpu/sup12.py", src)
+    assert not any("PT012" in f for f in findings), findings
+
+
+def test_package_is_pt012_clean():
+    """Replica lifecycle has one home (ISSUE 13): no direct
+    ActorServer construction in ptype_tpu/ outside reconciler/ (the
+    operator CLI's serve command rides reconciler.replica.serve_actor)."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt012 = [f for f in findings if "PT012" in f]
+    assert not pt012, pt012

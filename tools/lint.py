@@ -81,6 +81,14 @@ Checks (pyflakes-grade, conservative to stay false-positive-free):
   (serve_engine.BlockPool: ref-counted blocks, prefix reuse, LRU
   eviction) replaces; serving code gets its KV storage from the pool
   (models/generate.py keeps init_cache for the solo compiled path)
+- PT012 (ptype_tpu/ outside reconciler/ and serve.py): a direct
+  ``ActorServer(...)`` construction — replica lifecycle has ONE home
+  (reconciler/replica.py: spawn → warm → active → draining → exit,
+  with registration, drain ordering, and the scale.* chaos seams);
+  a server built beside it is a replica the reconciler can neither
+  drain nor replace, invisible to the elastic control loop. Build
+  through ``reconciler.replica.serve_actor`` / ``ReplicaHost`` (the
+  operator CLI's ``serve`` command already does)
 
 Exit 0 when clean; 1 with one ``path:line: code message`` per finding.
 """
@@ -588,6 +596,38 @@ class _RawCacheBankCheck(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawReplicaServerCheck(ast.NodeVisitor):
+    """PT012: ``ActorServer(...)`` constructed in ptype_tpu/ outside
+    reconciler/ and serve.py — bare name or any ``*.ActorServer``
+    attribute form. Serving-replica lifecycle (spawn/warm/activate/
+    drain/replace, the registration that makes the gateway route to
+    it, and the ``scale.spawn``/``scale.drain`` chaos seams) lives in
+    exactly one place, reconciler/replica.py; a server constructed
+    beside it serves traffic the elastic reconciler can neither drain
+    gracefully nor replace on death."""
+
+    def __init__(self, path: str, findings: list[str]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name == "ActorServer":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: PT012 direct ActorServer "
+                f"construction outside the replica-lifecycle home — "
+                f"the elastic reconciler can neither drain nor "
+                f"replace a replica it didn't build; construct "
+                f"through reconciler.replica.serve_actor / "
+                f"ReplicaHost")
+        self.generic_visit(node)
+
+
 class _RawTimerCheck(ast.NodeVisitor):
     """PT010: ``time.perf_counter()`` / ``time.time()`` anywhere in
     ptype_tpu/serve_engine/ — bare attribute form, any module alias
@@ -800,6 +840,12 @@ def check_file(path: str, findings: list[str]) -> None:
         # and any future serving module), contiguous full-reach banks
         # are the footprint the pool replaces.
         _RawCacheBankCheck(path, raw).visit(tree)
+    if ("ptype_tpu" in parts and "reconciler" not in parts
+            and os.path.basename(path) != "serve.py"):
+        # reconciler/replica.py IS the replica-lifecycle home (serve.py
+        # is its actor library); a serving ActorServer built anywhere
+        # else is invisible to the elastic control loop.
+        _RawReplicaServerCheck(path, raw).visit(tree)
     if not is_init:  # __init__ imports ARE the re-export surface
         for name, lineno in sorted(v.imported.items(),
                                    key=lambda kv: kv[1]):
