@@ -122,15 +122,14 @@ tpu-sweep:
 	python tools/tpu_sweep.py || test $$? -eq 42
 
 # Real static analysis (reference bar: golangci-lint, .golangci.yml):
-# ruff when available, else the stdlib-only checker in tools/lint.py
-# (unused imports, undefined names via symtable, mutable defaults,
-# bare excepts, ==None, placeholder-less f-strings).
+# the stdlib-only ptlint package (tools/ptlint) — the pyflakes-grade
+# base checks plus the PT001–PT017 house rules (catalogue:
+# docs/LINTING.md; suppressions are `# ptlint: disable=PTxxx -- why`
+# and MUST carry the justification). Also invoked from the tier-1
+# suite with a <10 s wall budget (tests/test_ptlint.py), so a broken
+# or slow linter fails `make test` too.
 lint:
-	@if command -v ruff >/dev/null 2>&1; then \
-		ruff check ptype_tpu tests examples tools bench.py __graft_entry__.py; \
-	else \
-		python tools/lint.py; \
-	fi
+	python -m tools.ptlint ptype_tpu tools tests examples bench.py __graft_entry__.py
 	python -m compileall -q ptype_tpu
 
 # Native wire transport (writev frame sends, GIL-free reads, crc32c).

@@ -212,6 +212,8 @@ def worker_main() -> None:
         "zero_note": None,
         "profile_overhead_pct": None,
         "profile_note": None,
+        "lockcheck_overhead_pct": None,
+        "lockcheck_note": None,
         "compiled_flops_per_token": None,
         "compiled_flops_note": None,
         "final_loss": round(float(out["loss"]), 4),
@@ -449,6 +451,19 @@ def _health_hostmesh() -> tuple[dict | None, str]:
         STORE_PROBE_TIMEOUT)
 
 
+def _lockcheck_hostmesh() -> tuple[dict | None, str]:
+    """Lock-order-watchdog cost probe (ISSUE 14): the health plane's
+    lock-heavy control path (registry mutate + sampler tick — every
+    lock off the lockcheck seam) armed vs disarmed, plus the
+    disarmed-seam residue at the primitive. Bars: <1% disarmed, <5%
+    armed."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.health.bench import measure_lockcheck_overhead\n"
+        "print(json.dumps(measure_lockcheck_overhead()))\n",
+        PROBE_TIMEOUT)
+
+
 def _patch_store_metric(rec: dict) -> None:
     """Fill the Store metrics from the host-mesh probes — but ONLY when
     the worker left the fields null (the 1-chip case). A multi-chip run
@@ -573,6 +588,23 @@ def _patch_store_metric(rec: dict) -> None:
             f"{probe['sampler_cadence_s']}s cadence, ledger observer "
             f"{probe['ledger_observe_us']}us "
             f"({probe['ledger_overhead_pct']}% of step); {note}"
+            if probe else note)
+    if rec.get("lockcheck_overhead_pct") is None:
+        # Lock-order watchdog cost on the control-plane probe
+        # (ISSUE 14 acceptance: <1% disarmed, <5% armed).
+        probe, note = _lockcheck_hostmesh()
+        rec["lockcheck_overhead_pct"] = (
+            probe["lockcheck_overhead_pct"] if probe else None)
+        rec["lockcheck_note"] = (
+            f"armed tick {probe['lockcheck_tick_us']}us -> "
+            f"{probe['lockcheck_tick_armed_us']}us at "
+            f"{probe['lockcheck_cadence_s']}s cadence "
+            f"({probe['lockcheck_acquires_per_tick']} acquires/tick, "
+            f"{probe['lockcheck_wrap_us_per_acquire']}us/acquire "
+            f"wrapped); disarmed residue "
+            f"{probe['lockcheck_disabled_overhead_pct']}% (plain "
+            f"Lock by construction); "
+            f"{probe['lockcheck_cycles']} cycles; {note}"
             if probe else note)
 
 

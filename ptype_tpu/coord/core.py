@@ -20,6 +20,8 @@ from __future__ import annotations
 import enum
 import threading
 import time
+
+from ptype_tpu import lockcheck
 from collections import deque
 from dataclasses import dataclass, field, replace
 
@@ -204,7 +206,7 @@ class Watch:
         #: the state lock could skip an event queued-but-undelivered.)
         self.arm_rev = 0
         self._cancel_fn = cancel_fn
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("coord.watch")
         self._events: list[Event] = []
         self._closed = False
 
@@ -243,13 +245,14 @@ class Watch:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
     def __iter__(self):
         while True:
             batch = self.get()
             if not batch:
-                if self._closed:
+                if self.closed:
                     return
                 continue
             for ev in batch:
@@ -281,7 +284,7 @@ class ReplFeed:
     def __init__(self, feed_id: int, cancel_fn):
         self.id = feed_id
         self._cancel_fn = cancel_fn
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("coord.repl_feed")
         self._items: list[tuple[str, dict, int]] = []
         self._closed = False
         #: Highest replication sequence this follower has ACKNOWLEDGED
@@ -338,7 +341,8 @@ class ReplFeed:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
 
 class CoordState:
@@ -365,7 +369,7 @@ class CoordState:
                  bump_term: bool | int = False,
                  fsync: bool = False,
                  history_window: int = 10_000):
-        self._lock = threading.RLock()
+        self._lock = lockcheck.rlock("coord.state")
         self._kv: dict[str, KVItem] = {}
         self._rev = 0
         #: Promotion generation (fencing token). Persisted in the
@@ -468,7 +472,7 @@ class CoordState:
             # wholesale by the NEXT replay. Rewriting both files makes
             # every start leave a consistent (snap, WAL-gen) pair —
             # and bounds future replay work as a side effect.
-            self._compact()
+            self._compact_locked()
         elif bump_term:
             self._term += int(bump_term)
         self._publish_term()
@@ -503,7 +507,7 @@ class CoordState:
 
         return os.path.join(self._data_dir, "coord.snap")
 
-    def _append(self, rec: dict) -> None:
+    def _append_locked(self, rec: dict) -> None:
         """Log one mutation (called under the lock, before ack)."""
         # Key is "<kind>:<kv-key>" (e.g. "p:services/x") so plans can
         # target one record precisely — bare kind codes collide as
@@ -534,9 +538,9 @@ class CoordState:
             os.fsync(self._wal.fileno())
         self._wal_count += 1
         if self._wal_count >= self._compact_every:
-            self._compact()
+            self._compact_locked()
 
-    def _snapshot_dict(self, wal_gen: int | None = None) -> dict:
+    def _snapshot_dict_locked(self, wal_gen: int | None = None) -> dict:
         """Full state in ``coord.snap`` format (called under the lock).
 
         ``wal_gen`` is the generation of WAL records that FOLLOW this
@@ -568,13 +572,13 @@ class CoordState:
             ],
         }
 
-    def _compact(self) -> None:
+    def _compact_locked(self) -> None:
         """Snapshot full state, truncate the WAL (under the lock)."""
         import json
         import os
 
         new_gen = self._wal_gen + 1
-        snap = self._snapshot_dict(wal_gen=new_gen)
+        snap = self._snapshot_dict_locked(wal_gen=new_gen)
         # A snapshot folds every record through the current seq, so a
         # follower's ack of it covers them all.
         for feed in list(self._repl_feeds):  # _push may self-cancel
@@ -731,7 +735,7 @@ class CoordState:
                 lease=lease,
             )
             self._kv[key] = item
-            self._append({"o": "p", "k": key, "v": value, "l": lease})
+            self._append_locked({"o": "p", "k": key, "v": value, "l": lease})
             self._notify([Event(EventType.PUT, key, value, self._rev)])
             return self._rev
 
@@ -784,7 +788,7 @@ class CoordState:
             if not doomed:
                 return 0
             n = self._delete_keys(doomed)
-            self._append({"o": "d", "ks": doomed})
+            self._append_locked({"o": "d", "ks": doomed})
             return n
 
     def _delete_keys(self, doomed: list[str]) -> int:
@@ -845,7 +849,7 @@ class CoordState:
             self._leases[lease_id] = Lease(
                 id=lease_id, ttl=ttl, expires_at=time.monotonic() + ttl
             )
-            self._append({"o": "g", "id": lease_id, "ttl": ttl})
+            self._append_locked({"o": "g", "id": lease_id, "ttl": ttl})
             return lease_id
 
     def keepalive(self, lease_id: int) -> float:
@@ -874,10 +878,10 @@ class CoordState:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 return
-            self._append({"o": "r", "id": lease_id})
-            self._expire_keys(lease)
+            self._append_locked({"o": "r", "id": lease_id})
+            self._expire_keys_locked(lease)
 
-    def _expire_keys(self, lease: Lease) -> None:
+    def _expire_keys_locked(self, lease: Lease) -> None:
         events = []
         if lease.keys:
             self._rev += 1
@@ -897,8 +901,8 @@ class CoordState:
                 ]
                 for lease in expired:
                     del self._leases[lease.id]
-                    self._append({"o": "x", "id": lease.id})
-                    self._expire_keys(lease)
+                    self._append_locked({"o": "x", "id": lease.id})
+                    self._expire_keys_locked(lease)
 
     # -------------------------------------------------------------- watches
 
@@ -958,7 +962,7 @@ class CoordState:
         with self._lock:
             feed = ReplFeed(self._next_repl, self._remove_repl)
             self._next_repl += 1
-            feed._push("snap", self._snapshot_dict(), self._repl_seq)
+            feed._push("snap", self._snapshot_dict_locked(), self._repl_seq)
             self._repl_feeds.append(feed)
             return feed
 
@@ -1068,13 +1072,13 @@ class CoordState:
     def _notify(self, events: list[Event]) -> None:
         # called under self._lock
         for ev in events:
-            self._record_event(ev)
+            self._record_event_locked(ev)
         for w in self._watches:
             batch = [ev for ev in events if ev.key.startswith(w.prefix)]
             if batch:
                 w._push(batch)
 
-    def _record_event(self, ev: Event) -> None:
+    def _record_event_locked(self, ev: Event) -> None:
         """Feed the bounded MVCC history (under the lock). Every
         mutation path funnels through _notify, so this is the single
         point where both the watch-replay log and the per-key version
@@ -1127,7 +1131,7 @@ class CoordState:
             )
             self._next_member += 1
             self._members[m.id] = m
-            self._append({"o": "ma", "id": m.id, "n": m.name,
+            self._append_locked({"o": "ma", "id": m.id, "n": m.name,
                           "a": m.peer_addr, "md": m.metadata})
             return m
 
@@ -1146,7 +1150,7 @@ class CoordState:
             md["learner"] = False
             promoted = replace(m, metadata=md)
             self._members[member_id] = promoted
-            self._append({"o": "mp", "id": member_id})
+            self._append_locked({"o": "mp", "id": member_id})
             return promoted
 
     def member_remove(self, member_id: int) -> bool:
@@ -1154,7 +1158,7 @@ class CoordState:
         with self._lock:
             gone = self._members.pop(member_id, None) is not None
             if gone:
-                self._append({"o": "mr", "id": member_id})
+                self._append_locked({"o": "mr", "id": member_id})
             return gone
 
     def member_list(self) -> list[Member]:

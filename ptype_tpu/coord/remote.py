@@ -6,6 +6,8 @@ import atexit
 import socket
 import threading
 import time
+
+from ptype_tpu import lockcheck
 import weakref
 
 from ptype_tpu import chaos, logs, retry
@@ -107,7 +109,7 @@ class RemoteCoord(CoordBackend):
         #: remove() between _dial's membership check and .index(), or
         #: between a len() and the modular index, would raise out of
         #: the reader's reconnect path. Created before the first _dial.
-        self._endpoints_lock = threading.Lock()
+        self._endpoints_lock = lockcheck.lock("coord.remote.endpoints")
         self.address = eps[0]
         self._dial_timeout = dial_timeout
         self._request_timeout = request_timeout
@@ -121,7 +123,7 @@ class RemoteCoord(CoordBackend):
             raise CoordinationError(
                 f"failed to dial coordination service at {eps}: {e}"
             ) from e
-        self._send_lock = threading.Lock()
+        self._send_lock = lockcheck.lock("coord.remote.send")
         #: Highest fencing term seen in any reply (never decreases).
         self._term = 0
         #: Set while a dialed connection is live; cleared on loss and
@@ -130,14 +132,14 @@ class RemoteCoord(CoordBackend):
         self._connected = threading.Event()
         self._connected.set()
         self._pending: dict[int, _Pending] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = lockcheck.lock("coord.remote.pending")
         self._watches: dict[int, Watch] = {}
         #: Watch pushes that arrived before their watch id was
         #: registered (see _dispatch_watch); drained at registration.
         self._orphan_events: dict[int, list] = {}
-        self._watches_lock = threading.Lock()
+        self._watches_lock = lockcheck.lock("coord.remote.watches")
         self._next_id = 1
-        self._id_lock = threading.Lock()
+        self._id_lock = lockcheck.lock("coord.remote.id")
         self._closed = threading.Event()
         #: Cleared while watches are being re-armed after a reconnect;
         #: ordinary calls wait on it so a caller cannot slip a write in
@@ -161,6 +163,14 @@ class RemoteCoord(CoordBackend):
         _live_clients.add(self)
 
     # ------------------------------------------------------------- plumbing
+
+    def _cur_addr(self) -> str:
+        """The active endpoint, read under the endpoints lock — the
+        discovery thread and stale-bounces rewrite ``self.address``
+        concurrently, and log/error paths must not read it torn
+        against the endpoint list."""
+        with self._endpoints_lock:
+            return self.address
 
     def _dial(self) -> socket.socket:
         """Dial the endpoint list in order, starting at the currently
@@ -272,13 +282,14 @@ class RemoteCoord(CoordBackend):
                 delay = bo.next_delay()
                 if time.monotonic() + delay > deadline:
                     log.warning("coordination reconnect gave up",
-                                kv={"addr": self.address})
+                                kv={"addr": self._cur_addr()})
                     return False
                 bo.sleep(delay)
                 continue
+            addr = self._cur_addr()
             log.info("coordination connection re-established",
-                     kv={"addr": self.address})
-            chaos.note_ok("coord.reconnect", self.address)
+                     kv={"addr": addr})
+            chaos.note_ok("coord.reconnect", addr)
             # Reap requests that were sent while we were re-dialing:
             # they went into the OLD socket (its first post-FIN write
             # "succeeds" locally) after the loss-path _fail_pending had
@@ -479,9 +490,10 @@ class RemoteCoord(CoordBackend):
                 idx = -1
             stale_ep = self.address
             self.address = self.endpoints[(idx + 1) % len(self.endpoints)]
+            nxt = self.address
         self._connected.clear()
         log.info("abandoning superseded coordinator",
-                 kv={"stale": stale_ep, "next": self.address,
+                 kv={"stale": stale_ep, "next": nxt,
                      "fence_term": self._term})
         sock = self._sock
         try:
@@ -498,8 +510,10 @@ class RemoteCoord(CoordBackend):
             pass
 
     def _call_once(self, op: str, reply_timeout: float | None, kwargs):
+        addr = self._cur_addr()
         if self._closed.is_set():
-            raise CoordinationError(f"coordination connection to {self.address} closed")
+            raise CoordinationError(
+                f"coordination connection to {addr} closed")
         if (not self._connected.is_set()
                 and threading.current_thread() is not self._rewatch_thread):
             # The reader is mid-re-dial: a send into the dead socket
@@ -509,7 +523,7 @@ class RemoteCoord(CoordBackend):
             # (exactly the outage contract the registry keepalive and
             # failover tests already code against).
             raise _SendFailed(
-                f"connection to {self.address} down (reconnect in flight)")
+                f"connection to {addr} down (reconnect in flight)")
         if (not self._rewatch_gate.is_set()
                 and threading.current_thread() is not self._rewatch_thread):
             # A reconnect is re-arming watches; hold ordinary traffic so
@@ -529,7 +543,7 @@ class RemoteCoord(CoordBackend):
         except (wire.WireError, OSError) as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
-            raise _SendFailed(f"send to {self.address} failed: {e}") from e
+            raise _SendFailed(f"send to {addr} failed: {e}") from e
         if sock is not self._sock and not p.event.is_set():
             # The reader replaced the connection while we were sending:
             # the bytes went into the dead socket (a kill's RST races
@@ -539,14 +553,16 @@ class RemoteCoord(CoordBackend):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             raise _SendFailed(
-                f"connection to {self.address} replaced mid-request")
+                f"connection to {addr} replaced mid-request")
         if not p.event.wait(reply_timeout if reply_timeout is not None
                             else self._request_timeout):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
-            raise CoordinationError(f"request {op!r} to {self.address} timed out")
+            raise CoordinationError(
+                f"request {op!r} to {addr} timed out")
         if p.reply is None:
-            raise CoordinationError(f"connection to {self.address} lost mid-request")
+            raise CoordinationError(
+                f"connection to {addr} lost mid-request")
         t = p.reply.get("term")
         if isinstance(t, int) and t > self._term:
             self._term = t  # adopt the newest primary's fence
@@ -554,7 +570,7 @@ class RemoteCoord(CoordBackend):
             if p.reply.get("stale"):
                 raise _StaleCoordinator(
                     p.reply.get("error", "stale coordinator"),
-                    endpoint=self.address)
+                    endpoint=addr)
             raise CoordinationError(p.reply.get("error", "unknown coordination error"))
         chaos.note_ok("coord.op", op)
         return p.reply.get("result")

@@ -45,6 +45,8 @@ import socket
 import threading
 import time
 
+from ptype_tpu import lockcheck
+
 from ptype_tpu import logs
 from ptype_tpu.coord import wire
 
@@ -72,7 +74,7 @@ class WitnessServer:
                  data_dir: str | None = None):
         self.ttl = ttl
         self._data_dir = data_dir
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("coord.witness")
         self._holder: str | None = None
         self._term = 0
         self._deadline = 0.0  # monotonic; 0 = vacant/expired
@@ -112,7 +114,7 @@ class WitnessServer:
             # lease to a challenger early.
             self._deadline = time.monotonic() + self.ttl
 
-    def _persist(self) -> None:
+    def _persist_locked(self) -> None:
         if not self._data_dir:
             return
         tmp = self._state_path() + ".tmp"
@@ -145,7 +147,7 @@ class WitnessServer:
                         term, self._term)
                     self._deadline = now + self.ttl
                     if changed:
-                        self._persist()
+                        self._persist_locked()
                     return {"granted": True, "term": self._term}
                 return {"granted": False, "term": self._term,
                         "holder": self._holder}
@@ -168,7 +170,7 @@ class WitnessServer:
                 self._holder = cand
                 self._term = max(term, self._term)
                 self._deadline = now + self.ttl
-                self._persist()
+                self._persist_locked()
                 log.info("witness lease granted",
                          kv={"holder": cand, "term": self._term})
                 return {"granted": True, "term": self._term}

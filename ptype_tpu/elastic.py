@@ -26,6 +26,8 @@ restore (resharded) → resume.
 from __future__ import annotations
 
 import threading
+
+from ptype_tpu import lockcheck
 from typing import Callable
 
 import jax
@@ -54,7 +56,7 @@ class FailureDetector:
         self.service_name = service_name
         self._watch = registry.watch_service(service_name)
         self._on_change = on_change
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("elastic.fd")
         self._current: dict[str, object] = {}
         self._lost: list[str] = []
         self._joined: list[str] = []

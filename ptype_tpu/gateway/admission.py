@@ -30,6 +30,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ptype_tpu import lockcheck
+
 from ptype_tpu import chaos, logs
 from ptype_tpu.errors import ShedError
 
@@ -64,7 +66,7 @@ class AdmissionQueue:
         self.max_depth = int(max_depth)
         self._capacity = capacity
         self._est_service_s = est_service_s or (lambda: 0.1)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("gateway.admission")
         self._queue: list[_Ticket] = []
         self._inflight = 0
         self._closed = False
@@ -88,9 +90,10 @@ class AdmissionQueue:
             elif f.action == "shed":
                 with self._lock:
                     self.shed_slo += 1
+                    ra = self._retry_after_locked()
                 raise ShedError(
                     f"chaos: forced shed at admission ({key!r})",
-                    retry_after_s=self._retry_after())
+                    retry_after_s=ra)
         with self._lock:
             if self._closed:
                 raise ShedError("gateway is shutting down",
@@ -104,7 +107,7 @@ class AdmissionQueue:
                 self.shed_full += 1
                 raise ShedError(
                     f"admission queue full ({self.max_depth} waiting)",
-                    retry_after_s=self._retry_after())
+                    retry_after_s=self._retry_after_locked())
             if deadline is not None:
                 est_wait = ((len(self._queue) + 1)
                             * self._est_service_s()
@@ -117,7 +120,7 @@ class AdmissionQueue:
                     raise ShedError(
                         f"estimated queue wait {est_wait:.2f}s exceeds "
                         f"the request deadline",
-                        retry_after_s=self._retry_after())
+                        retry_after_s=self._retry_after_locked())
             t = _Ticket(key, deadline)
             self._queue.append(t)
         timeout = (None if deadline is None
@@ -137,8 +140,9 @@ class AdmissionQueue:
             elif t.shed_reason is None:
                 self._release_locked()
             self.shed_deadline += 1
+            ra = self._retry_after_locked()
         raise ShedError("deadline lapsed in the admission queue",
-                        retry_after_s=self._retry_after())
+                        retry_after_s=ra)
 
     def release(self) -> None:
         """One dispatched request finished; grant the next waiter."""
@@ -166,9 +170,11 @@ class AdmissionQueue:
             self.admitted += 1
             t.granted.set()
 
-    def _retry_after(self) -> float:
+    def _retry_after_locked(self) -> float:
         """Backlog-proportional hint: how long until the queue has
-        plausibly drained one slot's worth of room for this caller."""
+        plausibly drained one slot's worth of room for this caller;
+        callers hold the lock (the queue length must be the one the
+        shed decision was made against)."""
         est = ((len(self._queue) + 1) * self._est_service_s()
                / max(1, int(self._capacity())))
         return min(10.0, max(0.05, est))

@@ -45,6 +45,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ptype_tpu import lockcheck
+
 from ptype_tpu import chaos, logs, retry, rpc as rpc_mod
 from ptype_tpu.registry import Node, Registry
 
@@ -74,7 +76,7 @@ class Replica:
         self.up = False
         self.dialing = False       # one (re)dial in flight at a time
         self.calls = 0
-        self.lock = threading.Lock()
+        self.lock = lockcheck.lock("gateway.pool.replica")
 
     def score(self) -> float:
         """Estimated ms until this replica would finish MY request:
@@ -180,7 +182,7 @@ class ReplicaPool:
         #: ``on_ttft(ttft_ms)`` per NEW replica-reported per-request
         #: TTFT sample (the gateway wires SLOTracker.record_ttft).
         self._on_ttft = on_ttft
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("gateway.pool.fleet")
         self._replicas: dict[str, Replica] = {}
         self._closed = threading.Event()
         self._watch = registry.watch_service(service)

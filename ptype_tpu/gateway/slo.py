@@ -31,8 +31,9 @@ Metric names (under the process-global registry by default):
 
 from __future__ import annotations
 
-import threading
 import time
+
+from ptype_tpu import lockcheck
 from dataclasses import dataclass, field
 
 from ptype_tpu import metrics as metrics_mod
@@ -76,7 +77,7 @@ class SLOTracker:
         self.g_queue = reg.gauge(f"{p}.queue_depth")
         self.g_replicas = reg.gauge(f"{p}.healthy_replicas")
         self.g_hint = reg.gauge(f"{p}.scale_hint")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("gateway.slo")
         #: (t, latency_ms, tokens) for answered requests in the window.
         self._ok: list[tuple[float, float, int]] = []
         #: (t,) stamps for sheds in the window.

@@ -50,7 +50,7 @@ The host-side parser (:func:`summarize`) reads the ``*.trace.json.gz``
 Chrome-trace artifact jax writes next to the ``.xplane.pb`` — stdlib
 gzip+json, so top-op tables work on CPU test runs with no TensorBoard.
 
-Lint rule PT008 (tools/lint.py) closes the side door: raw
+Lint rule PT008 (tools/ptlint) closes the side door: raw
 ``jax.profiler.start_trace`` / ``stop_trace`` calls are forbidden in
 ``ptype_tpu/`` outside metrics.py and this module — every capture goes
 through the rate-limited, artifact-managed seam.

@@ -55,8 +55,9 @@ from __future__ import annotations
 import collections
 import math
 import statistics
-import threading
 import time
+
+from ptype_tpu import lockcheck
 from dataclasses import dataclass, field
 
 from ptype_tpu import logs, trace
@@ -734,7 +735,7 @@ class AlertEngine:
                          else metrics_mod.metrics)
         self.alerts: collections.deque = collections.deque(maxlen=256)
         self._last_fired: dict[tuple[str, str], float] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("health.alerts")
 
     def evaluate(self, snapshot: dict,
                  now: float | None = None) -> list[Alert]:

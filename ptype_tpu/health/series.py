@@ -31,6 +31,8 @@ import os
 import threading
 import time
 
+from ptype_tpu import lockcheck
+
 from ptype_tpu import metrics as metrics_mod
 
 #: Default points kept per series: ~8.5 min of history at the default
@@ -53,7 +55,7 @@ class SeriesRing:
         self.name = name
         self._points: collections.deque = collections.deque(
             maxlen=int(capacity))
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("health.series.ring")
 
     def append(self, t: float, value: float) -> None:
         with self._lock:
@@ -84,7 +86,7 @@ class SeriesStore:
     def __init__(self, capacity: int = SERIES_CAPACITY):
         self.capacity = int(capacity)
         self._series: dict[str, SeriesRing] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("health.series.store")
 
     def series(self, name: str) -> SeriesRing:
         with self._lock:
@@ -144,7 +146,7 @@ class Sampler:
         #: sample_once is called both by the background loop and by
         #: callers flushing final values — unserialized ticks would
         #: double-append points and double-stamp rate windows.
-        self._tick_lock = threading.Lock()
+        self._tick_lock = lockcheck.lock("health.sampler.tick")
 
     # ---------------------------------------------------------- sampling
 

@@ -57,8 +57,9 @@ in ``bench.py --serve``'s tail, the <1%-per-engine-iteration bar).
 from __future__ import annotations
 
 import collections
-import threading
 import time
+
+from ptype_tpu import lockcheck
 
 from ptype_tpu import metrics as metrics_mod
 from ptype_tpu import trace
@@ -279,7 +280,7 @@ class ServingLedger:
         self.g_step_ms = reg.gauge("serve.step_ms")
         self.g_active = reg.gauge("serve.active_slots")
         self.g_stall = reg.gauge("serve.stall_ms")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("health.serving.ledger")
         self._records: collections.deque = collections.deque(
             maxlen=int(window))
         self._iters: collections.deque = collections.deque(

@@ -15,6 +15,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ptype_tpu import lockcheck
+
 import jax
 
 from ptype_tpu import trace as trace_mod
@@ -278,7 +280,7 @@ class Histogram:
         self._ring: list[float] = []
         self._idx = 0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("metrics.histogram")
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -320,7 +322,7 @@ class MetricsRegistry:
         self._timings: dict[str, Timing] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("metrics.registry")
         self._version = 0
 
     def _family(self, fam: dict, name: str, make):
@@ -480,7 +482,7 @@ class MetricsWriter:
         os.makedirs(os.path.dirname(os.path.abspath(path)),
                     exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("metrics.kvlogger")
 
     def emit(self, step: int, snapshot: dict | None = None,
              **scalars) -> None:

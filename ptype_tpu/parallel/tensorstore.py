@@ -209,7 +209,7 @@ class TensorStore:
             if spec is not None:
                 self._bindings[key] = b
             self._entries[key] = _Entry(arr, epoch, b,
-                                        self._stamp(key))
+                                        self._stamp_locked(key))
         self._publish(key)
         return arr
 
@@ -240,7 +240,7 @@ class TensorStore:
             if key not in self._entries:
                 raise NoKeyError(key)
             del self._entries[key]
-            self._stamp(key)  # a deletion is a mutation: cached
+            self._stamp_locked(key)  # a deletion is a mutation: cached
             #                   readers must notice and re-pull
         if self._kv is not None:
             try:
@@ -269,7 +269,7 @@ class TensorStore:
         with self._lock:
             return self._prefix_seq.get(prefix, 0)
 
-    def _stamp(self, key: str) -> int:
+    def _stamp_locked(self, key: str) -> int:
         """Bump the store write stamp and index it under every
         "/"-ancestor of ``key``; callers hold the lock."""
         self._seq += 1
@@ -350,7 +350,7 @@ class TensorStore:
             prev = self._entries.get(key)
             epoch = (prev.epoch + 1) if prev else 1
             self._entries[key] = _Entry(value, epoch, b,
-                                        self._stamp(key))
+                                        self._stamp_locked(key))
         self._publish(key)
         chaos.note_ok("store.push", key)
         return value
@@ -375,7 +375,7 @@ class TensorStore:
             [NamedSharding(self.mesh, b.spec) for b in bindings])
         with self._lock:
             for (key, _), b, arr in zip(pairs, bindings, arrs):
-                self._entries[key] = _Entry(arr, 0, b, self._stamp(key))
+                self._entries[key] = _Entry(arr, 0, b, self._stamp_locked(key))
             assigned = self._seq
         for key, _ in pairs:
             self._publish(key)

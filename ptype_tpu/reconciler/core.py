@@ -38,7 +38,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from ptype_tpu import chaos, logs
+from ptype_tpu import chaos, lockcheck, logs
 from ptype_tpu import metrics as metrics_mod
 from ptype_tpu import trace
 from ptype_tpu.elastic import FailureDetector
@@ -122,7 +122,7 @@ class Reconciler:
                      else metrics_mod.metrics)
         self._fd = FailureDetector(registry, service)
         self._fd.wait_seeded()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("reconciler.state")
         #: name -> handle, every replica this reconciler owns
         #: (warm + active + draining).
         self._handles: dict[str, object] = {}
@@ -132,8 +132,16 @@ class Reconciler:
         #: (drain complete / deliberate exit): losing them is not a
         #: death.
         self._expected_departures: set[str] = set()
+        #: addrs whose HANDLE is known dead but whose registration
+        #: has not expired yet: they must not count as serving
+        #: capacity (a zombie lease is not a replica), or the
+        #: replacement stalls up to a full lease TTL.
+        self._dead_addrs: set[str] = set()
         #: names with a spawn thread in flight -> "active"|"warm".
         self._spawning: dict[str, str] = {}
+        #: name -> the spawn Thread itself, for close()'s bounded
+        #: join (daemonized AND joined — the PT015 contract).
+        self._spawn_threads: dict[str, threading.Thread] = {}
         #: name -> last-read lifecycle. Refreshed ONCE per tick
         #: outside the main lock (for OS-process fleets a lifecycle
         #: read is a control RPC; a wedged worker must stall at most
@@ -149,7 +157,7 @@ class Reconciler:
         self._seq = 0
         self._closed = threading.Event()
         self._thread: threading.Thread | None = None
-        self._tick_lock = threading.Lock()
+        self._tick_lock = lockcheck.lock("reconciler.tick")
 
     # -------------------------------------------------------------- input
 
@@ -213,7 +221,8 @@ class Reconciler:
         cache = {}
         for name, h in items:
             cache[name] = h.lifecycle
-        self._lc = cache
+        with self._lock:
+            self._lc = cache
 
     def _actual(self) -> int:
         """Serving capacity now + capacity already committed: active
@@ -226,8 +235,11 @@ class Reconciler:
         installed, so it cannot be registry-visible before the
         reconciler owns it)."""
         mine = self._addr_handles()
+        with self._lock:
+            dead = set(self._dead_addrs)
         foreign = [n for n in self._fd.current()
-                   if f"{n.address}:{n.port}" not in mine]
+                   if f"{n.address}:{n.port}" not in mine
+                   and f"{n.address}:{n.port}" not in dead]
         with self._lock:
             active_mine = sum(
                 1 for name in self._handles
@@ -254,6 +266,7 @@ class Reconciler:
             with self._lock:
                 expected = addr in self._expected_departures
                 self._expected_departures.discard(addr)
+                self._dead_addrs.discard(addr)  # registry caught up
             if expected:
                 continue
             h = mine.get(addr)
@@ -292,8 +305,40 @@ class Reconciler:
                 with self._lock:
                     self._handles.pop(name, None)
                     was_draining = self._draining.pop(name, None)
-                if was_draining is None and h.lifecycle not in (
+                with self._lock:
+                    was_active = self._lc.get(name) == "active"
+                if (was_draining is None and was_active
+                        and h.lifecycle not in ("drained", "dead")):
+                    # An unexpected ACTIVE corpse: this IS the death,
+                    # found via the handle before (or racing) the
+                    # registry loss. Count it HERE and mark the
+                    # departure expected, so whichever path sees the
+                    # death first credits the replacement exactly
+                    # once — otherwise a loss landing mid-tick (after
+                    # _note_deaths, before _converge) reaps the
+                    # handle creditless, _converge spawns an
+                    # UNCREDITED replacement, and the next tick's
+                    # credit is zeroed by actual >= desired: the
+                    # replacement happened but was never counted.
+                    # ACTIVE-only on purpose: a warm/spawning replica
+                    # was never registered, so no loss event would
+                    # ever clear these dedup entries — a stale entry
+                    # at a reused addr would swallow a FUTURE real
+                    # death as "expected" and leak forever.
+                    with self._lock:
+                        self._expected_departures.add(h.addr)
+                        self._dead_addrs.add(h.addr)
+                        self._replace_credits += 1
+                    self._reg.counter("scale.deaths").add(1)
+                    log.warning("replica handle dead outside a drain; "
+                                "will replace",
+                                kv={"service": self.service,
+                                    "replica": name})
+                elif was_draining is None and h.lifecycle not in (
                         "drained", "dead"):
+                    # Warm/spawning corpse: reaped without death
+                    # accounting — it held no registration and served
+                    # no traffic; _refill_warm_pool replaces it.
                     log.warning("replica handle dead outside a drain",
                                 kv={"service": self.service,
                                     "replica": name})
@@ -404,7 +449,8 @@ class Reconciler:
                         pass
                     self._return_replace_credit(replacement)
                     return True  # retry loop: spawn instead
-            self._lc[h.name] = "active"
+            with self._lock:
+                self._lc[h.name] = "active"
             self._reg.counter("scale.activations").add(1)
             if replacement:
                 self._reg.counter("scale.replacements").add(1)
@@ -440,11 +486,12 @@ class Reconciler:
                 self._reg.counter("scale.spawns").add(1)
                 with self._lock:
                     self._handles[name] = h
+                    self._lc[name] = "warm"
                 installed = True
-                self._lc[name] = "warm"
                 if dest == "active":
                     h.activate()
-                    self._lc[name] = "active"
+                    with self._lock:
+                        self._lc[name] = "active"
                 if replacement:
                     self._reg.counter("scale.replacements").add(1)
                 log.info("replica spawned",
@@ -473,9 +520,13 @@ class Reconciler:
             finally:
                 with self._lock:
                     self._spawning.pop(name, None)
+                    self._spawn_threads.pop(name, None)
 
-        threading.Thread(target=run, name=f"spawn-{name}",
-                         daemon=True).start()
+        t = threading.Thread(target=run, name=f"spawn-{name}",
+                             daemon=True)
+        with self._lock:
+            self._spawn_threads[name] = t
+        t.start()
         return True
 
     def _pick_victim(self):
@@ -629,6 +680,22 @@ class Reconciler:
         self._closed.set()
         if self._thread is not None:
             self._thread.join(timeout=self.cfg.tick_interval_s + 5)
+        # Bounded join of in-flight spawn threads (PT015 contract):
+        # a spawn mid-flight at close is daemonized, but a test
+        # tearing the reconciler down must not leak a worker that
+        # wakes later against a dead registry. ONE shared deadline
+        # across all of them — k wedged spawns must not stack k full
+        # timeouts — and a registered-but-not-yet-started thread
+        # (ident is None: the tick thread was preempted between
+        # install and start) is skipped, not joined (joining an
+        # unstarted thread raises out of close()).
+        with self._lock:
+            spawns = list(self._spawn_threads.values())
+        deadline = time.monotonic() + self.cfg.spawn_timeout_s
+        for t in spawns:
+            if t.ident is None or t is threading.current_thread():
+                continue
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         self._fd.close()
         if stop_fleet:
             with self._lock:

@@ -43,6 +43,8 @@ import tempfile
 import threading
 import time
 
+from ptype_tpu import lockcheck
+
 from ptype_tpu import chaos, logs
 from ptype_tpu import metrics as metrics_mod
 from ptype_tpu import retry, rpc as rpc_mod
@@ -79,7 +81,7 @@ class FakeGeneratorActor:
         self.lifecycle = "active"
         self._draining = False
         self._in_flight = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("reconciler.fake_actor")
 
     def Generate(self, prompt, max_new_tokens: int = 8, *args):
         import numpy as np
@@ -107,12 +109,14 @@ class FakeGeneratorActor:
     def Info(self) -> dict:
         with self._lock:
             in_flight = self._in_flight
+            calls = self.calls
         return {"in_flight": in_flight,
                 "queue_depth": max(0, in_flight - 1),
-                "calls": self.calls, "lifecycle": self.lifecycle}
+                "calls": calls, "lifecycle": self.lifecycle}
 
     def begin_drain(self) -> None:
-        self._draining = True
+        with self._lock:
+            self._draining = True
         self.lifecycle = "draining"
 
     def drained(self) -> bool:
@@ -165,7 +169,7 @@ class ReplicaHost:
         self.generator_name = generator_name
         self.process_id = int(process_id)
         self._reg_handle = None
-        self._reg_lock = threading.Lock()
+        self._reg_lock = lockcheck.lock("reconciler.replica.reg")
         self._exit = threading.Event()
         self._drain_thread: threading.Thread | None = None
         self._drain_started: float | None = None
@@ -215,9 +219,11 @@ class ReplicaHost:
             info = self.actor.Info() or {}
         except Exception:  # noqa: BLE001 — status must always answer
             pass
+        with self._reg_lock:
+            registered = self._reg_handle is not None
         return {"service": self.service, "node": self.node_name,
                 "addr": self.key, "lifecycle": self.lifecycle,
-                "registered": self._reg_handle is not None,
+                "registered": registered,
                 "in_flight": int(info.get("in_flight", 0) or 0),
                 "queue_depth": int(info.get("queue_depth", 0) or 0),
                 "drained": bool(self._actor_drained()),
@@ -327,6 +333,12 @@ class ReplicaHost:
                 close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
+        # Thread-hygiene (PT015 contract): the drain worker is
+        # daemonized AND joined bounded — a host torn down mid-drain
+        # must not leave a worker waking against closed sockets.
+        t = self._drain_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     def kill(self) -> None:
         """Die the ungraceful way (drill stand-in for SIGKILL): the
@@ -410,14 +422,27 @@ class ProcessReplicaHandle(ReplicaHandle):
         self._dial_timeout = float(dial_timeout)
         self._call_timeout = float(call_timeout)
         self._conn = None
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("reconciler.proc_handle")
 
     def _call(self, method: str, *args):
         with self._lock:
             conn = self._conn
-            if conn is None or not conn.healthy:
-                conn = rpc_mod._dial(self._node, self._dial_timeout)
-                self._conn = conn
+        if conn is None or not conn.healthy:
+            # Dial OUTSIDE the lock: a wedged worker would otherwise
+            # hold every concurrent control call (status polls, drain
+            # orders) hostage for the full dial timeout. The install
+            # is double-checked — a racer's healthy conn wins and the
+            # loser's dial is closed, never leaked.
+            dialed = rpc_mod._dial(self._node, self._dial_timeout)
+            stale = None
+            with self._lock:
+                cur = self._conn
+                if cur is not None and cur.healthy and cur is not conn:
+                    conn, stale = cur, dialed  # lost the dial race
+                else:
+                    self._conn, conn, stale = dialed, dialed, cur
+            if stale is not None and stale is not conn:
+                stale.close()
         fut = conn.call_async(method, args)
         try:
             return fut.result(timeout=self._call_timeout)
@@ -500,7 +525,7 @@ class LocalLauncher:
         self._generator_name = generator_name
         self._metrics_registry = metrics_registry
         self.hosts: list[ReplicaHost] = []
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("reconciler.launcher")
 
     def spawn(self, name: str,
               warm_hold: bool = False) -> LocalReplicaHandle:

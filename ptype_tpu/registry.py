@@ -20,6 +20,8 @@ import atexit
 import json
 import threading
 import time
+
+from ptype_tpu import lockcheck
 import weakref
 from dataclasses import dataclass, field
 
@@ -102,7 +104,7 @@ class NodeWatch:
     """
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("registry.node_watch")
         self._queue: list[list[Node]] = []
         self._closed = False
         self._cancel_cb = lambda: None
@@ -157,12 +159,13 @@ class NodeWatch:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
     def __iter__(self):
         while True:
             snap = self.get()
-            if snap is None and self._closed:
+            if snap is None and self.closed:
                 return
             if snap is not None:
                 yield snap

@@ -1,7 +1,7 @@
 """Shared jittered exponential backoff for retry/poll loops.
 
 Every retry loop in ``ptype_tpu/`` rides :class:`Backoff` instead of a
-bare ``time.sleep`` (lint rule PT002, tools/lint.py): an immediate or
+bare ``time.sleep`` (lint rule PT002, tools/ptlint): an immediate or
 fixed-interval re-fire sends a whole fleet back into a dying node set
 in lockstep, which is exactly the thundering herd the reference's
 round-robin retry was built to avoid. Jitter decorrelates the herd;

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import threading
 
+from ptype_tpu import lockcheck
+
 import jax
 import jax.numpy as jnp
 
@@ -54,13 +56,13 @@ class GeneratorActor:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.params = (params if params is not None
                        else jax.jit(lambda r: tfm.init_params(r, cfg))(rng))
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("serve.actor.decode")
         self._calls = 0
         #: Load telemetry for the gateway's replica pool: requests that
         #: have entered Generate/Logits and not yet returned. Kept
         #: under its own lock — _lock is HELD for a whole decode loop,
         #: and Info() must answer while one is in flight.
-        self._load_lock = threading.Lock()
+        self._load_lock = lockcheck.lock("serve.actor.load")
         self._in_flight = 0
         #: Replica lifecycle (ISSUE 13): "active" for a bare actor;
         #: the reconciler's ReplicaHost moves it through spawning →
@@ -90,7 +92,9 @@ class GeneratorActor:
         to ``drained()`` — the replica would deregister and exit with
         the request still executing, exactly the lost request the
         drain contract forbids."""
-        if self._draining:
+        with self._load_lock:
+            draining = self._draining
+        if draining:
             raise ShedError("replica draining (scale-down in "
                             "progress); route elsewhere",
                             retry_after_s=0.05)
@@ -99,9 +103,11 @@ class GeneratorActor:
         """Stop admitting; in-flight requests finish normally. The
         reconciler (or operator) polls :meth:`drained` and
         deregisters/exits the replica once it reports True."""
-        self._draining = True
+        with self._load_lock:
+            self._draining = True
+            in_flight = self._in_flight
         self.lifecycle = "draining"
-        log.info("replica draining", kv={"in_flight": self._in_flight})
+        log.info("replica draining", kv={"in_flight": in_flight})
 
     def drained(self) -> bool:
         """True once a drain was requested AND no request is in
@@ -119,8 +125,9 @@ class GeneratorActor:
         self._enter_request()
         try:
             self._check_draining()
-            with self._lock:
+            with self._load_lock:
                 self._calls += 1
+            with self._lock:
                 out = gen.generate(
                     self.params, self.cfg, prompt, int(max_new_tokens),
                     float(temperature), jax.random.PRNGKey(int(seed)),
@@ -146,13 +153,14 @@ class GeneratorActor:
     def Info(self) -> dict:
         with self._load_lock:
             in_flight = self._in_flight
+            calls = self._calls
         return {
             "n_params": tfm.count_params(self.params),
             "d_model": self.cfg.d_model,
             "n_layers": self.cfg.n_layers,
             "vocab_size": self.cfg.vocab_size,
             "max_seq": self.cfg.max_seq,
-            "calls": self._calls,
+            "calls": calls,
             # Lifecycle (ISSUE 13): the reconciler's state machine,
             # surfaced so the gateway pool's snapshots (and `obs
             # serve`) render the same fleet view the reconciler acts
@@ -214,7 +222,7 @@ class BatchingGeneratorActor(GeneratorActor):
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self._queue: list[_Pending] = []
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("serve.batcher")
         self._closed = False
         self._batches = 0
         self._batched_requests = 0
@@ -325,10 +333,11 @@ class BatchingGeneratorActor(GeneratorActor):
                 S_b = max(S, min(_pow2(S), self.cfg.max_seq - max_new))
                 if S_b > S:
                     prompts = jnp.pad(prompts, ((0, 0), (S_b - S, 0)))
-                with self._lock:
+                with self._load_lock:
                     self._calls += len(reqs)
                     self._batches += 1
                     self._batched_requests += len(reqs)
+                with self._lock:
                     out = gen.generate(self.params, self.cfg, prompts,
                                        max_new, 0.0,
                                        jax.random.PRNGKey(0),
@@ -347,8 +356,9 @@ class BatchingGeneratorActor(GeneratorActor):
 
     def Info(self) -> dict:
         info = super().Info()
-        info["batches"] = self._batches
-        info["batched_requests"] = self._batched_requests
+        with self._load_lock:
+            info["batches"] = self._batches
+            info["batched_requests"] = self._batched_requests
         with self._cond:
             # Requests queued for a batching round, not lock-waiters.
             info["queue_depth"] = len(self._queue)

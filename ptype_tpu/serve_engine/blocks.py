@@ -40,7 +40,8 @@ write can never corrupt a real (possibly shared) block.
 from __future__ import annotations
 
 import collections
-import threading
+
+from ptype_tpu import lockcheck
 
 import jax.numpy as jnp
 
@@ -105,7 +106,7 @@ class BlockPool:
         #: steps/prefills donate and replace them.
         self.k = jnp.zeros(shape, cfg.dtype)
         self.v = jnp.zeros(shape, cfg.dtype)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("serve_engine.pool")
         # Block 0 never allocated: the trash target for masked writes.
         self._free: list[int] = list(range(1, n_blocks))
         #: LRU of refcount-0 hashed blocks (oldest first).

@@ -49,6 +49,8 @@ answers "where did this request's latency go" across processes.
 from __future__ import annotations
 
 import threading
+
+from ptype_tpu import lockcheck
 from dataclasses import dataclass
 
 import jax
@@ -277,7 +279,7 @@ class PagedGeneratorActor(GeneratorActor):
         self._slot_state: dict[int, _PagedRow] = {}
         self._queue: list[_PagedRow] = []
         self._admitting: _PagedRow | None = None
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("serve_engine.queue")
         self._closed = False
         self._steps = 0
         self._max_live = 0
@@ -558,22 +560,24 @@ class PagedGeneratorActor(GeneratorActor):
         spent = 0.0
         while budget > 0:
             with self._cond:
-                self._maybe_start_admission()
-            row = self._admitting
+                self._maybe_start_admission_locked()
+                row = self._admitting
+                if row is not None and row.cancelled:
+                    # Withdrawn mid-prefill: drop its blocks +
+                    # reservation.
+                    self._admitting = None
             if row is not None and row.cancelled:
-                # Withdrawn mid-prefill: drop its blocks + reservation.
-                self._admitting = None
                 self._finish_row(row, "cancelled")
                 continue
-            if self._admitting is None:
+            if row is None:
                 break
             with metrics_mod.annotate("serve.prefill"):
-                n, dur_s = self._prefill_one_chunk(budget)
+                n, dur_s = self._prefill_one_chunk(row, budget)
             budget -= n
             spent += dur_s
         return spent
 
-    def _maybe_start_admission(self) -> None:
+    def _maybe_start_admission_locked(self) -> None:
         """(under _cond) Move the queue head into admission when a
         slot is free and the pool can cover its worst case. FIFO:
         head-of-line blocking is the fairness contract."""
@@ -629,12 +633,13 @@ class PagedGeneratorActor(GeneratorActor):
             self._chunk_progs[C] = prog
         return prog
 
-    def _prefill_one_chunk(self, budget: int | None = None
+    def _prefill_one_chunk(self, row, budget: int | None = None
                            ) -> tuple[int, float]:
-        """Prefill one bounded chunk of the admitting row; returns
-        (prompt tokens written — the budget consumed, chunk seconds —
-        the stall charge)."""
-        row = self._admitting
+        """Prefill one bounded chunk of ``row`` (the admitting row,
+        handed over by ``_admission_round`` — reading it back off
+        ``self._admitting`` here would be a bare cross-thread read);
+        returns (prompt tokens written — the budget consumed, chunk
+        seconds — the stall charge)."""
         toks = row.prompt
         L = len(toks)
         bt = self.block_tokens
@@ -714,7 +719,8 @@ class PagedGeneratorActor(GeneratorActor):
         # The TTFT stamp: the first token exists on the host here.
         self.ledger.first_token(row.rec)
         row.emitted.append(first)
-        self._admitting = None
+        with self._cond:
+            self._admitting = None
         self._export_gauges()
         if (row.max_new == 1
                 or (row.stop_token >= 0 and first == row.stop_token)):
@@ -796,10 +802,9 @@ class PagedGeneratorActor(GeneratorActor):
                 "topp": jnp.asarray(self._topp),
             }
         d = self._dev
+        self._steps += 1
+        self._max_live = max(self._max_live, int(self._active.sum()))
         with self._lock:
-            self._steps += 1
-            self._max_live = max(self._max_live,
-                                 int(self._active.sum()))
             (self.pool.k, self.pool.v, nxt, d["pos"],
              d["eidx"]) = self._engine_step(
                 sampled, self.params, self.pool.k, self.pool.v,
@@ -1046,18 +1051,21 @@ class PagedGeneratorActor(GeneratorActor):
             }
         sd = self._sdev
         sampled = bool((self._temps[self._active] > 0.0).any())
+        self._steps += 1
+        self._max_live = max(self._max_live, len(live))
+        tok_dev = jnp.asarray(self._tok)
+        pos_dev = jnp.asarray(self._pos)
+        sctr_dev = jnp.asarray(self._sctr)
         with self._lock:
-            self._steps += 1
-            self._max_live = max(self._max_live, len(live))
             (out_toks, n_acc, self.pool.k, self.pool.v,
              self._dpool.k, self._dpool.v) = \
                 self._window_prog(W, sampled)(
                     self.params, self._spec.draft_params,
-                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    tok_dev, pos_dev,
                     self.pool.k, self.pool.v, self._dpool.k,
                     self._dpool.v, sd["tables"], sd["dtables"],
                     sd["nalloc"], sd["dnalloc"], sd["active"],
-                    sd["keys"], jnp.asarray(self._sctr), sd["temps"],
+                    sd["keys"], sctr_dev, sd["temps"],
                     sd["topk"], sd["topp"])
         out_host = np.asarray(out_toks)   # the window's ONE host sync
         acc_host = np.asarray(n_acc)
@@ -1209,7 +1217,8 @@ class PagedGeneratorActor(GeneratorActor):
             round(self._max_stall_ms, 3))
         # len() read without _cond on purpose: a point-in-time gauge,
         # and the exporters run on the engine thread mid-admission.
-        reg.gauge("serve.queue_depth").set(len(self._queue))
+        reg.gauge("serve.queue_depth").set(
+            len(self._queue))  # ptlint: disable=PT013 -- point-in-time gauge; list len is GIL-atomic and the engine thread must not contend admission for a sample
         # The kv.* pressure sample the serving alert rules key on.
         self.ledger.kv_sample(st, self.prefix_hit_rate())
 

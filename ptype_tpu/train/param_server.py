@@ -106,10 +106,10 @@ class ParamServer:
             # under the lock below; the version only grows, so this
             # early verdict can never un-reject.
             with self._lock:
-                self._check_staleness(version)
+                self._check_staleness_locked(version)
             grads = collectives.dequantize_tree(grads, self._treedef)
         with self._lock:
-            staleness = self._check_staleness(version)
+            staleness = self._check_staleness_locked(version)
             self._params, self._opt_state = self._apply_fn(
                 self._params, grads, self._opt_state
             )
@@ -119,7 +119,7 @@ class ParamServer:
                 self._quantized += 1  # rejected ones never trained
             return {"version": self._version, "staleness": staleness}
 
-    def _check_staleness(self, version: int) -> int:
+    def _check_staleness_locked(self, version: int) -> int:
         """Raise (and count) when ``version`` is too far behind;
         callers hold the lock. Returns the staleness."""
         staleness = self._version - int(version)

@@ -145,3 +145,27 @@ def wait_output(proc, needle: str, timeout: float):
     raise AssertionError(
         f"did not see {needle!r} within {timeout}s; got: {''.join(lines)}"
     )
+
+
+@pytest.fixture
+def lock_order_watchdog():
+    """ISSUE 14: arm the runtime lock-order watchdog for one test —
+    every lock the stack under test creates is tracked, and a cycle
+    in the acquisition graph (a latent deadlock, hung or not) fails
+    the test at teardown. Hold-budget findings are informational;
+    cycles are the invariant. The concurrency tiers
+    (test_chaos_soak / test_gateway / test_reconciler) alias this as
+    an autouse fixture so every drill runs under it for free."""
+    from ptype_tpu import lockcheck
+
+    was = lockcheck.active()
+    wd = lockcheck.enable()
+    yield wd
+    cycles = wd.cycles()
+    if was is not None:
+        # PTYPE_LOCKCHECK=1 session: hand the env-armed watchdog
+        # back instead of silently disarming the rest of the run.
+        lockcheck._watchdog = was
+    else:
+        lockcheck.disable()
+    assert not cycles, f"lock-order cycles detected: {cycles}"

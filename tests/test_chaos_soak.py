@@ -82,6 +82,14 @@ SOAK_MENU = [
 ]
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_watchdog(lock_order_watchdog):
+    """Every test in this concurrency tier runs under the runtime
+    lock-order watchdog (the shared ``lock_order_watchdog`` fixture in
+    conftest.py — zero cycles is the teardown invariant)."""
+    yield
+
+
 class _Echo:
     def Echo(self, x):
         return x

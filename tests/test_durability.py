@@ -173,7 +173,8 @@ def test_follower_mirror_crash_window_recovers(tmp_path):
     st.close()
     # Old snapshot from a closed state's files: build one by compacting.
     st = _mk(tmp_path / "a", compact_every=10_000)
-    st._compact()
+    with st._lock:
+        st._compact_locked()
     st.close()
     # Simulate: follower truncated the WAL with a NEWER generation
     # header, then crashed before writing the newer snapshot.

@@ -25,6 +25,14 @@ from ptype_tpu.registry import CoordRegistry
 from ptype_tpu.rpc import Client, ConnConfig
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_watchdog(lock_order_watchdog):
+    """Every test in this concurrency tier runs under the runtime
+    lock-order watchdog (the shared ``lock_order_watchdog`` fixture in
+    conftest.py — zero cycles is the teardown invariant)."""
+    yield
+
+
 class _FakeGen:
     """Stands in for a GeneratorActor: same surface (Generate/Info),
     no model — latency injected per-replica."""

@@ -1,13 +1,15 @@
-"""Unit tier for the tooling satellites: the PT001 per-leaf collective
-lint rule, the PT002 bare-sleep-in-retry-loop rule, and the TTL-derived
-repl pump idle tick."""
+"""Unit tier for the house lint rules PT001–PT012 (now served by the
+tools/ptlint package — the ``lint`` name below is the compatibility
+alias over ``ptlint.check_file``) and the TTL-derived repl pump idle
+tick. The ptlint v2 core, the PT013–PT017 passes, and the suppression
+machinery are covered in tests/test_ptlint.py."""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
-import lint  # noqa: E402  (tools/ is not a package)
+import ptlint as lint  # noqa: E402  (tools/ is not a package)
 
 
 def _check(tmp_path, rel, src):

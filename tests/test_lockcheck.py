@@ -1,0 +1,202 @@
+"""Lock-order watchdog unit tier: the seeded deliberate-deadlock
+fixture the watchdog must catch, the hold-budget finding, the
+condition-wait exemption, the disarmed zero-overhead path, and the
+flight-recorder dump seam."""
+
+import threading
+import time
+
+import pytest
+
+from ptype_tpu import lockcheck, trace
+
+
+@pytest.fixture
+def watchdog():
+    wd = lockcheck.enable(hold_budget_s=0.2)
+    yield wd
+    lockcheck.disable()
+
+
+def test_disarmed_factory_returns_plain_primitives():
+    lockcheck.disable()
+    assert isinstance(lockcheck.lock("x"), type(threading.Lock()))
+    assert isinstance(lockcheck.condition("x"), threading.Condition)
+
+
+def test_seeded_deadlock_fixture_is_caught(watchdog):
+    """The acceptance fixture: two threads taking A/B in opposite
+    orders — a latent deadlock whether or not THIS interleaving hung.
+    The watchdog must report the cycle from the orders alone."""
+    a = lockcheck.lock("fixture.A")
+    b = lockcheck.lock("fixture.B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    # SEQUENTIAL on purpose: the graph convicts the inverted ORDERS
+    # without needing the unlucky interleaving that actually hangs —
+    # exactly what makes the check usable in a fast test tier.
+    t1 = threading.Thread(target=ab, daemon=True)
+    t1.start()
+    t1.join(timeout=5)
+    t2 = threading.Thread(target=ba, daemon=True)
+    t2.start()
+    t2.join(timeout=5)
+    cycles = watchdog.cycles()
+    assert cycles, watchdog.report()
+    names = set(cycles[0]["cycle"])
+    assert {"fixture.A", "fixture.B"} <= names
+
+
+def test_consistent_order_reports_no_cycle(watchdog):
+    a = lockcheck.lock("ord.A")
+    b = lockcheck.lock("ord.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert watchdog.cycles() == []
+    assert watchdog.report()["edges"] == {"ord.A": ["ord.B"]}
+
+
+def test_hold_budget_finding(watchdog):
+    slow = lockcheck.lock("hold.slow")
+    with slow:
+        time.sleep(0.25)
+    holds = watchdog.holds()
+    assert holds and holds[0]["lock"] == "hold.slow"
+    assert holds[0]["held_s"] >= 0.2
+
+
+def test_condition_wait_is_not_a_hold(watchdog):
+    cond = lockcheck.condition("cv.q")
+    with cond:
+        cond.wait(timeout=0.3)  # parked, not holding
+    assert watchdog.holds() == [], watchdog.holds()
+
+
+def test_condition_wait_reenters_the_order_graph(watchdog):
+    cond = lockcheck.condition("cv.outer")
+    inner = lockcheck.lock("cv.inner")
+    with cond:
+        cond.wait(timeout=0.01)
+        with inner:  # edge cv.outer -> cv.inner recorded post-wake
+            pass
+    assert watchdog.report()["edges"] == {"cv.outer": ["cv.inner"]}
+
+
+def test_reentrant_rlock_is_not_an_edge(watchdog):
+    r = lockcheck.rlock("re.R")
+    with r:
+        with r:
+            pass
+    assert watchdog.report()["edges"] == {}
+    assert watchdog.cycles() == []
+
+
+def test_cycle_dumps_through_flight_recorder(watchdog, tmp_path):
+    """A detected cycle lands as a span event AND a flight-recorder
+    dump — the post-mortem artifact the runbook row points at."""
+    rec = trace.enable("lockcheck-test", dump_dir=str(tmp_path))
+    try:
+        a = lockcheck.lock("dump.A")
+        b = lockcheck.lock("dump.B")
+        with trace.span("drill"):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert watchdog.cycles()
+        events = [ev for sp in rec.spans() for ev in sp.events
+                  if ev["name"] == "lockcheck.cycle"]
+        assert events, [sp.to_dict() for sp in rec.spans()]
+        dumps = list(tmp_path.glob("flight-*.jsonl"))
+        assert dumps
+    finally:
+        trace.disable()
+
+
+def test_enable_from_env(monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_VAR, "1")
+    lockcheck.disable()
+    lockcheck._maybe_enable_from_env()
+    try:
+        assert lockcheck.active() is not None
+        assert isinstance(lockcheck.lock("env.x"),
+                          lockcheck.TrackedLock)
+    finally:
+        lockcheck.disable()
+
+
+def test_real_components_ride_the_seam(watchdog):
+    """The sweep satellite's contract: a component built while the
+    watchdog is armed contributes its locks to the graph."""
+    from ptype_tpu.health.series import Sampler, SeriesStore
+    from ptype_tpu.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("t.hits").add(1)
+    s = Sampler(reg, store=SeriesStore(), cadence_s=0.01, memory=False)
+    s.sample_once()
+    reg.counter("t.hits").add(1)
+    s.sample_once()
+    assert watchdog.report()["acquires"] > 0
+    assert watchdog.cycles() == []
+
+
+def test_condition_over_tracked_rlock(watchdog):
+    """The coord idiom — ``threading.Condition(self._lock)`` over the
+    seam's state RLock — must work armed: TrackedLock proxies the
+    Condition protocol (``_is_owned``/``_release_save``/
+    ``_acquire_restore``); without them Condition's ``acquire(0)``
+    ownership probe SUCCEEDS on the wrapped re-entrant lock and
+    notify/wait raise 'cannot notify on un-acquired lock'."""
+    lk = lockcheck.rlock("cv.state")
+    cond = threading.Condition(lk)
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(timeout=2.0))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    with cond:
+        with lk:  # reentrant depth 2: _release_save unwinds both
+            pass
+        cond.notify_all()
+    t.join(timeout=5)
+    assert woke == [True], woke
+    assert watchdog.cycles() == []
+    assert watchdog.holds() == []  # the park is not a hold
+
+
+def test_armed_coordinator_replication_acks(watchdog):
+    """End-to-end shape of the crash the Condition proxies fix: an
+    armed CoordState's replication-ack path (Condition over the seam
+    state RLock) must serve a sync put."""
+    from ptype_tpu.coord.core import CoordState
+
+    st = CoordState()
+    feed = st.repl_subscribe()
+    batch = feed.get(timeout=2.0)
+    assert batch and batch[0][0] == "snap"
+    st.put("k", "v")
+    batch = feed.get(timeout=2.0)
+    assert batch and batch[-1][0] == "rec"
+    seq = batch[-1][2]
+    st.note_repl_ack(feed, seq)  # crashed armed before the fix
+    assert st.wait_replicated(seq, timeout=2.0, min_followers=1)
+    feed.cancel()
+    assert watchdog.cycles() == []

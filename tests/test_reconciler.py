@@ -29,6 +29,14 @@ from ptype_tpu.registry import CoordRegistry
 PROMPT = np.zeros((1, 4), np.int32)
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_watchdog(lock_order_watchdog):
+    """Every test in this concurrency tier runs under the runtime
+    lock-order watchdog (the shared ``lock_order_watchdog`` fixture in
+    conftest.py — zero cycles is the teardown invariant)."""
+    yield
+
+
 class _Hint:
     def __init__(self, delta, reason="steady"):
         self.delta = delta

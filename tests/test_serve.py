@@ -349,3 +349,31 @@ def test_continuous_engine_throughput_beats_serialized():
             f"(serialized {t_serial:.3f}s, continuous {t_cont:.3f}s)")
     finally:
         continuous.close()
+
+
+def test_info_and_drain_gate_do_not_ride_the_decode_lock():
+    """ISSUE 14 regression (PT013 sweep): the load-telemetry surface —
+    Info()'s counters, the drain gate, begin_drain — lives entirely on
+    the load lock, so a decode loop HOLDING the serialization lock can
+    never stall probes or drain orders (the gateway evicts a replica
+    whose Info stops answering)."""
+    import threading
+
+    from ptype_tpu.serve import GeneratorActor
+
+    actor = GeneratorActor(CFG)
+    out: dict = {}
+
+    def probe():
+        out["info"] = actor.Info()
+        actor.begin_drain()
+        out["drained"] = actor.drained()
+
+    with actor._lock:  # a decode loop is "in flight"
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), \
+            "Info()/begin_drain() blocked behind the decode lock"
+    assert out["info"]["calls"] == 0
+    assert out["drained"] is True  # drain flag + zero in flight

@@ -1,0 +1,47 @@
+"""ptlint — the repo's stdlib-only static analyzer, as a package.
+
+The reference gated every commit on golangci-lint
+(/root/reference/.golangci.yml) and leaned on Go's race detector to
+keep its concurrency honest; this image bakes in no Python linter and
+installs are barred, so ``make lint`` runs this checker instead. v2
+grows the old single-file walker (tools/lint.py, 12 ad-hoc visitors)
+into a package with a shared scope/dataflow core and a rule registry:
+
+- ``core``       — Finding / FileContext / registry / suppressions
+                   (``# ptlint: disable=PTxxx`` with justification,
+                   unused-suppression detection), JSON output
+- ``scopes``     — the shared dataflow helpers every pass rides:
+                   lock-context walking, import-alias resolution,
+                   terminal names, per-function load/store indexes
+- ``rules_style``  — the pyflakes-grade base checks (E999/E722/B006/
+                     E711/F541/F401/F821)
+- ``rules_domain`` — PT001–PT012, migrated from tools/lint.py with
+                     behavior pinned by a golden-output test
+- ``rules_concurrency`` — PT013 lock-discipline, PT014
+                     blocking-under-lock, PT015 thread-hygiene
+- ``rules_jax``  — PT016 donation-safety, PT017 RNG-key-reuse
+
+The rule catalogue (ID, rationale, example, suppression policy) lives
+in docs/LINTING.md. Exit 0 when clean; 1 with one
+``path:line: code message`` per finding (or a JSON array under
+``--json``).
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401 — the package surface
+    FileContext,
+    Finding,
+    RULES,
+    check_file,
+    check_file_findings,
+    iter_py,
+    main,
+    run_paths,
+)
+
+# Importing the rule modules registers every rule with the registry.
+from . import rules_style  # noqa: F401,E402
+from . import rules_domain  # noqa: F401,E402
+from . import rules_concurrency  # noqa: F401,E402
+from . import rules_jax  # noqa: F401,E402

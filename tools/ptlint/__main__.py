@@ -1,0 +1,18 @@
+"""CLI entry: ``python -m tools.ptlint [--json] [paths...]`` from the
+repo root (tools/ is a PEP 420 namespace package), or ``python -m
+ptlint`` with tools/ on PYTHONPATH — both resolve to the same package.
+"""
+
+import sys
+
+if __package__ in (None, ""):  # executed as a bare directory/script
+    import os
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from ptlint import main
+else:
+    from . import main
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,480 @@
+"""PT013/PT014/PT015 — the concurrency passes, tuned to this repo's
+real defect history (drain-gate TOCTOU, control-RPC-held-under-lock,
+zombie threads — the classes PR 2 and PR 12 fixed by hand).
+
+All three ride the shared lock-context walker in :mod:`.scopes`; the
+conventions they encode:
+
+- a lock is anything whose name looks like one (``self._lock``,
+  ``r.lock``, ``self._cond`` — see :func:`scopes.is_lockish`);
+- ``*_locked`` methods are caller-holds-the-lock helpers (the house
+  convention: ``_sample_locked``, ``_drain_ttft_locked``) and are
+  exempt from PT013's bare-access check;
+- ``__init__`` is exempt too: construction happens-before publication.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, rule
+from .scopes import (
+    ContextWalker,
+    ImportMap,
+    is_lockish,
+    terminal_name,
+    unparse,
+)
+
+# --------------------------------------------------------------- PT013
+
+#: Methods whose attribute accesses never need the lock: construction
+#: happens-before publication, and ``*_locked`` helpers document that
+#: their CALLER holds the lock.
+_PT013_EXEMPT = ("__init__", "__new__", "__del__")
+
+#: Constructors whose product is itself thread-safe (or is the
+#: synchronization): an attribute holding one of these needs no lock.
+_SYNC_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "local",
+    "WeakSet", "WeakValueDictionary",
+    # the repo's own tracked-lock seam (ptype_tpu.lockcheck)
+    "lock", "rlock", "condition",
+})
+
+
+class _Access:
+    __slots__ = ("attr", "method", "line", "locks", "store")
+
+    def __init__(self, attr, method, line, locks, store):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.locks = locks      # frozenset of held self-lock attrs
+        self.store = store
+
+
+class _MethodWalker(ContextWalker):
+    """Collect per-attribute accesses of one method (nested closures
+    included — a spawn thread's body mutates the same ``self``)."""
+
+    def __init__(self, method_name: str, self_name: str, out: list):
+        super().__init__()
+        self.method = method_name
+        self.self_name = self_name
+        self.out = out
+
+    def _self_locks(self) -> frozenset:
+        held = set()
+        for h in self.held_locks:
+            # `with self._lock:` — held self-attribute locks only;
+            # foreign locks (`with r.lock:`) don't guard self state.
+            if h.expr == f"{self.self_name}.{h.name}":
+                held.add(h.name)
+        return frozenset(held)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name)
+                and node.value.id == self.self_name):
+            self.out.append(_Access(
+                node.attr, self.method, node.lineno,
+                self._self_locks(),
+                isinstance(node.ctx, (ast.Store, ast.Del))))
+        self.generic_visit(node)
+
+
+def _class_method_names(cls: ast.ClassDef) -> set[str]:
+    names = set()
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(stmt.name)
+    return names
+
+
+def _self_arg(fn) -> str | None:
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+def _sync_attrs(cls: ast.ClassDef, self_name_by_method: dict) -> set:
+    """Attributes assigned a synchronization/thread-safe object
+    anywhere in the class (usually ``__init__``)."""
+    out = set()
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        self_name = self_name_by_method.get(stmt.name)
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and terminal_name(node.value.func) in _SYNC_CTORS):
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == self_name):
+                    out.add(t.attr)
+    return out
+
+
+def _init_only_methods(methods, self_by_method) -> set[str]:
+    """Methods reachable ONLY from ``__init__``/``__new__``: their
+    accesses happen-before the object is published to other threads,
+    so they need no lock (fixpoint over the in-class self-call
+    graph). A method with no in-class caller is public API and stays
+    accountable."""
+    callers: dict[str, set] = {}
+    for m in methods:
+        self_name = self_by_method.get(m.name)
+        for node in ast.walk(m):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == self_name):
+                callers.setdefault(node.func.attr, set()).add(m.name)
+    exempt = {"__init__", "__new__"}
+    changed = True
+    while changed:
+        changed = False
+        for m in methods:
+            if m.name in exempt:
+                continue
+            cs = callers.get(m.name)
+            if cs and cs <= exempt:
+                exempt.add(m.name)
+                changed = True
+    return exempt - {"__init__", "__new__"}
+
+
+def _check_class_pt013(ctx: FileContext, cls: ast.ClassDef,
+                       findings: list[Finding]) -> None:
+    methods = [stmt for stmt in cls.body
+               if isinstance(stmt, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+    method_names = _class_method_names(cls)
+    self_by_method = {m.name: _self_arg(m) for m in methods}
+    sync_attrs = _sync_attrs(cls, self_by_method)
+    init_only = _init_only_methods(methods, self_by_method)
+
+    accesses: list = []
+    for m in methods:
+        self_name = self_by_method.get(m.name)
+        if not self_name:
+            continue  # staticmethod-shaped: no self state
+        w = _MethodWalker(m.name, self_name, accesses)
+        w.visit(m)
+
+    # attr -> observed facts across NON-exempt methods.
+    locked_by: dict[str, set] = {}
+    bare: dict[str, list] = {}
+    stored_outside_init: set[str] = set()
+    for a in accesses:
+        attr = a.attr
+        if (attr in method_names or attr in sync_attrs
+                or is_lockish(attr)):
+            continue
+        exempt = (a.method in _PT013_EXEMPT
+                  or a.method in init_only
+                  or a.method.endswith("_locked"))
+        if a.store and a.method not in ("__init__", "__new__"):
+            stored_outside_init.add(attr)
+        if exempt:
+            continue
+        if a.locks:
+            locked_by.setdefault(attr, set()).update(a.locks)
+        else:
+            bare.setdefault(attr, []).append(a)
+
+    for attr in sorted(locked_by):
+        if attr not in bare or attr not in stored_outside_init:
+            # Never guarded anywhere, or effectively immutable after
+            # construction (only __init__ writes it): not shared
+            # mutable state the lock is protecting.
+            continue
+        locks = "/".join(sorted(f"self.{name}"
+                                for name in locked_by[attr]))
+        # One finding per (attr, method): the first bare access in
+        # each offending method, so a fix or a suppression is local.
+        first_in_method: dict[str, _Access] = {}
+        for a in bare[attr]:
+            cur = first_in_method.get(a.method)
+            if cur is None or a.line < cur.line:
+                first_in_method[a.method] = a
+        guarded_in = sorted({a.method for a in accesses
+                             if getattr(a, "attr", None) == attr
+                             and a.locks})
+        for m, a in sorted(first_in_method.items(),
+                           key=lambda kv: kv[1].line):
+            findings.append(Finding(
+                ctx.path, a.line, "PT013",
+                f"attribute 'self.{attr}' is guarded by {locks} in "
+                f"{', '.join(guarded_in[:3])} but accessed bare in "
+                f"{m} — check-then-act on it races the guarded "
+                f"writers (the drain-gate TOCTOU class); take the "
+                f"lock, or rename the method '*_locked' if the "
+                f"caller holds it"))
+
+
+@rule("PT013",
+      "lock-discipline: attribute guarded in some methods, bare in "
+      "others",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename != "lockcheck.py")
+def check_pt013(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class_pt013(ctx, node, findings)
+    return findings
+
+
+# --------------------------------------------------------------- PT014
+
+#: Call terminal names that block on I/O or another thread: dialing,
+#: wire sends/receives, synchronous RPC, future waits, subprocess.
+_BLOCKING_VERBS = frozenset({
+    "dial", "_dial", "create_connection", "send_msg", "recv_msg",
+    "call", "_call", "result", "communicate", "check_call",
+    "check_output", "Popen", "getaddrinfo", "connect", "accept",
+})
+_SUBPROCESS_FNS = frozenset({
+    "run", "call", "check_call", "check_output", "Popen",
+})
+#: Receiver names that mark a ``.join`` as a THREAD join (str.join is
+#: the overwhelmingly common false positive this filter removes).
+_THREADISH = ("thread", "proc", "process", "worker", "reader",
+              "watcher")
+
+
+class _Pt014Walker(ContextWalker):
+    def __init__(self, ctx, findings):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+        self.imports = ImportMap(ctx.tree)
+        #: Names assigned ``threading.Thread(...)`` per function —
+        #: the lightweight dataflow that makes `t.join()` a thread
+        #: join even without a thread-ish name.
+        self.thread_vars: list[set] = [set()]
+
+    def _fn(self, node) -> None:
+        self.thread_vars.append(set())
+        super()._fn(node)
+        self.thread_vars.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (isinstance(node.value, ast.Call)
+                and terminal_name(node.value.func) == "Thread"):
+            for t in node.targets:
+                self.thread_vars[-1].add(unparse(t))
+        self.generic_visit(node)
+
+    def _flag(self, node, what: str) -> None:
+        lock = self.held_locks[-1]
+        self.findings.append(self.ctx.finding(
+            node, "PT014",
+            f"blocking call {what} while holding '{lock.expr}' — "
+            f"every other acquirer stalls for the call's full "
+            f"latency (dial timeouts, sleeps, subprocess waits); "
+            f"move the call outside the critical section and "
+            f"publish its result under the lock (the PR 12 "
+            f"control-RPC-under-lock class)"))
+
+    def _is_thread_join(self, recv: ast.expr, node: ast.Call) -> bool:
+        if isinstance(recv, ast.Constant):
+            return False  # ", ".join(...)
+        name = (terminal_name(recv) or "").lower()
+        if any(k in name for k in _THREADISH):
+            return True
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return True
+        return unparse(recv) in self.thread_vars[-1]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.holding():
+            fn = node.func
+            name = terminal_name(fn)
+            if isinstance(fn, ast.Attribute):
+                recv = fn.value
+                if name == "sleep":
+                    self._flag(node, f"{unparse(fn)}()")
+                elif name == "wait" and not self.holds_expr(
+                        unparse(recv)):
+                    # cond.wait() while holding cond is the condition-
+                    # variable protocol, not a blocked hold.
+                    self._flag(node, f"{unparse(fn)}()")
+                elif name == "join" and self._is_thread_join(recv,
+                                                             node):
+                    self._flag(node, f"{unparse(fn)}()")
+                elif (isinstance(recv, ast.Name)
+                        and recv.id == "subprocess"
+                        and name in _SUBPROCESS_FNS):
+                    self._flag(node, f"subprocess.{name}()")
+                elif (isinstance(recv, ast.Name)
+                        and recv.id == "chaos"
+                        and name in ("hit", "note_ok")):
+                    self._flag(node, f"chaos.{name}() (the seam may "
+                               f"inject a delay)")
+                elif name in _BLOCKING_VERBS:
+                    self._flag(node, f"{unparse(fn)}()")
+            elif isinstance(fn, ast.Name):
+                src = self.imports.from_names.get(fn.id)
+                if fn.id == "sleep" or (
+                        src is not None and src == ("time", "sleep")):
+                    self._flag(node, f"{fn.id}()")
+                elif src is not None and src[0] == "subprocess" \
+                        and src[1] in _SUBPROCESS_FNS:
+                    self._flag(node, f"{fn.id}() (subprocess)")
+                elif fn.id == "create_connection" or (
+                        src is not None
+                        and src[1] == "create_connection"):
+                    self._flag(node, f"{fn.id}()")
+        self.generic_visit(node)
+
+
+@rule("PT014", "blocking call under a held lock",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename not in (
+          "lockcheck.py",))
+def check_pt014(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _Pt014Walker(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT015
+
+
+class _ThreadBirth:
+    __slots__ = ("node", "target", "cls", "fn", "daemon")
+
+    def __init__(self, node, target, cls, fn):
+        self.node = node
+        self.target = target  # unparse of the assignment target, or None
+        self.cls = cls        # enclosing ClassDef name, or None
+        self.fn = fn          # enclosing function name, or None
+        self.daemon = False
+
+
+class _Pt015Walker(ast.NodeVisitor):
+    """Collect Thread constructions + every ``.join`` receiver and
+    ``.daemon = True`` target, then reconcile."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.births: list[_ThreadBirth] = []
+        self.join_recvs: set[tuple] = set()   # (cls|None, recv text)
+        self.daemon_sets: set[tuple] = set()
+        self.cls_stack: list[str] = []
+        self.fn_stack: list[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls_stack.append(node.name)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+
+    def _fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def _cls(self):
+        return self.cls_stack[-1] if self.cls_stack else None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (isinstance(node.value, ast.Call)
+                and terminal_name(node.value.func) == "Thread"):
+            b = _ThreadBirth(node.value,
+                             unparse(node.targets[0]),
+                             self._cls(),
+                             self.fn_stack[-1] if self.fn_stack
+                             else None)
+            b.daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.value.keywords)
+            self.births.append(b)
+        for t in node.targets:
+            # t.daemon = True after construction
+            if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                self.daemon_sets.add((self._cls(), unparse(t.value)))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if terminal_name(fn) == "Thread" and not any(
+                b.node is node for b in self.births):
+            # Unassigned construction (e.g. Thread(...).start(), or a
+            # list comprehension element).
+            b = _ThreadBirth(node, None, self._cls(),
+                             self.fn_stack[-1] if self.fn_stack
+                             else None)
+            b.daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords)
+            self.births.append(b)
+        if (isinstance(fn, ast.Attribute) and fn.attr == "join"
+                and not isinstance(fn.value, ast.Constant)):
+            # Recorded with BOTH class and function scope: `self.X`
+            # threads may be joined from any method (the close()
+            # contract), but a local thread's join must be reachable
+            # from its own function — a bare `t.join()` in some OTHER
+            # method says nothing about this birth.
+            self.join_recvs.add((self._cls(),
+                                 self.fn_stack[-1] if self.fn_stack
+                                 else None,
+                                 unparse(fn.value)))
+        self.generic_visit(node)
+
+
+def _joined(w: _Pt015Walker, b: _ThreadBirth) -> bool:
+    if b.target is None:
+        return False
+    if b.target.startswith("self."):
+        # Attribute-held threads: a join anywhere in the class is the
+        # close()/stop() path the rule asks for.
+        return any(cls == b.cls and recv == b.target
+                   for cls, fn, recv in w.join_recvs)
+    # Locally-named threads: an exact-name join in the SAME function,
+    # or (`threads.append(t)` + `for t in threads: t.join()`) any
+    # bare-name join in the same function — a join in some OTHER
+    # method does not reach this birth.
+    return any(cls == b.cls and fn == b.fn
+               and (recv == b.target or "." not in recv)
+               for cls, fn, recv in w.join_recvs)
+
+
+@rule("PT015",
+      "thread-hygiene: non-daemon thread without a reachable join",
+      applies=lambda ctx: ctx.in_pkg)
+def check_pt015(ctx: FileContext) -> list[Finding]:
+    w = _Pt015Walker(ctx)
+    w.visit(ctx.tree)
+    findings: list[Finding] = []
+    for b in w.births:
+        if b.daemon:
+            continue
+        if b.target is not None and (b.cls, b.target) in w.daemon_sets:
+            continue
+        if _joined(w, b):
+            continue
+        where = (f"self.{b.target.split('.', 1)[1]}"
+                 if b.target and b.target.startswith("self.")
+                 else (b.target or "<unassigned>"))
+        findings.append(ctx.finding(
+            b.node, "PT015",
+            f"thread {where} is neither daemonized nor joined — a "
+            f"zombie thread outlives its owner's close() and wakes "
+            f"against torn-down state (the PR 2 server contract: "
+            f"daemon=True, or a bounded join in a close/stop path)"))
+    return findings

@@ -1,0 +1,475 @@
+"""PT001–PT012: the house rules, migrated from tools/lint.py.
+
+Each rule guards one architectural seam this repo earned the hard way
+(the full rationale per rule lives in docs/LINTING.md). Migration is
+behavior-preserving: the golden-output test in tests/test_ptlint.py
+pins these against the old walker's findings on a fixture tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, rule
+from .scopes import ContextWalker, terminal_name
+
+# --------------------------------------------------------------- PT001
+
+#: Method/function names that dispatch one eager collective per call.
+_EAGER_COLLECTIVES = frozenset({
+    "push", "push_scatter", "all_reduce", "all_gather",
+    "reduce_scatter", "quantized_all_reduce",
+    "quantized_reduce_scatter", "all_to_all", "ring_shift",
+})
+
+
+class _PerLeafCollectiveCheck(ast.NodeVisitor):
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.loop_depth = 0
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+    visit_ListComp = visit_SetComp = _loop
+    visit_DictComp = visit_GeneratorExp = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = terminal_name(node.func)
+        if self.loop_depth and name in _EAGER_COLLECTIVES:
+            self.findings.append(self.ctx.finding(
+                node, "PT001",
+                f"eager collective {name!r} called in a per-leaf "
+                f"loop; bucket it (TensorStore.push_tree / "
+                f"collectives.tree_all_reduce)"))
+        self.generic_visit(node)
+
+
+@rule("PT001", "eager collective in a per-leaf loop (train/ only)",
+      applies=lambda ctx: ctx.in_dir("train"))
+def check_pt001(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _PerLeafCollectiveCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT002
+
+
+class _SleepInLoopCheck(ast.NodeVisitor):
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.loop_depth = 0
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (self.loop_depth
+                and isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("time", "_time")):
+            self.findings.append(self.ctx.finding(
+                node, "PT002",
+                "bare time.sleep in a loop; use ptype_tpu.retry."
+                "Backoff (jittered, capped) or an Event.wait deadline"))
+        self.generic_visit(node)
+
+
+@rule("PT002", "bare time.sleep in a loop (retry.py is the sleeper)",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename != "retry.py")
+def check_pt002(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _SleepInLoopCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT003
+
+_GATED_SERVICES = frozenset({"llm"})
+
+
+@rule("PT003", "direct new_client('llm') bypasses the gateway",
+      applies=lambda ctx: ctx.in_pkg and not ctx.in_dir("gateway"))
+def check_pt003(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if (name == "new_client" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in _GATED_SERVICES):
+            findings.append(ctx.finding(
+                node, "PT003",
+                f"direct new_client({node.args[0].value!r}) bypasses "
+                f"the inference gateway (admission control, shedding, "
+                f"load-aware routing); use gateway.InferenceGateway "
+                f"or a GatewayActor service"))
+    return findings
+
+
+# --------------------------------------------------------------- PT004
+
+
+@rule("PT004", "bare print() in framework code",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename != "__main__.py")
+def check_pt004(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            findings.append(ctx.finding(
+                node, "PT004",
+                "bare print() in framework code; use logs.get_logger "
+                "(trace-correlated kv logging) or a trace span event"))
+    return findings
+
+
+# --------------------------------------------------------------- PT005
+
+_METRIC_FAMILIES = frozenset({"Counter", "Timing", "Gauge", "Histogram"})
+_METRICS_ALIASES = frozenset({"metrics", "metrics_mod"})
+
+
+@rule("PT005", "metric family constructed outside MetricsRegistry",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename != "metrics.py")
+def check_pt005(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name) and fn.id in _METRIC_FAMILIES:
+            name = fn.id
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in _METRIC_FAMILIES
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id in _METRICS_ALIASES):
+            name = fn.attr
+        if name is not None:
+            findings.append(ctx.finding(
+                node, "PT005",
+                f"direct {name}() construction bypasses the "
+                f"MetricsRegistry — the health sampler can't see it "
+                f"(no series, no alerts); use "
+                f"registry.{name.lower()}(name)"))
+    return findings
+
+
+# --------------------------------------------------------------- PT006
+
+_QUANT_HELPER_PREFIXES = ("_q_", "quantize", "dequantize")
+
+
+def _is_int8_arg(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value == "int8"
+    if isinstance(node, ast.Attribute) and node.attr == "int8":
+        return True
+    if (isinstance(node, ast.Call) and node.args
+            and isinstance(node.args[0], ast.Constant)):
+        return node.args[0].value == "int8"
+    return False
+
+
+class _RawInt8CastCheck(ContextWalker):
+    def __init__(self, ctx, findings):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+
+    def _sanctioned(self) -> bool:
+        return any(name.startswith(_QUANT_HELPER_PREFIXES)
+                   for name in self.fn_stack)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        dtype_args = list(node.args[:1]) + [
+            kw.value for kw in node.keywords if kw.arg == "dtype"]
+        if (isinstance(fn, ast.Attribute) and fn.attr == "astype"
+                and any(_is_int8_arg(a) for a in dtype_args)
+                and not self._sanctioned()):
+            self.findings.append(self.ctx.finding(
+                node, "PT006",
+                "raw .astype(int8) narrowing outside the quantize "
+                "helpers — an unscaled int8 cast destroys gradients "
+                "(saturation + underflow); use collectives."
+                "_q_int8_blockwise / quantize_leaf, which carry "
+                "per-block absmax scales"))
+        self.generic_visit(node)
+
+
+@rule("PT006", "raw int8 cast outside the quantize helpers",
+      applies=lambda ctx: ctx.in_pkg and ctx.in_dir("parallel"))
+def check_pt006(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _RawInt8CastCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT007
+
+_OPT_INIT_SANCTIONED = ("__init__", "init_", "_init")
+
+
+class _FullTreeOptStateCheck(ContextWalker):
+    def __init__(self, ctx, findings):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+
+    def _sanctioned(self) -> bool:
+        return any(name.startswith(_OPT_INIT_SANCTIONED)
+                   for name in self.fn_stack)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "init"
+                and not self._sanctioned()):
+            recv = terminal_name(fn.value)
+            if recv is not None and (
+                    "optimizer" in recv.lower()
+                    or recv in ("opt", "_opt")):
+                self.findings.append(self.ctx.finding(
+                    node, "PT007",
+                    f"full-tree optimizer state constructed outside "
+                    f"the init helpers ({recv}.init) — replicated "
+                    f"moments cap trainable model size; hot paths "
+                    f"must use the sharded state (parallel/zero."
+                    f"ZeroState, 1/N per replica) or the per-bucket "
+                    f"states the init helpers set up"))
+        self.generic_visit(node)
+
+
+@rule("PT007", "full-tree optimizer.init outside init helpers",
+      applies=lambda ctx: ctx.in_dir("train"))
+def check_pt007(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _FullTreeOptStateCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT008
+
+
+class _RawProfilerTraceCheck(ast.NodeVisitor):
+    _VERBS = frozenset({"start_trace", "stop_trace"})
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.from_profiler: set[str] = set()
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.module.endswith("profiler"):
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.from_profiler.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        hit = None
+        if (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "profiler"):
+            hit = fn.attr            # jax.profiler.start_trace(...)
+        elif (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "profiler"):
+            hit = fn.attr            # from jax import profiler
+        elif (isinstance(fn, ast.Name)
+                and fn.id in self.from_profiler):
+            hit = fn.id              # from jax.profiler import ...
+        if hit is not None:
+            self.findings.append(self.ctx.finding(
+                node, "PT008",
+                f"raw jax.profiler.{hit} — the profiler is "
+                f"process-global and this call races the managed "
+                f"capture plane; go through health/profiling.py "
+                f"(start/stop/capture or the ptype.Profile endpoint)"))
+        self.generic_visit(node)
+
+
+@rule("PT008", "raw jax.profiler start/stop outside the managed seam",
+      applies=lambda ctx: ctx.in_pkg and ctx.basename not in (
+          "metrics.py", "profiling.py"))
+def check_pt008(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _RawProfilerTraceCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT009
+
+
+@rule("PT009", "raw init_cache bank outside serve_engine/models",
+      applies=lambda ctx: (ctx.in_pkg
+                           and not ctx.in_dir("serve_engine")
+                           and not ctx.in_dir("models")))
+def check_pt009(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "init_cache"):
+            findings.append(ctx.finding(
+                node, "PT009",
+                "raw init_cache full-reach bank allocation in "
+                "serving code — resident KV must come from the paged "
+                "block pool (serve_engine.BlockPool: ref-counted "
+                "blocks, prefix reuse, LRU eviction), not a "
+                "contiguous n_slots×reach bank"))
+    return findings
+
+
+# --------------------------------------------------------------- PT010
+
+
+class _RawTimerCheck(ast.NodeVisitor):
+    _VERBS = frozenset({"perf_counter", "time"})
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.mods: set[str] = set()
+        self.funcs: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "time":
+                self.mods.add(a.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.funcs[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, verb: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT010",
+            f"raw time.{verb} in serve_engine/ — engine latency "
+            f"stamps must ride the serving ledger's seams "
+            f"(health/serving.py: enqueued/head_refused/admitted/"
+            f"chunk/first_token/tokens_emitted/iteration/retired), "
+            f"the one timing home the histograms, span tree, and "
+            f"seam-cost probe all derive from"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr in self._VERBS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in (self.mods or {"time", "_time"})):
+            self._flag(node, fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node, self.funcs[fn.id])
+        self.generic_visit(node)
+
+
+@rule("PT010", "raw wall-clock reads beside the serving ledger",
+      applies=lambda ctx: ctx.in_pkg and ctx.in_dir("serve_engine"))
+def check_pt010(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _RawTimerCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT011
+
+
+class _RawSamplingCheck(ast.NodeVisitor):
+    _VERBS = frozenset({"categorical", "gumbel"})
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.rand_mods: set[str] = set()
+        self.funcs: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "jax.random" and a.asname:
+                self.rand_mods.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    self.rand_mods.add(a.asname or "random")
+        elif node.module == "jax.random":
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.funcs[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, verb: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT011",
+            f"direct jax.random.{verb} sampling in serve_engine/ — "
+            f"acceptance sampling has one RNG home (models/generate."
+            f"py: sample_token_rows/draft_propose_paged/"
+            f"spec_accept_rows, the contract-tested helpers); a raw "
+            f"draw here silently rots the exact-distribution "
+            f"contract"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in self._VERBS:
+            base = fn.value
+            if (isinstance(base, ast.Attribute)
+                    and base.attr == "random"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "jax"):
+                self._flag(node, fn.attr)   # jax.random.categorical
+            elif (isinstance(base, ast.Name)
+                    and base.id in self.rand_mods):
+                self._flag(node, fn.attr)   # random.categorical / jr.
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node, self.funcs[fn.id])
+        self.generic_visit(node)
+
+
+@rule("PT011", "ad-hoc sampling draw beside the RNG home",
+      applies=lambda ctx: ctx.in_pkg and ctx.in_dir("serve_engine"))
+def check_pt011(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _RawSamplingCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT012
+
+
+@rule("PT012", "ActorServer built outside the replica-lifecycle home",
+      applies=lambda ctx: (ctx.in_pkg
+                           and not ctx.in_dir("reconciler")
+                           and ctx.basename != "serve.py"))
+def check_pt012(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "ActorServer"):
+            findings.append(ctx.finding(
+                node, "PT012",
+                "direct ActorServer construction outside the "
+                "replica-lifecycle home — the elastic reconciler can "
+                "neither drain nor replace a replica it didn't "
+                "build; construct through reconciler.replica."
+                "serve_actor / ReplicaHost"))
+    return findings

@@ -1,0 +1,245 @@
+"""PT016/PT017 — the JAX-safety dataflow passes.
+
+- **PT016 donation-safety**: an argument donated via
+  ``donate_argnums`` is INVALID after the jitted call — its buffer was
+  handed to XLA for reuse. Reading it afterwards either crashes
+  ("buffer has been deleted") on hardware or, worse, silently reads
+  whatever happened to still be resident under some backends. The pass
+  maps every ``name = jax.jit(f, donate_argnums=...)`` binding (module,
+  class or local scope), then at each call site of that binding checks
+  whether a donated argument expression is loaded again later in the
+  same function without an intervening rebind.
+
+- **PT017 RNG-key-reuse**: the same ``jax.random`` key flowing into
+  two draws without a ``split``/``fold_in`` between yields CORRELATED
+  samples (identical, for the same draw shape) — the serving engine's
+  exact-distribution contract dies silently. The pass tracks key
+  names through a function in statement order: a second draw from an
+  already-consumed key name with no rebinding between is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, rule
+from .scopes import ImportMap, index_loads_stores, terminal_name, unparse
+
+# --------------------------------------------------------------- PT016
+
+
+def _donated_indices(call: ast.Call) -> tuple | None:
+    """The donate_argnums of a ``jax.jit``/``jit`` call, or None."""
+    if terminal_name(call.func) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    out.append(elt.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _collect_donating_bindings(tree: ast.AST) -> dict[str, tuple]:
+    """binding expression text -> donated indices, for every
+    ``<target> = jax.jit(..., donate_argnums=...)`` in the file."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        donated = _donated_indices(node.value)
+        if not donated:
+            continue
+        for t in node.targets:
+            out[unparse(t)] = donated
+    return out
+
+
+def _check_fn_pt016(ctx: FileContext, fn, bindings: dict,
+                    findings: list[Finding]) -> None:
+    loads, stores = index_loads_stores(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        donated = bindings.get(unparse(node.func))
+        if not donated:
+            continue
+        call_end = getattr(node, "end_lineno", node.lineno)
+        for idx in donated:
+            if idx >= len(node.args):
+                continue
+            arg = node.args[idx]
+            if not isinstance(arg, (ast.Name, ast.Attribute,
+                                    ast.Subscript)):
+                continue
+            expr = unparse(arg)
+            rebinds = [s for s in stores.get(expr, [])
+                       if s >= node.lineno]
+            for load_line in loads.get(expr, []):
+                if load_line <= call_end:
+                    continue
+                if any(node.lineno <= s <= load_line
+                       for s in rebinds):
+                    break  # rebound (the donation idiom: x = f(x))
+                findings.append(Finding(
+                    ctx.path, load_line, "PT016",
+                    f"'{expr}' was DONATED to {unparse(node.func)} "
+                    f"(donate_argnums position {idx}, line "
+                    f"{node.lineno}) and is read again here — the "
+                    f"buffer now belongs to XLA (deleted-buffer "
+                    f"crash on TPU, silent garbage elsewhere); "
+                    f"rebind the result or drop the stale "
+                    f"reference"))
+                break  # one finding per donated arg per call
+
+
+@rule("PT016", "donated argument read after the jitted call",
+      applies=lambda ctx: ctx.in_pkg)
+def check_pt016(ctx: FileContext) -> list[Finding]:
+    bindings = _collect_donating_bindings(ctx.tree)
+    if not bindings:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_fn_pt016(ctx, node, bindings, findings)
+    return findings
+
+
+# --------------------------------------------------------------- PT017
+
+#: jax.random callables that CONSUME a key but are key-plumbing, not
+#: draws: a second use after them is still a bug, but they are how a
+#: key is split into independent streams, so they never mark a key
+#: "used" (the typical idiom rebinds: ``key, sub = split(key)`` —
+#: the Store clears the name anyway).
+_NON_DRAWS = frozenset({
+    "split", "fold_in", "PRNGKey", "key", "key_data",
+    "wrap_key_data", "clone", "key_impl",
+})
+
+
+class _Pt017Walker(ast.NodeVisitor):
+    """Per-function linear scan: draw calls consume key names; a
+    rebinding (Store) refreshes them."""
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.imports = ImportMap(ctx.tree)
+        self.rand_mods = self.imports.module_aliases("jax.random")
+        self.from_draws = {
+            local: orig
+            for local, (mod, orig) in self.imports.from_names.items()
+            if mod == "jax.random" and orig not in _NON_DRAWS}
+
+    def _draw_verb(self, call: ast.Call) -> str | None:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if fn.attr in _NON_DRAWS:
+                return None
+            if (isinstance(base, ast.Attribute)
+                    and base.attr == "random"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "jax"):
+                return fn.attr          # jax.random.uniform(...)
+            if (isinstance(base, ast.Name)
+                    and base.id in self.rand_mods):
+                return fn.attr          # jr.uniform / random.uniform
+        elif isinstance(fn, ast.Name) and fn.id in self.from_draws:
+            return self.from_draws[fn.id]
+        return None
+
+    @staticmethod
+    def _walk_shallow(root):
+        """ast.walk, but stopping at nested function defs (they get
+        their own linear scan — re-scanning their bodies as part of
+        the parent would double-report every nested draw)."""
+        todo = list(ast.iter_child_nodes(root))
+        while todo:
+            node = todo.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                todo.extend(ast.iter_child_nodes(node))
+
+    def _fn(self, node) -> None:
+        used: dict[str, int] = {}   # key expr -> draw line
+        # Walk in source order; track rebinds as they appear.
+        for sub in sorted(
+                [n for n in self._walk_shallow(node)
+                 if isinstance(n, (ast.Call, ast.Name, ast.Attribute,
+                                   ast.Subscript))],
+                key=lambda n: (n.lineno, n.col_offset)):
+            if isinstance(sub, (ast.Name, ast.Attribute,
+                                ast.Subscript)):
+                if isinstance(getattr(sub, "ctx", None),
+                              (ast.Store, ast.Del)):
+                    used.pop(unparse(sub), None)
+                continue
+            verb = self._draw_verb(sub)
+            if verb is None:
+                continue
+            if not sub.args:
+                continue
+            key = sub.args[0]
+            if not isinstance(key, (ast.Name, ast.Attribute,
+                                    ast.Subscript)):
+                continue
+            expr = unparse(key)
+            prev = used.get(expr)
+            if prev is not None:
+                self.findings.append(self.ctx.finding(
+                    sub, "PT017",
+                    f"key '{expr}' already fed a jax.random draw at "
+                    f"line {prev} and flows into jax.random.{verb} "
+                    f"with no split/fold_in between — the two draws "
+                    f"are correlated (identical for equal shapes); "
+                    f"split the key or fold_in a step counter"))
+            else:
+                used[expr] = sub.lineno
+        # No recursion into nested defs from here: each function is
+        # visited on its own by generic dispatch below.
+
+    def visit_FunctionDef(self, node) -> None:
+        self._fn(node)
+        for stmt in node.body:
+            self.generic_visit_nested(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def generic_visit_nested(self, node) -> None:
+        """Descend looking for NESTED function defs only (their bodies
+        get their own linear scan; re-scanning them as part of the
+        parent would double-report)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                self.visit_FunctionDef(child)
+            else:
+                self.generic_visit_nested(child)
+
+
+@rule("PT017", "same RNG key feeding two draws without a split",
+      applies=lambda ctx: ctx.in_pkg)
+def check_pt017(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    w = _Pt017Walker(ctx, findings)
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w.visit_FunctionDef(node)
+        else:
+            w.generic_visit_nested(node)
+    return findings

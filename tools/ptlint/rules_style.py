@@ -1,0 +1,170 @@
+"""The pyflakes-grade base checks, migrated verbatim from tools/lint.py:
+
+- E722 bare except, B006 mutable default, E711 ==None/True/False,
+  F541 placeholder-less f-string (one combined AST walk);
+- F401 unused module-scope imports (``__init__.py`` re-export surfaces
+  and ``_``-prefixed names exempt);
+- F821 undefined names via the symtable module's scope analysis.
+
+Behavior is pinned by the golden-output migration test
+(tests/test_ptlint.py) — these must keep firing exactly where the old
+walker fired.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+
+from .core import FileContext, Finding, rule
+
+_IMPLICIT = {"__file__", "__name__", "__doc__", "__package__",
+             "__spec__", "__loader__", "__builtins__", "__debug__",
+             "__path__", "__class__", "NotImplemented"}
+_BUILTINS = set(dir(builtins)) | _IMPLICIT
+
+
+class _AstChecks(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext, findings: list[Finding]):
+        self.ctx = ctx
+        self.findings = findings
+        self.imported: dict[str, int] = {}  # name -> lineno
+        self.used: set[str] = set()
+        self.exported: set[str] = set()
+
+    def _f(self, node, code, msg):
+        self.findings.append(self.ctx.finding(node, code, msg))
+
+    # -- imports / usage for the unused-import pass (module level only)
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            if not name.startswith("_"):
+                self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directives, not bindings to "use"
+        for a in node.names:
+            if a.name == "*":
+                continue
+            name = a.asname or a.name
+            if not name.startswith("_"):
+                self.imported.setdefault(name, node.lineno)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if (isinstance(t, ast.Name) and t.id == "__all__"
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        self.exported.add(str(elt.value))
+        self.generic_visit(node)
+
+    # -- style/bug checks
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._f(node, "E722", "bare except")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self._f(d, "B006", "mutable default argument")
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if (isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and (comp.value is None or comp.value is True
+                         or comp.value is False)):
+                # == True/False/None: identity is the correct test.
+                self._f(node, "E711",
+                        f"comparison to {comp.value} with ==/!= "
+                        f"(use is / is not)")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue)
+                   for v in node.values):
+            self._f(node, "F541", "f-string without placeholders")
+        # No generic_visit: recursing into FormattedValue format specs
+        # re-reports the same literal.
+
+
+@rule("E7XX", "base style/bug checks (E722/B006/E711/F541) + F401")
+def check_base(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    v = _AstChecks(ctx, findings)
+    v.visit(ctx.tree)
+    if not ctx.is_init:  # __init__ imports ARE the re-export surface
+        for name, lineno in sorted(v.imported.items(),
+                                   key=lambda kv: kv[1]):
+            if name not in v.used and name not in v.exported:
+                findings.append(Finding(
+                    ctx.path, lineno, "F401",
+                    f"{name!r} imported but unused"))
+    return findings
+
+
+def _scope_bound_names(table: symtable.SymbolTable) -> set[str]:
+    bound = set()
+    for sym in table.get_symbols():
+        if sym.is_assigned() or sym.is_imported() or sym.is_parameter():
+            bound.add(sym.get_name())
+    for child in table.get_children():
+        bound.add(child.get_name())  # nested def/class names
+    return bound
+
+
+@rule("F821", "undefined names via symtable scope analysis")
+def check_undefined(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    try:
+        top = symtable.symtable(ctx.src, ctx.path, "exec")
+    except SyntaxError:
+        return findings  # already reported as E999
+
+    module_bound = _scope_bound_names(top)
+
+    def walk(table: symtable.SymbolTable, enclosing: set[str]) -> None:
+        bound = enclosing | _scope_bound_names(table)
+        for sym in table.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced():
+                continue
+            if (sym.is_assigned() or sym.is_imported()
+                    or sym.is_parameter() or sym.is_global()
+                    or sym.is_declared_global() or sym.is_nonlocal()):
+                continue
+            if sym.is_free():  # bound in an enclosing function scope
+                continue
+            if name in bound or name in _BUILTINS:
+                continue
+            findings.append(Finding(
+                ctx.path, table.get_lineno(), "F821",
+                f"undefined name {name!r} "
+                f"(scope {table.get_name()!r})"))
+        for child in table.get_children():
+            # Class scopes do not enclose their methods' name lookup.
+            nxt = (enclosing | module_bound
+                   if table.get_type() == "class" else bound)
+            walk(child, nxt)
+
+    walk(top, set())
+    return findings

@@ -1,0 +1,221 @@
+"""Shared scope/dataflow helpers — the core every pass rides.
+
+The old checker's 12 visitors each re-derived the same three facts:
+what name a call terminates in, what module an alias is bound to, and
+what syntactic context (loop body, function, ``with``-held lock) the
+node sits in. This module centralizes them so a new pass is mostly its
+decision logic:
+
+- :func:`terminal_name` — the last identifier of a receiver chain;
+- :class:`ImportMap` — module aliases and from-import bindings, the
+  dodge-proof way to recognize ``import time as _t`` / ``from
+  jax.random import categorical as c``;
+- :class:`ContextWalker` — a NodeVisitor base tracking the enclosing
+  function stack, loop depth, and the stack of ``with``-held locks
+  (any context-manager expression whose name looks lock-ish:
+  ``self._lock``, ``r.lock``, ``self._cond`` ...);
+- :func:`index_loads_stores` — per-function expression occurrence
+  index (by ``ast.unparse`` string) for the read-after-donate and
+  key-reuse dataflow passes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+#: Attribute/variable names treated as locks for the concurrency
+#: passes. Name-based on purpose: the repo's idiom is ``_lock`` /
+#: ``_load_lock`` / ``_cond`` / ``lock`` — a lock you can't tell is a
+#: lock from its name is already a review finding.
+_LOCKISH_EXACT = frozenset({"mu", "_mu", "mutex", "_mutex"})
+
+
+def is_lockish(name: str | None) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return ("lock" in low or "cond" in low or low in _LOCKISH_EXACT)
+
+
+def terminal_name(node: ast.expr) -> str | None:
+    """Last identifier of a receiver expression: ``optimizer`` for
+    ``self.optimizer``, ``join`` for ``t.join``, the func's terminal
+    for a call."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return terminal_name(node.func)
+    return None
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — malformed synthetic nodes
+        return ""
+
+
+class ImportMap(ast.NodeVisitor):
+    """Module aliases + from-import bindings for one file.
+
+    ``modules`` maps local name -> dotted module path (``_t`` ->
+    ``time``, ``jr`` -> ``jax.random``); ``from_names`` maps local
+    name -> (module, original name) for every from-import.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.modules: dict[str, str] = {}
+        self.from_names: dict[str, tuple[str, str]] = {}
+        self.visit(tree)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            # `import jax.random` binds `jax`; with an asname it binds
+            # the full dotted module.
+            self.modules[local] = a.name if a.asname else local
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if not node.module:
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.from_names[a.asname or a.name] = (node.module, a.name)
+            # `from jax import random` binds a module object too.
+            self.modules.setdefault(a.asname or a.name,
+                                    f"{node.module}.{a.name}")
+
+    def module_aliases(self, dotted: str) -> set[str]:
+        """Local names bound to module ``dotted`` (exact match)."""
+        return {local for local, mod in self.modules.items()
+                if mod == dotted}
+
+    def from_bindings(self, module: str,
+                      names: frozenset | set) -> dict[str, str]:
+        """local name -> original name, for from-imports of ``names``
+        out of ``module``."""
+        return {local: orig
+                for local, (mod, orig) in self.from_names.items()
+                if mod == module and orig in names}
+
+
+class HeldLock:
+    """One ``with``-held lock: its expression text and terminal name."""
+
+    __slots__ = ("expr", "name", "lineno")
+
+    def __init__(self, expr: str, name: str, lineno: int):
+        self.expr = expr
+        self.name = name
+        self.lineno = lineno
+
+
+class ContextWalker(ast.NodeVisitor):
+    """NodeVisitor tracking function stack, loop depth, and the stack
+    of with-held locks. Subclasses override ``handle_call`` (and
+    anything else) and read ``self.fn_stack`` / ``self.loop_depth`` /
+    ``self.held_locks``."""
+
+    def __init__(self):
+        self.fn_stack: list[str] = []
+        self.loop_depth = 0
+        self.held_locks: list[HeldLock] = []
+
+    # -- functions
+
+    def _fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    # -- loops
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+
+    # -- with-held locks
+
+    def _with(self, node) -> None:
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            # `with self._lock:` — a bare lock expression; a call like
+            # `with chaos.armed(plan):` is a context manager, not a
+            # lock acquisition.
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                name = terminal_name(expr)
+                if is_lockish(name):
+                    self.held_locks.append(
+                        HeldLock(unparse(expr), name or "",
+                                 expr.lineno))
+                    pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self.held_locks.pop()
+
+    visit_With = visit_AsyncWith = _with
+
+    def holding(self) -> bool:
+        return bool(self.held_locks)
+
+    def holds_expr(self, expr: str) -> bool:
+        return any(h.expr == expr for h in self.held_locks)
+
+
+def _store_targets(node: ast.AST):
+    """Expression nodes bound by an assignment-ish statement."""
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.NamedExpr)):
+        return [node.target]
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return [node.target]
+    return []
+
+
+def _flatten_targets(targets):
+    out = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_flatten_targets(t.elts))
+        else:
+            out.append(t)
+    return out
+
+
+def index_loads_stores(fn: ast.AST) -> tuple[dict, dict]:
+    """(loads, stores): expression text -> sorted line numbers, over
+    one function body. Loads cover Name/Attribute/Subscript in Load
+    context; stores cover assignment/loop/with-as targets and
+    ``del``. Nested function bodies are included (closures read the
+    same frame)."""
+    loads: dict[str, list[int]] = {}
+    stores: dict[str, list[int]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+            if isinstance(getattr(node, "ctx", None), ast.Load):
+                loads.setdefault(unparse(node), []).append(node.lineno)
+            elif isinstance(getattr(node, "ctx", None),
+                            (ast.Store, ast.Del)):
+                stores.setdefault(unparse(node), []).append(node.lineno)
+        for t in _flatten_targets(_store_targets(node)):
+            stores.setdefault(unparse(t), []).append(t.lineno)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for t in _flatten_targets([item.optional_vars]):
+                        stores.setdefault(unparse(t), []).append(
+                            t.lineno)
+    for d in (loads, stores):
+        for k in d:
+            d[k] = sorted(set(d[k]))
+    return loads, stores
