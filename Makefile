@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench spec-bench scale-bench collectives-bench zero-bench profile-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench scale-bench collectives-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -71,6 +71,16 @@ zero-bench:
 profile-bench:
 	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
 		python bench.py --profile
+
+# Recompile-watchdog microbench (docs/LINTING.md "The runtime half"):
+# the armed jitwatch hot-region price — transfer-guard entry per
+# dispatch, charged against an engine-shaped step with its one host
+# sync per iteration (<5% acceptance bar), plus a
+# zero-steady-state-recompiles check on the probe itself — the
+# ISSUE 15 acceptance numbers. Also emitted in the headline bench
+# tail as jitwatch_overhead_pct.
+jitwatch-bench:
+	JAX_PLATFORMS=cpu python bench.py --jitwatch
 
 # Seeded chaos soak (docs/OPERATIONS.md "Chaos drills"): a FRESH random
 # fault schedule against the in-process trainer + registry +

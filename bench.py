@@ -214,6 +214,8 @@ def worker_main() -> None:
         "profile_note": None,
         "lockcheck_overhead_pct": None,
         "lockcheck_note": None,
+        "jitwatch_overhead_pct": None,
+        "jitwatch_note": None,
         "compiled_flops_per_token": None,
         "compiled_flops_note": None,
         "final_loss": round(float(out["loss"]), 4),
@@ -464,6 +466,18 @@ def _lockcheck_hostmesh() -> tuple[dict | None, str]:
         PROBE_TIMEOUT)
 
 
+def _jitwatch_hostmesh() -> tuple[dict | None, str]:
+    """Recompile-watchdog cost probe (ISSUE 15): the hot-region
+    transfer-guard entry priced on a bare-dispatch A/B and charged
+    against an engine-shaped step with its one host sync per
+    iteration. Bar: armed < 5%."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.health.bench import measure_jitwatch_overhead\n"
+        "print(json.dumps(measure_jitwatch_overhead()))\n",
+        PROBE_TIMEOUT)
+
+
 def _patch_store_metric(rec: dict) -> None:
     """Fill the Store metrics from the host-mesh probes — but ONLY when
     the worker left the fields null (the 1-chip case). A multi-chip run
@@ -605,6 +619,18 @@ def _patch_store_metric(rec: dict) -> None:
             f"{probe['lockcheck_disabled_overhead_pct']}% (plain "
             f"Lock by construction); "
             f"{probe['lockcheck_cycles']} cycles; {note}"
+            if probe else note)
+    if rec.get("jitwatch_overhead_pct") is None:
+        # Recompile-watchdog cost (ISSUE 15 acceptance: armed < 5%).
+        probe, note = _jitwatch_hostmesh()
+        rec["jitwatch_overhead_pct"] = (
+            probe["jitwatch_overhead_pct"] if probe else None)
+        rec["jitwatch_note"] = (
+            f"hot-region entry {probe['jitwatch_region_us']}us on a "
+            f"{probe['jitwatch_step_ms']}ms engine-shaped step "
+            f"(bare dispatch {probe['jitwatch_dispatch_us']}us); "
+            f"{probe['jitwatch_steady_recompiles']} steady-state "
+            f"recompiles; {note}"
             if probe else note)
 
 
@@ -770,6 +796,29 @@ def profile_main() -> None:
         "analytic_flops_per_token": cost["analytic_flops_per_token"],
         "mfu_gap_pct": cost["mfu_gap_pct"],
         "mfu_gap_within_10pct": abs(cost["mfu_gap_pct"]) <= 10.0,
+    })
+
+
+def jitwatch_main() -> None:
+    """``make jitwatch-bench``: the ISSUE 15 dispatch-discipline
+    numbers in-process — the armed watchdog's per-step price (hot
+    region entry charged against an engine-shaped step, <5% bar) and
+    a zero-steady-state-recompiles check on the probe itself."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ptype_tpu.health.bench import measure_jitwatch_overhead
+
+    probe = measure_jitwatch_overhead()
+    _emit({"probe": "jitwatch_overhead", **probe})
+    _emit({
+        "metric": "jitwatch: armed hot-region overhead",
+        "value": probe["jitwatch_overhead_pct"],
+        "unit": "% of engine-shaped step time",
+        "jitwatch_overhead_pct": probe["jitwatch_overhead_pct"],
+        "jitwatch_region_us": probe["jitwatch_region_us"],
+        "jitwatch_step_ms": probe["jitwatch_step_ms"],
+        "jitwatch_steady_recompiles":
+            probe["jitwatch_steady_recompiles"],
+        "within_5pct_bar": probe["jitwatch_overhead_pct"] < 5.0,
     })
 
 
@@ -1447,6 +1496,9 @@ def main() -> None:
         return
     if "--profile" in sys.argv:
         profile_main()
+        return
+    if "--jitwatch" in sys.argv:
+        jitwatch_main()
         return
 
     t_start = time.time()

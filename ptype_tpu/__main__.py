@@ -409,6 +409,17 @@ def _obs() -> None:
             except KeyboardInterrupt:
                 pass
             return
+        if len(sys.argv) > 2 and sys.argv[2] == "jit":
+            from ptype_tpu.health import run_jit
+
+            try:
+                run_jit(CoordRegistry(coord),
+                        iters=int(os.environ.get("TOP_ITERS", "0")),
+                        interval_s=float(
+                            os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
         snap = tel.cluster_snapshot(CoordRegistry(coord),
                                     include_local=False)
         out_dir = os.environ.get("OBS_DIR", ".")

@@ -26,16 +26,17 @@ from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
                                     KvPressureRule, LossRule,
                                     MemoryGrowthRule, MfuGapRule,
                                     P99Rule, PrefixHitCollapseRule,
-                                    Rule, ServeStallRule, StallRule,
+                                    RecompileStormRule, Rule,
+                                    ServeStallRule, StallRule,
                                     StragglerRule, TtftRule,
                                     default_rules)
 from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
                                      telemetry_endpoint)
 from ptype_tpu.health.serving import (RequestRecord, ServingLedger,
                                       measure_seam_cost_us)
-from ptype_tpu.health.top import (render_scale, render_serve,
-                                  render_top, run_scale, run_serve,
-                                  run_top)
+from ptype_tpu.health.top import (render_jit, render_scale,
+                                  render_serve, render_top, run_jit,
+                                  run_scale, run_serve, run_top)
 
 __all__ = [
     "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
@@ -48,7 +49,7 @@ __all__ = [
     "P99Rule", "StallRule", "StragglerRule", "LossRule",
     "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "TtftRule",
     "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
-    "default_rules",
+    "RecompileStormRule", "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
-    "render_scale", "run_scale",
+    "render_scale", "run_scale", "render_jit", "run_jit",
 ]

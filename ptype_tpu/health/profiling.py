@@ -523,7 +523,7 @@ def transformer_grads_cost(cfg, batch: int, seq: int,
     shape = (batch, seq) if stacked is None else (stacked, batch, seq)
     batch_avals = {"tokens": jax.ShapeDtypeStruct(shape, jnp.int32),
                    "targets": jax.ShapeDtypeStruct(shape, jnp.int32)}
-    fn = (jax.jit(local_grads) if stacked is None
+    fn = (jax.jit(local_grads) if stacked is None  # ptlint: disable=PT019 -- one-shot cost probe: the jit is lowered for cost_analysis only, never dispatched hot
           else jax.jit(jax.vmap(local_grads, in_axes=(None, 0))))
     cost = compiled_cost(fn, params_avals, batch_avals)
     tokens = batch * seq * (stacked or 1)

@@ -676,6 +676,69 @@ class ServeStallRule(Rule):
         return out
 
 
+class RecompileStormRule(Rule):
+    """Dispatch-discipline breach at runtime: a node's
+    ``jit.recompiles`` counter (the jitwatch seam — same-signature
+    backend compiles the trace cache should have served) grew by
+    ``threshold`` or more inside the window. A steady-state process
+    compiles NOTHING; sustained recompiles mean a hot loop is paying
+    trace+XLA-compile per iteration — the 0.77x class a green test
+    suite never sees. The alert NAMES the worst-offending function
+    from the per-function ``jit.fn.*`` books, which is what makes the
+    page actionable (and lets the profile-capture hook grab the right
+    node's timeline). Structural: the series only exists on
+    jitwatch-armed processes, so a disarmed fleet never pays a false
+    page."""
+
+    name = "recompile-storm"
+    severity = "page"
+
+    def __init__(self, threshold: float = 3.0, window_s: float = 120.0,
+                 series: str = "jit.recompiles"):
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.series = series
+
+    def _worst_fn(self, view: ClusterView, node: str):
+        """(fn, recompiles) with the highest per-function count, from
+        the sampled ``jit.fn.*`` series (or the live gauges when the
+        snapshot carries metrics)."""
+        best: tuple[str, float] | None = None
+        telem = view.nodes.get(node, {})
+        candidates: dict[str, float] = {}
+        for name, pts in (telem.get("series") or {}).items():
+            if name.startswith("jit.fn.") and pts:
+                candidates[name[len("jit.fn."):]] = pts[-1][1]
+        for name, val in ((telem.get("metrics") or {})
+                          .get("gauges", {}).items()):
+            if name.startswith("jit.fn."):
+                candidates.setdefault(name[len("jit.fn."):], val)
+        for fn, val in candidates.items():
+            if best is None or val > best[1]:
+                best = (fn, val)
+        return best
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = view.series(node, self.series)
+            delta = counter_delta(pts, self.window_s, view.now)
+            if delta < self.threshold:
+                continue
+            worst = self._worst_fn(view, node)
+            who = (f"; worst offender: {worst[0]} "
+                   f"({worst[1]:.0f} recompiles)" if worst else "")
+            out.append(self._alert(
+                node,
+                f"{delta:.0f} steady-state recompiles in "
+                f"{self.window_s:.0f}s — a hot program is re-tracing "
+                f"per call{who}; read `obs jit` for the per-function "
+                f"books",
+                value=delta, threshold=self.threshold,
+                fn=worst[0] if worst else None))
+        return out
+
+
 def default_rules(service: str = "llm",
                   slo_p99_ms: float | None = None,
                   slo_ttft_ms: float | None = None) -> list[Rule]:
@@ -699,6 +762,7 @@ def default_rules(service: str = "llm",
         KvPressureRule(),
         PrefixHitCollapseRule(),
         ServeStallRule(),
+        RecompileStormRule(),
     ]
     if slo_ttft_ms is not None:
         rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))

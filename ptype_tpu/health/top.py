@@ -259,6 +259,88 @@ def render_scale(snapshot: dict, alerts=(),
     return "\n".join(lines)
 
 
+def render_jit(snapshot: dict, alerts=(), max_nodes: int = 32,
+               max_fns: int = 12) -> str:
+    """``obs jit``: the dispatch-discipline one-pager (ISSUE 15) —
+    per-node compile/recompile totals from the jitwatch seam
+    (``jit.compiles``/``jit.recompiles`` counters, sampled into
+    series) plus the per-function ``jit.fn.*`` recompile books, worst
+    offender first. A node with no ``jit.*`` families is disarmed
+    (``PTYPE_JITWATCH=1`` arms it) — shown so an operator chasing a
+    recompile-storm page can tell 'quiet' from 'blind'."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+
+    def cnt(t, name):
+        return t.get("metrics", {}).get("counters", {}).get(name)
+
+    armed = {k: t for k, t in nodes.items()
+             if cnt(t, "jit.compiles") is not None
+             or (t.get("series") or {}).get("jit.recompiles")}
+    lines = [
+        f"ptype jit @ {snapshot.get('ts')} — {len(armed)} armed "
+        f"nodes ({len(nodes)} nodes, {len(errors)} unreachable)",
+        f"{'node':<28} {'compiles':>9} {'recomp':>7} {'sanct':>6} "
+        f"{'worst offender':<32}",
+    ]
+
+    def num(v, fmt="{:.0f}", dash="-"):
+        return fmt.format(v) if v is not None else dash
+
+    for key in sorted(armed)[:max_nodes]:
+        t = armed[key]
+        fns = []
+        for name, val in (t.get("metrics", {})
+                          .get("gauges", {})).items():
+            if name.startswith("jit.fn."):
+                fns.append((name[len("jit.fn."):], val))
+        for name, pts in (t.get("series") or {}).items():
+            if name.startswith("jit.fn.") and pts:
+                fn = name[len("jit.fn."):]
+                if not any(f == fn for f, _ in fns):
+                    fns.append((fn, pts[-1][1]))
+        fns.sort(key=lambda kv: -kv[1])
+        worst = (f"{fns[0][0]} ({fns[0][1]:.0f}x)" if fns else "-")
+        lines.append(
+            f"{key[:28]:<28} {num(cnt(t, 'jit.compiles')):>9} "
+            f"{num(cnt(t, 'jit.recompiles')):>7} "
+            f"{num(cnt(t, 'jit.sanctioned_transfers')):>6} "
+            f"{worst[:32]:<32}")
+        for fn, val in fns[1:max_fns]:
+            lines.append(f"  {fn[:40]:<40} {val:>6.0f}x")
+    if not armed:
+        lines.append("  (no node exports jit.* — arm the watchdog "
+                     "with PTYPE_JITWATCH=1 or jitwatch.enable())")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
+def run_jit(registry, iters: int = 0, interval_s: float = 2.0,
+            engine: AlertEngine | None = None,
+            services: list[str] | None = None,
+            include_local: bool = False, out=None,
+            clear: bool = True) -> AlertEngine:
+    """The ``obs jit`` loop: :func:`run_top`'s poll contract with the
+    dispatch-discipline rendering (the recompile-storm rule fires off
+    the same snapshot)."""
+    return run_top(registry, iters=iters, interval_s=interval_s,
+                   engine=engine, services=services,
+                   include_local=include_local, out=out, clear=clear,
+                   render=render_jit)
+
+
 def run_scale(registry, iters: int = 0, interval_s: float = 2.0,
               engine: AlertEngine | None = None,
               services: list[str] | None = None,

@@ -1,0 +1,489 @@
+"""Jaxpr-level program audits — the dispatch-discipline contract.
+
+ptlint's PT018–PT020 police the PYTHON around the hot programs;
+:mod:`ptype_tpu.jitwatch` watches them recompile at runtime. This
+module closes the middle: it TRACES a hot program (``jax.make_jaxpr``
+— no execution, no backend compile) and asserts invariants of the
+program itself, the ones a green test suite cannot see breaking:
+
+- **no host callbacks** — a ``pure_callback``/``io_callback``/
+  ``debug_callback`` (or ``debug.print``) inside a hot program turns
+  every dispatch into a host round-trip; fine in a notebook, fatal in
+  a decode loop;
+- **no f64** — a ``convert_element_type`` to float64 (or any f64
+  intermediate) doubles HBM and wire bytes for the whole downstream
+  program, usually smuggled in by a dtype-less numpy literal (PT020's
+  runtime shadow);
+- **donation consumed** — ``donate_argnums`` is a *request*; whether
+  XLA actually aliases the buffer only shows in the lowering
+  (``tf.aliasing_output`` / ``jax.buffer_donor``). The engine's bank
+  donation is what keeps the KV pool from being copied per step — a
+  silently-dropped donation is a 2x HBM regression with no failing
+  test;
+- **collective-op count** — the bucketed collectives exist to make
+  one bucket cost ONE launch; a refactor that un-fuses them (N psums
+  for N leaves) keeps every parity test green and gives back the PR 1
+  win. The audit counts collective primitives in the traced program
+  and pins them to the bucket plan.
+
+:func:`register` + :func:`audit_registered` keep a process-wide
+registry of hot-program builders; :func:`register_default_programs`
+installs the standing set (train-step grads, ZeRO shard-apply,
+bucketed allreduce/reduce-scatter, the paged decode step, the fused
+spec window) that ``tests/test_progaudit.py`` audits in the fast
+tier.
+
+Stdlib + jax only at the bottom; model/mesh imports live inside the
+default builders (lazy — auditing a custom program must not drag the
+transformer stack in).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+
+__all__ = [
+    "AuditError", "AuditReport", "audit", "collect_primitives",
+    "register", "registered", "audit_registered", "audit_all",
+    "register_default_programs", "DEFAULT_PROGRAMS",
+]
+
+#: Primitive names that round-trip through the host per dispatch.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+})
+
+#: Cross-device collective primitives (the launch-count currency).
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+#: Lowering markers that prove a donated invar was actually aliased
+#: (or at least accepted as a donor) by XLA.
+_DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+class AuditError(AssertionError):
+    """A hot program broke its dispatch contract; the message names
+    every violated invariant."""
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """One audited program: counts, sites, and the verdict."""
+
+    name: str
+    problems: list[str]
+    collectives: dict[str, int]
+    callbacks: list[str]
+    f64_sites: list[str]
+    eqns: int
+    donated_expected: int = 0
+    donated_consumed: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def raise_if_failed(self) -> "AuditReport":
+        if self.problems:
+            raise AuditError(
+                f"progaudit[{self.name}]: "
+                + "; ".join(self.problems))
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "ok": self.ok,
+            "problems": list(self.problems),
+            "collectives": dict(self.collectives),
+            "callbacks": list(self.callbacks),
+            "f64_sites": list(self.f64_sites),
+            "eqns": self.eqns,
+            "donated_expected": self.donated_expected,
+            "donated_consumed": self.donated_consumed,
+        }
+
+
+# ------------------------------------------------------------- traversal
+
+
+def _sub_jaxprs(eqn):
+    """Every nested jaxpr an equation carries (pjit/scan/shard_map →
+    params['jaxpr']; cond → params['branches']; custom_*: call
+    jaxprs)."""
+    for v in eqn.params.values():
+        if hasattr(v, "jaxpr"):        # ClosedJaxpr
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):       # raw Jaxpr
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for b in v:
+                if hasattr(b, "jaxpr"):
+                    yield b.jaxpr
+                elif hasattr(b, "eqns"):
+                    yield b
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+def collect_primitives(closed) -> dict[str, int]:
+    """primitive name -> count over the whole (nested) jaxpr."""
+    counts: dict[str, int] = {}
+    for eqn in _walk_eqns(closed.jaxpr):
+        counts[eqn.primitive.name] = counts.get(
+            eqn.primitive.name, 0) + 1
+    return counts
+
+
+def _is_f64(aval) -> bool:
+    dtype = getattr(aval, "dtype", None)
+    return dtype is not None and str(dtype) == "float64"
+
+
+# ----------------------------------------------------------------- audit
+
+
+def audit(fn, args, *, name: str = "", donate_argnums=(),
+          expect_collectives: int | dict | None = None,
+          allow_f64: bool = False, static_argnums=(),
+          check_donation: bool | None = None) -> AuditReport:
+    """Trace ``fn(*args)`` (args may be ShapeDtypeStructs — nothing
+    executes) and audit the program. ``expect_collectives``: an int
+    pins the TOTAL collective-primitive count, a dict pins per-prim
+    counts (prims absent from the dict are unconstrained). With
+    ``donate_argnums`` the program is additionally LOWERED (still no
+    execution) and the donation must survive into the lowering text.
+    Returns the report; call :meth:`AuditReport.raise_if_failed` to
+    turn problems into a typed :class:`AuditError`."""
+    problems: list[str] = []
+    closed = jax.make_jaxpr(fn, static_argnums=static_argnums)(*args)
+
+    callbacks: list[str] = []
+    f64_sites: list[str] = []
+    collectives: dict[str, int] = {}
+    n_eqns = 0
+    for eqn in _walk_eqns(closed.jaxpr):
+        n_eqns += 1
+        prim = eqn.primitive.name
+        if prim in CALLBACK_PRIMS or "callback" in prim:
+            callbacks.append(prim)
+        if prim in COLLECTIVE_PRIMS:
+            collectives[prim] = collectives.get(prim, 0) + 1
+        if prim == "convert_element_type" and _is_f64(
+                eqn.outvars[0].aval):
+            f64_sites.append("convert_element_type -> f64")
+        else:
+            for v in eqn.outvars:
+                if _is_f64(getattr(v, "aval", None)):
+                    f64_sites.append(f"{prim} produces f64")
+                    break
+    for v in closed.jaxpr.invars:
+        if _is_f64(getattr(v, "aval", None)):
+            f64_sites.append("f64 program input")
+
+    if callbacks:
+        problems.append(
+            f"host callbacks in the program: {sorted(set(callbacks))} "
+            f"(a host round-trip per dispatch)")
+    if f64_sites and not allow_f64:
+        problems.append(
+            f"float64 in the program ({len(f64_sites)} sites, first: "
+            f"{f64_sites[0]}) — 2x HBM/wire for every downstream op")
+    if expect_collectives is not None:
+        total = sum(collectives.values())
+        if isinstance(expect_collectives, int):
+            if total != expect_collectives:
+                problems.append(
+                    f"collective launch count {total} != expected "
+                    f"{expect_collectives} (got {collectives}) — the "
+                    f"bucket fusion contract")
+        else:
+            for prim, want in expect_collectives.items():
+                got = collectives.get(prim, 0)
+                if got != want:
+                    problems.append(
+                        f"{prim} count {got} != expected {want} "
+                        f"(got {collectives})")
+
+    donated_expected = donated_consumed = 0
+    if check_donation is None:
+        check_donation = bool(donate_argnums)
+    if check_donation and donate_argnums:
+        flat_args = []
+        for i in donate_argnums:
+            flat_args.extend(jax.tree_util.tree_leaves(args[i]))
+        donated_expected = len(flat_args)
+        lowered = jax.jit(
+            fn, donate_argnums=donate_argnums,
+            static_argnums=static_argnums).lower(*args)
+        text = lowered.as_text()
+        donated_consumed = sum(text.count(m) for m in
+                               _DONATION_MARKERS)
+        if donated_consumed < donated_expected:
+            problems.append(
+                f"donation not consumed in the lowering: "
+                f"{donated_consumed}/{donated_expected} donated "
+                f"buffers marked ({'/'.join(_DONATION_MARKERS)}) — "
+                f"the banks are being COPIED per step")
+
+    return AuditReport(
+        name=name or getattr(fn, "__name__", "<fn>"),
+        problems=problems, collectives=collectives,
+        callbacks=sorted(set(callbacks)), f64_sites=f64_sites,
+        eqns=n_eqns, donated_expected=donated_expected,
+        donated_consumed=donated_consumed)
+
+
+# -------------------------------------------------------------- registry
+
+#: name -> zero-arg builder returning an :class:`AuditReport`.
+_REGISTRY: dict[str, Callable[[], AuditReport]] = {}
+
+DEFAULT_PROGRAMS = (
+    "train.grads", "zero.shard_apply", "collectives.bucket_allreduce",
+    "collectives.bucket_reduce_scatter", "serve.decode_step",
+    "serve.spec_window",
+)
+
+
+def register(name: str, builder: Callable[[], AuditReport]) -> None:
+    _REGISTRY[name] = builder
+
+
+def registered() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def audit_registered(name: str) -> AuditReport:
+    if name not in _REGISTRY:
+        raise KeyError(f"no registered hot program {name!r} "
+                       f"(have: {registered()})")
+    return _REGISTRY[name]()
+
+
+def audit_all(raise_on_failure: bool = False) -> dict[str, AuditReport]:
+    out = {}
+    for name in registered():
+        out[name] = audit_registered(name)
+        if raise_on_failure:
+            out[name].raise_if_failed()
+    return out
+
+
+# ---------------------------------------------------- default programs
+
+
+def _tiny_setup(preset: str):
+    import jax.numpy as jnp
+
+    from ptype_tpu.models import transformer as tfm
+
+    cfg = tfm.preset(preset, dtype=jnp.float32)
+    params_avals = jax.eval_shape(
+        lambda r: tfm.init_params(r, cfg), jax.random.PRNGKey(0))
+    return cfg, params_avals
+
+
+def _build_train_grads(preset: str, batch: int, seq: int):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.models import transformer as tfm
+
+        cfg, params_avals = _tiny_setup(preset)
+        batch_avals = {
+            "tokens": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+            "targets": jax.ShapeDtypeStruct((batch, seq), jnp.int32)}
+
+        def grads(p, b):
+            return jax.value_and_grad(tfm.loss_fn)(p, b, cfg)
+
+        # The single-replica grad program is collective-free (the
+        # wire is the Store's job) and must stay f32/bf16 end to end.
+        return audit(grads, (params_avals, batch_avals),
+                     name="train.grads", expect_collectives=0)
+
+    return builder
+
+
+def _build_zero_apply():
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import zero as zero_mod
+        from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.train.trainer import default_optimizer_hparams
+
+        n = jax.device_count()
+        mesh = build_mesh({"data": n})
+        shapes = ((4, 4), (8,))
+        total = sum(1 if not s else int(__import__("math").prod(s))
+                    for s in shapes)
+        pad = (-total) % n
+        elems = total + pad
+        fn = zero_mod._shard_apply_fn(
+            mesh, "data", shapes, "float32", pad,
+            default_optimizer_hparams())
+        f32 = jnp.float32
+        avals = ([jax.ShapeDtypeStruct(s, f32) for s in shapes]
+                 + [jax.ShapeDtypeStruct((elems,), f32)] * 4
+                 + [jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), f32)])
+        # ONE all_gather: the fused shard-apply's whole point (pack →
+        # slice my shard → AdamW → gather) — a second gather means
+        # the fusion regressed to per-leaf assembly.
+        return audit(fn, avals, name="zero.shard_apply",
+                     expect_collectives={"all_gather": 1})
+
+    return builder
+
+
+def _build_bucket_collective(kind: str):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import collectives as coll
+        from ptype_tpu.parallel.mesh import build_mesh
+
+        n = jax.device_count()
+        mesh = build_mesh({"data": n})
+        shapes = ((4, 4), (8,))
+        pad = (-24) % n
+        avals = [jax.ShapeDtypeStruct((n, *s), jnp.float32)
+                 for s in shapes]
+        if kind == "allreduce":
+            fn = coll._bucket_all_reduce_fn(
+                mesh, "data", "mean", shapes, "float32", pad, None,
+                False, q_block=None)
+            expect = {"psum": 1}
+            name = "collectives.bucket_allreduce"
+        else:
+            fn = coll._bucket_reduce_scatter_fn(
+                mesh, "data", "sum", shapes, "float32", pad, None,
+                False, q_block=None)
+            expect = {"reduce_scatter": 1}
+            name = "collectives.bucket_reduce_scatter"
+        # N leaves, ONE launch: the bucket contract PR 1 measured
+        # 2-3x from; per-leaf regressions show up as count N.
+        return audit(fn, avals, name=name, expect_collectives=expect)
+
+    return builder
+
+
+def _build_decode_step(preset: str, n_slots: int, n_blocks: int,
+                       block_tokens: int):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.models import generate as gen
+
+        cfg, params_avals = _tiny_setup(preset)
+        B, nb, bt = n_slots, n_blocks, block_tokens
+        kvh = cfg.n_kv_heads or cfg.n_heads
+        hd = cfg.d_model // cfg.n_heads
+        bank = jax.ShapeDtypeStruct(
+            (cfg.n_layers, nb, bt, kvh, hd), jnp.float32)
+        i32 = jnp.int32
+
+        def step(params, kb, vb, tok, pos, tables, wr_b, wr_o):
+            return gen.decode_step_paged(params, tok, pos, cfg, kb,
+                                         vb, tables, wr_b, wr_o)
+
+        args = (params_avals, bank, bank,
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B, nb), i32),
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B,), i32))
+        # Banks donated (the engine's donate_argnums=(2, 3) shape):
+        # a dropped donation copies the whole KV pool every step.
+        return audit(step, args, name="serve.decode_step",
+                     donate_argnums=(1, 2), expect_collectives=0)
+
+    return builder
+
+
+def _build_spec_window(preset: str, k: int):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.models import generate as gen
+        from ptype_tpu.models import transformer as tfm
+        from ptype_tpu.serve_engine import (PagedGeneratorActor,
+                                            SpecConfig)
+
+        cfg = tfm.preset(preset, dtype=jnp.float32)
+        params = jax.jit(lambda r: tfm.init_params(r, cfg))(
+            jax.random.PRNGKey(0))
+        dp, dcfg = gen.truncated_draft_params(params, cfg, n_layers=1)
+        eng = PagedGeneratorActor(
+            cfg, params=params, n_slots=2, block_tokens=16,
+            spec=SpecConfig(draft_params=dp, draft_cfg=dcfg, k=k,
+                            adaptive=False))
+        try:
+            W = k + 1
+            B, nb = eng.n_slots, eng.nb
+            i32, f32 = jnp.int32, jnp.float32
+            kvh = cfg.n_kv_heads or cfg.n_heads
+            hd = cfg.d_model // cfg.n_heads
+            bank = jax.ShapeDtypeStruct(
+                (cfg.n_layers, eng.pool.n_blocks, eng.block_tokens,
+                 kvh, hd), f32)
+            dbank = jax.ShapeDtypeStruct(
+                (dcfg.n_layers, eng.pool.n_blocks, eng.block_tokens,
+                 kvh, hd), f32)
+            run = eng._window_prog(W, sampled=False)
+            args = (
+                params, dp,
+                jax.ShapeDtypeStruct((B,), i32),        # tok
+                jax.ShapeDtypeStruct((B,), i32),        # pos
+                bank, bank, dbank, dbank,
+                jax.ShapeDtypeStruct((B, nb), i32),     # tables
+                jax.ShapeDtypeStruct((B, nb), i32),     # dtables
+                jax.ShapeDtypeStruct((B,), i32),        # nalloc
+                jax.ShapeDtypeStruct((B,), i32),        # dnalloc
+                jax.ShapeDtypeStruct((B,), jnp.bool_),  # active
+                jax.ShapeDtypeStruct((B, 2), jnp.uint32),  # keys
+                jax.ShapeDtypeStruct((B,), i32),        # sctr
+                jax.ShapeDtypeStruct((B,), f32),        # temps
+                jax.ShapeDtypeStruct((B,), i32),        # topk
+                jax.ShapeDtypeStruct((B,), f32),        # topp
+            )
+            # The REAL engine window program: fused draft scan +
+            # batched verify + accept, both pools' banks donated,
+            # ONE dispatch per window, no collectives, no f64.
+            return audit(run, args, name="serve.spec_window",
+                         donate_argnums=(4, 5, 6, 7),
+                         expect_collectives=0)
+        finally:
+            eng.close()
+
+    return builder
+
+
+def register_default_programs(preset: str = "tiny", batch: int = 4,
+                              seq: int = 16, spec_k: int = 3) -> None:
+    """Install the standing hot-program registry (idempotent): the
+    five program families the ROADMAP's perf wins live in. The
+    fast-tier contract test audits every one; ``audit_all()`` is the
+    operator surface."""
+    register("train.grads", _build_train_grads(preset, batch, seq))
+    register("zero.shard_apply", _build_zero_apply())
+    register("collectives.bucket_allreduce",
+             _build_bucket_collective("allreduce"))
+    register("collectives.bucket_reduce_scatter",
+             _build_bucket_collective("reduce_scatter"))
+    register("serve.decode_step",
+             _build_decode_step(preset, n_slots=2, n_blocks=12,
+                                block_tokens=16))
+    register("serve.spec_window", _build_spec_window(preset, spec_k))

@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ptype_tpu import chaos, logs, trace
+from ptype_tpu import chaos, jitwatch, logs, trace
 from ptype_tpu import metrics as metrics_mod
 from ptype_tpu.errors import ShedError
 from ptype_tpu.health.serving import ServingLedger
@@ -805,11 +805,17 @@ class PagedGeneratorActor(GeneratorActor):
         self._steps += 1
         self._max_live = max(self._max_live, int(self._active.sum()))
         with self._lock:
-            (self.pool.k, self.pool.v, nxt, d["pos"],
-             d["eidx"]) = self._engine_step(
-                sampled, self.params, self.pool.k, self.pool.v,
-                d["tok"], d["pos"], d["tables"], d["active"],
-                d["keys"], d["eidx"], d["temps"], d["topk"], d["topp"])
+            # Armed (PTYPE_JITWATCH=1), the hot region makes any
+            # unsanctioned implicit transfer into the decode step
+            # raise at the call — the steady-state step re-uploads
+            # NOTHING, and jitwatch counts its compiles.
+            with jitwatch.hot_region("serve.decode"):
+                (self.pool.k, self.pool.v, nxt, d["pos"],
+                 d["eidx"]) = self._engine_step(
+                    sampled, self.params, self.pool.k, self.pool.v,
+                    d["tok"], d["pos"], d["tables"], d["active"],
+                    d["keys"], d["eidx"], d["temps"], d["topk"],
+                    d["topp"])
         d["tok"] = nxt
         nxt_host = np.array(nxt)  # host mirror for retire bookkeeping
         self._pos[self._active] += 1
@@ -1057,16 +1063,17 @@ class PagedGeneratorActor(GeneratorActor):
         pos_dev = jnp.asarray(self._pos)
         sctr_dev = jnp.asarray(self._sctr)
         with self._lock:
-            (out_toks, n_acc, self.pool.k, self.pool.v,
-             self._dpool.k, self._dpool.v) = \
-                self._window_prog(W, sampled)(
-                    self.params, self._spec.draft_params,
-                    tok_dev, pos_dev,
-                    self.pool.k, self.pool.v, self._dpool.k,
-                    self._dpool.v, sd["tables"], sd["dtables"],
-                    sd["nalloc"], sd["dnalloc"], sd["active"],
-                    sd["keys"], sctr_dev, sd["temps"],
-                    sd["topk"], sd["topp"])
+            with jitwatch.hot_region("serve.spec_window"):
+                (out_toks, n_acc, self.pool.k, self.pool.v,
+                 self._dpool.k, self._dpool.v) = \
+                    self._window_prog(W, sampled)(
+                        self.params, self._spec.draft_params,
+                        tok_dev, pos_dev,
+                        self.pool.k, self.pool.v, self._dpool.k,
+                        self._dpool.v, sd["tables"], sd["dtables"],
+                        sd["nalloc"], sd["dnalloc"], sd["active"],
+                        sd["keys"], sctr_dev, sd["temps"],
+                        sd["topk"], sd["topp"])
         out_host = np.asarray(out_toks)   # the window's ONE host sync
         acc_host = np.asarray(n_acc)
         emit_recs, emit_counts = [], []

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ptype_tpu import jitwatch
 from ptype_tpu.models import transformer as tfm
 from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
 from ptype_tpu.parallel.zero import ShardPlan, ZeroState
@@ -228,7 +229,11 @@ class StoreDPTrainer:
             )
         # The data leg of the goodput breakdown: host→device batch
         # staging, attributed separately from compute/collective.
-        with annotate("train.data"):
+        with annotate("train.data"), \
+                jitwatch.sanctioned_transfer("train.data"):
+            # The sanctioned host→device seam: the batch upload IS the
+            # data leg's contract — typed and counted, so an armed
+            # hot region elsewhere can disallow every other transfer.
             sh = NamedSharding(self.mesh, P(self.axis, None, None))
             return {
                 k: jax.device_put(
@@ -249,50 +254,59 @@ class StoreDPTrainer:
             self._cost_avals = (
                 jax.tree_util.tree_map(aval, params),
                 jax.tree_util.tree_map(aval, stacked))
-        losses, grads = self._grads_fn(params, stacked)
+        with jitwatch.hot_region("train.step"):
+            # Armed, the guard disallows implicit transfers across the
+            # whole dispatch chain (grads → reduce → apply): the batch
+            # already staged through the sanctioned seam, so anything
+            # else crossing the host boundary here is a leak.
+            losses, grads = self._grads_fn(params, stacked)
 
-        if self.zero:
-            self._reduce_apply_zero(grads)
-        elif self.overlap is True:
-            self._reduce_apply_overlapped(params, grads)
-        elif self.overlap == "drain":
-            # Synchronous-DDP accounting: every bucket dispatched, then
-            # waited out through BucketPush.wait (the one
-            # collective-attribution contract), so the goodput ledger's
-            # collective leg is the reduce wall time — the honest
-            # baseline the overlap mode shrinks.
-            handles = self.store.push_tree_stream("grads", grads,
-                                                  op="mean")
-            for h in handles:
-                h.wait()
-            reduced = self._tree_from_handles(handles)
-            with annotate("train.opt"):
-                new_params, self.opt_state = self._apply_fn(
-                    params, reduced, self.opt_state)
-            self._param_leaves = list(
-                jax.tree_util.tree_leaves(new_params))
-            self._params_seq = self.store.put_tree("params", new_params)
-        else:
-            # The gather: Store push == pmean allreduce over the data
-            # axis, bucketed — the whole grad tree reduces in
-            # ceil(bytes/bucket) fused launches per dtype group, all in
-            # flight before the optimizer consumes the first leaf.
-            # push_tree returns the committed views directly.
-            reduced_flat = self.store.push_tree("grads", grads, op="mean")
-            reduced = jax.tree_util.tree_unflatten(
-                self._treedef,
-                [reduced_flat[k.replace("params/", "grads/", 1)]
-                 for k in self._keys])
-            with annotate("train.opt"):
-                new_params, self.opt_state = self._apply_fn(
-                    params, reduced, self.opt_state
-                )
-            self._param_leaves = list(
-                jax.tree_util.tree_leaves(new_params))
-            # Stamp from the seqs OUR put assigned (not a re-read of
-            # the global max, which would absorb a concurrent external
-            # write into the cache stamp and hide it).
-            self._params_seq = self.store.put_tree("params", new_params)
+            if self.zero:
+                self._reduce_apply_zero(grads)
+            elif self.overlap is True:
+                self._reduce_apply_overlapped(params, grads)
+            elif self.overlap == "drain":
+                # Synchronous-DDP accounting: every bucket dispatched,
+                # then waited out through BucketPush.wait (the one
+                # collective-attribution contract), so the goodput
+                # ledger's collective leg is the reduce wall time — the
+                # honest baseline the overlap mode shrinks.
+                handles = self.store.push_tree_stream("grads", grads,
+                                                      op="mean")
+                for h in handles:
+                    h.wait()
+                reduced = self._tree_from_handles(handles)
+                with annotate("train.opt"):
+                    new_params, self.opt_state = self._apply_fn(
+                        params, reduced, self.opt_state)
+                self._param_leaves = list(
+                    jax.tree_util.tree_leaves(new_params))
+                self._params_seq = self.store.put_tree("params",
+                                                       new_params)
+            else:
+                # The gather: Store push == pmean allreduce over the
+                # data axis, bucketed — the whole grad tree reduces in
+                # ceil(bytes/bucket) fused launches per dtype group,
+                # all in flight before the optimizer consumes the
+                # first leaf. push_tree returns the committed views
+                # directly.
+                reduced_flat = self.store.push_tree("grads", grads,
+                                                    op="mean")
+                reduced = jax.tree_util.tree_unflatten(
+                    self._treedef,
+                    [reduced_flat[k.replace("params/", "grads/", 1)]
+                     for k in self._keys])
+                with annotate("train.opt"):
+                    new_params, self.opt_state = self._apply_fn(
+                        params, reduced, self.opt_state
+                    )
+                self._param_leaves = list(
+                    jax.tree_util.tree_leaves(new_params))
+                # Stamp from the seqs OUR put assigned (not a re-read
+                # of the global max, which would absorb a concurrent
+                # external write into the cache stamp and hide it).
+                self._params_seq = self.store.put_tree("params",
+                                                       new_params)
 
         self.step_count += 1
         return {

@@ -148,6 +148,32 @@ def wait_output(proc, needle: str, timeout: float):
 
 
 @pytest.fixture
+def jitwatch_watchdog():
+    """ISSUE 15: arm the runtime recompile watchdog for one test —
+    every backend compile the stack under test pays is booked per
+    (function, signature), hot regions disallow unsanctioned implicit
+    transfers (they RAISE at the call), and a recompile storm (the
+    same signature compiled ≥3 times — a hot program re-tracing per
+    call) fails the test at teardown. The dispatch tiers
+    (test_chaos_soak / test_serve_engine) alias this as an autouse
+    fixture; steady-state drills additionally ``mark_steady()`` and
+    assert ``recompiles_since_steady() == {}``."""
+    from ptype_tpu import jitwatch
+
+    was = jitwatch.active()
+    jw = jitwatch.enable()
+    yield jw
+    storms = jw.storms()
+    if was is not None:
+        # PTYPE_JITWATCH=1 session: re-arm rather than silently
+        # disarming the rest of the run.
+        jitwatch.enable(was.storm_threshold, was.transfer_level)
+    else:
+        jitwatch.disable()
+    assert not storms, f"recompile storms detected: {storms}"
+
+
+@pytest.fixture
 def lock_order_watchdog():
     """ISSUE 14: arm the runtime lock-order watchdog for one test —
     every lock the stack under test creates is tracked, and a cycle

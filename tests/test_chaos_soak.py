@@ -35,6 +35,7 @@ import numpy as np
 import pytest
 
 from ptype_tpu import chaos, trace
+from ptype_tpu import jitwatch as jitwatch_mod
 from ptype_tpu.chaos import FaultPlan, FaultSpec
 from ptype_tpu.errors import ClusterError, CoordinationError
 
@@ -181,6 +182,7 @@ def run_soak(seed: int, root) -> list[tuple]:
             stream = synthetic_batches(cfg.vocab_size, 8, 32)
 
             chaos.arm(plan)
+            jw = jitwatch_mod.active()
             for i in range(STEPS):
                 assert client.call("Echo.Echo", i) == i
                 out = _step_with_retry(trainer, next(stream))
@@ -192,7 +194,17 @@ def run_soak(seed: int, root) -> list[tuple]:
                         # checkpoint.commit/crash: the step stays
                         # invisible; the next save is the recovery.
                         assert "chaos" in str(e), e
+                if jw is not None and i == SAVE_EVERY:
+                    # One full cycle of every program class (steps +
+                    # a checkpoint save) is the warmup; everything
+                    # after is steady state and must compile NOTHING
+                    # (ISSUE 15 — the armed-soak invariant).
+                    jw.mark_steady()
             assert trainer.step_count == STEPS
+            if jw is not None:
+                assert jw.recompiles_since_steady() == {}, (
+                    f"steady-state compiles under the soak: "
+                    f"{jw.recompiles_since_steady()}")
 
             # ---- drain phase: stop injecting, prove every class is
             # live again, and pair any still-outstanding faults.
@@ -278,7 +290,11 @@ _SEEDS = [int(_ENV_SEED)] if _ENV_SEED else [11, 23]
 
 
 @pytest.mark.parametrize("seed", _SEEDS)
-def test_soak_under_seeded_fault_schedule(seed, tmp_path):
+def test_soak_under_seeded_fault_schedule(seed, tmp_path,
+                                          jitwatch_watchdog):
+    """The soak runs ARMED (ISSUE 15): recompile books kept, hot
+    regions disallow unsanctioned transfers, and run_soak asserts
+    zero steady-state compiles after the first full warmup cycle."""
     run_soak(seed, tmp_path)
 
 

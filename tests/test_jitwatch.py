@@ -1,0 +1,238 @@
+"""Jitwatch unit tier (ISSUE 15): the seeded forced-retrace fixture
+the watchdog must catch, shape-specialization vs recompile
+accounting, the eager-wrapper exclusion, hot-region transfer
+discipline + the sanctioned seam, steady-state marking, the
+flight-recorder dump, env arming, and the recompile-storm health
+rule on synthetic series."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ptype_tpu import jitwatch, trace
+
+
+@pytest.fixture
+def watch():
+    jw = jitwatch.enable(storm_threshold=3)
+    yield jw
+    jitwatch.disable()
+
+
+def test_disarmed_is_inert():
+    jitwatch.disable()
+    assert jitwatch.active() is None
+    # Guards are free no-ops disarmed.
+    with jitwatch.hot_region("x"):
+        jax.jit(lambda v: v + 1)(np.ones(3))  # implicit transfer: fine
+    with jitwatch.sanctioned_transfer("x"):
+        pass
+
+
+def test_forced_retrace_is_detected(watch):
+    """THE fixture: a fresh jit object per call re-keys the trace
+    cache — same function name, same signature, compiled again and
+    again. The watchdog books every one as a recompile and raises a
+    storm at the threshold."""
+    x = jnp.ones(9)
+    for _ in range(4):
+        jax.jit(lambda v: v * 3)(x).block_until_ready()
+    rec = watch.recompiles()
+    assert rec.get("<lambda>", 0) >= 2, rec
+    storms = watch.storms()
+    assert storms and storms[0]["fn"] == "<lambda>", storms
+    assert storms[0]["compiles"] == watch.storm_threshold
+
+
+def test_shape_specialization_is_not_a_recompile(watch):
+    """Distinct signatures are legit specializations (the engine's
+    per-chunk-width programs): compiles counted, recompiles zero."""
+    f = jax.jit(lambda v: v * 2)
+
+    f(jnp.ones(3)).block_until_ready()
+    f(jnp.ones(4)).block_until_ready()
+    f(jnp.ones(5)).block_until_ready()
+    f(jnp.ones(5)).block_until_ready()  # cache hit: no compile
+    assert watch.compiles().get("<lambda>", 0) == 3
+    assert watch.recompiles() == {} and watch.storms() == []
+
+
+def test_eager_wrapper_static_param_churn_is_excluded(watch):
+    """jax's eager op dispatch (jit(broadcast_in_dim) ...) compiles
+    the same INPUT signature under different static params — the log
+    line can't tell those apart, so wrapper names stay out of the
+    recompile/storm books (the false-positive-free charter)."""
+    for n in (2, 3, 4, 5):
+        jnp.broadcast_to(jnp.float32(1.0), (n,)).block_until_ready()
+    assert "broadcast_to" in watch.ignored_fns
+    assert watch.recompiles() == {} and watch.storms() == [], (
+        watch.recompiles(), watch.storms())
+
+
+def test_hot_region_blocks_unsanctioned_implicit_transfer(watch):
+    """Armed, a hot region disallows implicit transfers: a numpy
+    array (or python scalar) smuggled into a jitted call raises AT
+    the call; explicit uploads (jnp.asarray) and the sanctioned seam
+    stay legal."""
+    f = jax.jit(lambda v: v * 2)
+    dev = jnp.ones(4)
+    f(dev).block_until_ready()  # compile outside the guard
+    with jitwatch.hot_region("test.hot"):
+        f(dev)                        # device-resident: fine
+        f(jnp.asarray(np.ones(4, np.float32)))  # explicit: fine
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            f(np.ones(4, np.float32))  # implicit: the leak, caught
+        with jitwatch.sanctioned_transfer("test.meter"):
+            f(np.ones(4, np.float32))  # exempted AND counted
+    assert watch.sanctioned() == {"test.meter": 1}
+    assert watch.report()["hot_regions"] == 1
+
+
+def test_mark_steady_books_every_later_compile(watch):
+    f = jax.jit(lambda v: v + 1)
+    x3, x6 = jnp.ones(3), jnp.ones(6)  # arrays built pre-steady: the
+    #                                    books must show OUR program
+    f(x3).block_until_ready()
+    watch.mark_steady()
+    assert watch.recompiles_since_steady() == {}
+    f(x3).block_until_ready()           # cache hit: still zero
+    assert watch.recompiles_since_steady() == {}
+    f(x6).block_until_ready()  # NEW shape post-steady: booked
+    assert watch.recompiles_since_steady() == {"<lambda>": 1}
+
+
+def test_storm_dumps_through_flight_recorder(watch, tmp_path):
+    """A storm lands in the span ring and the rate-limited
+    flight-*.jsonl dump — the post-mortem artifact the runbook row
+    points at."""
+    rec = trace.enable("jitwatch-test", dump_dir=str(tmp_path))
+    trace._dump_last = 0.0  # an earlier test's dump must not eat the
+    #                         one-per-interval rate limit
+    try:
+        with trace.span("drive"):
+            x = jnp.ones(11)
+            for _ in range(3):
+                jax.jit(lambda v: v - 1)(x).block_until_ready()
+        assert watch.storms()
+        dumps = list(tmp_path.glob("flight-*.jsonl"))
+        assert dumps, "no flight-recorder dump for the storm"
+    finally:
+        trace.disable()
+
+
+def test_enable_from_env(monkeypatch):
+    monkeypatch.setenv(jitwatch.ENV_VAR, "1")
+    jitwatch.disable()
+    jitwatch._maybe_enable_from_env()
+    try:
+        assert jitwatch.active() is not None
+    finally:
+        jitwatch.disable()
+
+
+def test_disable_restores_compile_log_config():
+    prior = bool(jax.config.jax_log_compiles)
+    jitwatch.enable()
+    assert bool(jax.config.jax_log_compiles) is True
+    jitwatch.disable()
+    assert bool(jax.config.jax_log_compiles) is prior
+    # No leftover filters on the hooked loggers.
+    for name in jitwatch._NOISY_LOGGERS:
+        assert not any(isinstance(f, jitwatch._CompileFilter)
+                       for f in logging.getLogger(name).filters)
+
+
+def test_armed_logs_are_swallowed_not_printed(watch, capsys):
+    """We armed jax_log_compiles for the hook, not the console: the
+    compile WARNINGs must not reach the root handlers."""
+    jax.jit(lambda v: v * 7)(jnp.ones(13)).block_until_ready()
+    err = capsys.readouterr().err
+    assert "Compiling" not in err and "Finished XLA" not in err
+
+
+def test_recompile_storm_rule_names_the_function():
+    """The health rule on synthetic series: counter delta over the
+    window trips the page, and the per-function books name the worst
+    offender; a flat series stays silent."""
+    from ptype_tpu.health.rules import ClusterView, RecompileStormRule
+
+    now = 1000.0
+    stormy = {
+        "nodes": {
+            "workers/w0": {"series": {
+                "jit.recompiles": [(now - 90, 1.0), (now - 30, 3.0),
+                                   (now - 5, 6.0)],
+                "jit.fn.engine_step": [(now - 5, 5.0)],
+                "jit.fn.apply": [(now - 5, 1.0)],
+            }},
+            "workers/w1": {"series": {
+                "jit.recompiles": [(now - 90, 2.0), (now - 5, 2.0)],
+            }},
+        },
+        "ts": now,
+    }
+    rule = RecompileStormRule(threshold=3, window_s=120.0)
+    alerts = rule.evaluate(ClusterView(stormy, now))
+    assert len(alerts) == 1 and alerts[0].node == "workers/w0"
+    assert alerts[0].rule == "recompile-storm"
+    assert "engine_step" in alerts[0].message
+    assert alerts[0].labels.get("fn") == "engine_step"
+
+
+def test_recompile_storm_rule_in_default_set():
+    from ptype_tpu.health.rules import (RecompileStormRule,
+                                        default_rules)
+
+    assert any(isinstance(r, RecompileStormRule)
+               for r in default_rules())
+
+
+def test_obs_jit_render_names_functions_and_disarmed_fleet():
+    from ptype_tpu.health.top import render_jit
+
+    snap = {
+        "ts": "2026-08-04T00:00:00",
+        "nodes": {
+            "workers/w0": {
+                "metrics": {
+                    "counters": {"jit.compiles": 42.0,
+                                 "jit.recompiles": 7.0,
+                                 "jit.sanctioned_transfers": 5.0},
+                    "gauges": {"jit.fn.engine_step": 6.0,
+                               "jit.fn.apply": 1.0},
+                },
+                "series": {},
+            },
+            "workers/w1": {"metrics": {"counters": {}}, "series": {}},
+        },
+        "errors": {},
+    }
+    out = render_jit(snap)
+    assert "engine_step (6x)" in out and "42" in out and "7" in out
+    assert "1 armed" in out
+    empty = render_jit({"ts": "t", "nodes": {}, "errors": {}})
+    assert "PTYPE_JITWATCH=1" in empty
+
+
+def test_overhead_probe_rearms_an_armed_watchdog():
+    """Review regression: measure_jitwatch_overhead in an armed
+    process must leave a LIVE watchdog behind (filters + compile-log
+    config re-armed), not a zombie that reports armed while counting
+    nothing."""
+    from ptype_tpu.health.bench import measure_jitwatch_overhead
+
+    jitwatch.enable()
+    try:
+        measure_jitwatch_overhead(iters=50, repeats=1)
+        jw = jitwatch.active()
+        assert jw is not None and bool(jax.config.jax_log_compiles)
+        x = jnp.ones(17)
+        for _ in range(4):
+            jax.jit(lambda v: v * 2)(x).block_until_ready()
+        assert jw.recompiles().get("<lambda>", 0) >= 2, \
+            jw.recompiles()
+    finally:
+        jitwatch.disable()

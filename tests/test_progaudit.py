@@ -1,0 +1,147 @@
+"""Progaudit contract tier (ISSUE 15): the jaxpr-level auditor's
+detectors (callbacks, f64 drift, collective-count fusion, donation
+consumption) on synthetic programs, and THE acceptance — the real
+hot-program registry (train grads, ZeRO shard-apply, bucketed
+allreduce/reduce-scatter, the paged decode step, the fused spec
+window) audits clean on the current tree."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ptype_tpu import progaudit
+
+
+# ------------------------------------------------------------ detectors
+
+
+def test_clean_program_audits_clean():
+    rep = progaudit.audit(lambda x: x * 2 + 1,
+                          (jax.ShapeDtypeStruct((8,), jnp.float32),),
+                          name="clean", expect_collectives=0)
+    assert rep.ok and rep.collectives == {} and rep.eqns >= 2
+    assert rep.raise_if_failed() is rep
+
+
+def test_callback_in_program_is_flagged():
+    def noisy(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1
+
+    rep = progaudit.audit(
+        noisy, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+        name="noisy")
+    assert not rep.ok and rep.callbacks, rep.to_dict()
+    with pytest.raises(progaudit.AuditError, match="noisy"):
+        rep.raise_if_failed()
+
+
+def test_pure_callback_is_flagged():
+    import numpy as np
+
+    def hybrid(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+    rep = progaudit.audit(
+        hybrid, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    assert not rep.ok and "pure_callback" in rep.callbacks
+
+
+def test_f64_drift_is_flagged_and_allow_f64_waives():
+    from jax.experimental import enable_x64
+
+    def drift(x):
+        return x.astype(jnp.float64).sum()
+
+    with enable_x64():
+        rep = progaudit.audit(
+            drift, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            name="drift")
+        waived = progaudit.audit(
+            drift, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            allow_f64=True)
+    assert not rep.ok and rep.f64_sites, rep.to_dict()
+    assert waived.ok
+
+
+def test_unfused_collective_count_breaks_the_contract():
+    """N per-leaf psums where the bucket plan says ONE — the un-fusion
+    regression the launch-count invariant exists to catch."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ptype_tpu.compat import shard_map
+
+    mesh = Mesh(jax.devices(), ("data",))
+
+    def per_leaf(a, b):
+        return (jax.lax.psum(a, "data"), jax.lax.psum(b, "data"))
+
+    fn = shard_map(per_leaf, mesh=mesh,
+                   in_specs=(P("data"), P("data")),
+                   out_specs=(P(), P()), check_vma=False)
+    n = jax.device_count()
+    avals = (jax.ShapeDtypeStruct((n, 4), jnp.float32),
+             jax.ShapeDtypeStruct((n, 4), jnp.float32))
+    rep = progaudit.audit(fn, avals, name="unfused",
+                          expect_collectives=1)
+    assert not rep.ok and rep.collectives.get("psum") == 2, \
+        rep.to_dict()
+    ok = progaudit.audit(fn, avals, expect_collectives={"psum": 2})
+    assert ok.ok
+
+
+def test_dropped_donation_is_flagged():
+    """Donating a buffer no output can alias (shape mismatch) makes
+    XLA drop the donation — the audit sees no marker in the lowering
+    and flags the copy."""
+    rep = progaudit.audit(
+        lambda x: x.sum(),
+        (jax.ShapeDtypeStruct((16,), jnp.float32),),
+        name="dropped", donate_argnums=(0,))
+    assert not rep.ok and rep.donated_consumed < rep.donated_expected
+    assert any("donation" in p for p in rep.problems), rep.problems
+
+
+def test_consumed_donation_passes():
+    rep = progaudit.audit(
+        lambda x: x * 2,
+        (jax.ShapeDtypeStruct((16,), jnp.float32),),
+        donate_argnums=(0,))
+    assert rep.ok and rep.donated_consumed >= 1, rep.to_dict()
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_unknown_program_raises_keyerror():
+    with pytest.raises(KeyError, match="no registered hot program"):
+        progaudit.audit_registered("no.such.program")
+
+
+def test_default_registry_covers_the_hot_programs():
+    progaudit.register_default_programs()
+    names = progaudit.registered()
+    assert set(progaudit.DEFAULT_PROGRAMS) <= set(names)
+    assert len(progaudit.DEFAULT_PROGRAMS) >= 5
+
+
+def test_real_hot_programs_audit_clean():
+    """THE acceptance (ISSUE 15): every registered hot program on the
+    CURRENT tree traces with no callbacks, no f64, the pinned
+    collective launch counts, and consumed donations."""
+    progaudit.register_default_programs()
+    reports = progaudit.audit_all(raise_on_failure=True)
+    assert len(reports) >= 5
+    # The specific contract points, pinned:
+    assert reports["collectives.bucket_allreduce"].collectives == \
+        {"psum": 1}
+    assert reports["collectives.bucket_reduce_scatter"].collectives \
+        == {"reduce_scatter": 1}
+    assert reports["zero.shard_apply"].collectives == {"all_gather": 1}
+    dec = reports["serve.decode_step"]
+    assert dec.donated_consumed == dec.donated_expected == 2
+    win = reports["serve.spec_window"]
+    assert win.donated_consumed == win.donated_expected == 4
+    assert win.collectives == {} and dec.collectives == {}

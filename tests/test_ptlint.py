@@ -674,3 +674,223 @@ def test_pt015_join_in_another_method_does_not_reach_local_thread(
     )
     findings = _check(tmp_path, "ptype_tpu/hole15.py", src)
     assert any("PT015" in f for f in findings), findings
+
+
+# ------------------------------------------------------------------ PT018
+
+
+PT018_HOT_SYNC = (
+    "import jax.numpy as jnp\n"
+    "class E:\n"
+    "    def run(self, xs):\n"
+    "        outs = []\n"
+    "        for x in xs:\n"
+    "            y = jnp.dot(x, x)\n"
+    "            outs.append(float(y[0]))\n"   # device read per iter
+    "        return outs\n"
+)
+
+
+def test_pt018_flags_device_read_in_loop(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/hot18.py",
+                      PT018_HOT_SYNC)
+    assert any("PT018" in f and "float(y[0])" in f for f in findings), \
+        findings
+
+
+def test_pt018_flags_item_and_device_get(tmp_path):
+    src = (
+        "import jax\n"
+        "from jax import device_get as dg\n"
+        "def drain(vals):\n"
+        "    total = 0.0\n"
+        "    for v in vals:\n"
+        "        total += v.item()\n"
+        "        dg(v)\n"
+        "    return total\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/train/sync18.py", src)
+    assert sum("PT018" in f for f in findings) == 2, findings
+
+
+def test_pt018_silent_on_host_mirrors(tmp_path):
+    """The engine idiom: np-assigned host state indexed in loops is
+    NOT a device sync — the false-positive-free charter."""
+    src = (
+        "import numpy as np\n"
+        "class E:\n"
+        "    def step(self, nxt, slots):\n"
+        "        nxt_host = np.array(nxt)\n"
+        "        out = []\n"
+        "        for s in slots:\n"
+        "            out.append(int(nxt_host[s]))\n"
+        "        return out\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/ok18.py", src)
+    assert not any("PT018" in f for f in findings), findings
+
+
+def test_pt018_flags_np_asarray_of_jit_result(tmp_path):
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "class E:\n"
+        "    def __init__(self, f):\n"
+        "        self._step = jax.jit(f)\n"
+        "    def run(self, xs):\n"
+        "        outs = []\n"
+        "        for x in xs:\n"
+        "            y = self._step(x)\n"
+        "            outs.append(np.asarray(y))\n"
+        "        return outs\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/models/jit18.py", src)
+    assert any("PT018" in f and "np.asarray(y)" in f
+               for f in findings), findings
+
+
+def test_pt018_sanctioned_meter_seams_are_exempt(tmp_path):
+    src = PT018_HOT_SYNC.replace("def run(", "def measure_run(")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/meter18.py",
+                      src)
+    assert not any("PT018" in f for f in findings), findings
+
+
+def test_pt018_silent_outside_hot_modules(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/gateway/cool18.py",
+                      PT018_HOT_SYNC)
+    assert not any("PT018" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt018_clean():
+    found = [f for f in _walk_pkg_findings() if "PT018" in f]
+    assert not found, found
+
+
+# ------------------------------------------------------------------ PT019
+
+
+def test_pt019_flags_jit_of_lambda_per_call(tmp_path):
+    src = (
+        "import jax\n"
+        "class E:\n"
+        "    def step(self, x):\n"
+        "        return jax.jit(lambda v: v * 2)(x)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/lam19.py", src)
+    # ONE defect, ONE finding: the construct-and-call branch covers
+    # the inner lambda-jit — no double count on the same expression.
+    assert sum("PT019" in f for f in findings) == 1, findings
+
+
+def test_pt019_flags_jit_in_loop_and_local_closure(tmp_path):
+    src = (
+        "import jax\n"
+        "class E:\n"
+        "    def rebuild(self, shapes, cfg):\n"
+        "        progs = []\n"
+        "        for s in shapes:\n"
+        "            progs.append(jax.jit(self._fwd))\n"
+        "        return progs\n"
+        "    def score(self, x, cfg):\n"
+        "        def fwd(v):\n"
+        "            return v @ cfg.w\n"
+        "        return jax.jit(fwd)(x)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/loop19.py", src)
+    assert sum("PT019" in f for f in findings) >= 2, findings
+
+
+def test_pt019_passes_init_builder_and_module_scope(tmp_path):
+    src = (
+        "import jax\n"
+        "def _top(v):\n"
+        "    return v + 1\n"
+        "TOP = jax.jit(_top)\n"              # module scope: cached
+        "class E:\n"
+        "    def __init__(self, f, shapes):\n"
+        "        self._step = jax.jit(lambda v: f(v))\n"
+        "        self._progs = [jax.jit(f) for _ in shapes]\n"
+        "    def _chunk_prog(self, C):\n"     # memoized builder idiom
+        "        def run(p, t):\n"
+        "            return p @ t\n"
+        "        return jax.jit(run)\n"
+        "def measure_push(f, x):\n"           # one-shot probe seam
+        "    return jax.jit(lambda v: f(v))(x)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/ok19.py", src)
+    assert not any("PT019" in f for f in findings), findings
+
+
+def test_pt019_tracks_from_import_alias(tmp_path):
+    src = (
+        "from jax import jit as J\n"
+        "class E:\n"
+        "    def step(self, x):\n"
+        "        return J(lambda v: v * 2)(x)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/alias19.py", src)
+    assert any("PT019" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt019_clean():
+    found = [f for f in _walk_pkg_findings() if "PT019" in f]
+    assert not found, found
+
+
+# ------------------------------------------------------------------ PT020
+
+
+def test_pt020_flags_dtypeless_and_explicit_f64(tmp_path):
+    src = (
+        "import numpy as np\n"
+        "def build(x):\n"
+        "    a = np.zeros(4)\n"                     # dtype-less ctor
+        "    b = np.array([0.5, 1.5])\n"            # float literals
+        "    c = np.float64(x)\n"                   # explicit f64
+        "    d = x.astype(np.float64)\n"            # f64 cast
+        "    e = np.ones(3, dtype=np.float64)\n"    # f64 dtype kw
+        "    return a, b, c, d, e\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/parallel/drift20.py", src)
+    assert sum("PT020" in f for f in findings) == 5, findings
+
+
+def test_pt020_passes_named_dtypes_and_int_literals(tmp_path):
+    src = (
+        "import numpy as np\n"
+        "def build(rows, nb):\n"
+        "    a = np.zeros((rows, nb), np.int32)\n"   # positional dtype
+        "    b = np.ones(rows, np.float32)\n"
+        "    c = np.full((2, 2), 7, np.int32)\n"
+        "    d = np.array([1, 2, 3])\n"              # int literals ok
+        "    e = np.asarray(a, dtype=np.float32)\n"
+        "    return a, b, c, d, e\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/ok20.py", src)
+    assert not any("PT020" in f for f in findings), findings
+
+
+def test_pt020_tracks_numpy_alias(tmp_path):
+    src = (
+        "import numpy as N\n"
+        "def build():\n"
+        "    return N.zeros(4)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/models/alias20.py", src)
+    assert any("PT020" in f for f in findings), findings
+
+
+def test_pt020_silent_outside_device_adjacent_dirs(tmp_path):
+    src = (
+        "import numpy as np\n"
+        "def build():\n"
+        "    return np.zeros(4)\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/cool20.py", src)
+    assert not any("PT020" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt020_clean():
+    found = [f for f in _walk_pkg_findings() if "PT020" in f]
+    assert not found, found

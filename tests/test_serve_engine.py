@@ -750,3 +750,30 @@ def test_goodput_ledger_attributes_serve_prefill_leg():
     assert rec["prefill_ms"] >= 15, rec
     assert rec["stall_ms"] < rec["prefill_ms"], rec
     assert led.summary()["step_breakdown"]["prefill_ms"] > 0
+
+
+# ------------------------------------- dispatch discipline (ISSUE 15)
+
+
+def test_steady_state_decode_compiles_nothing_armed(jitwatch_watchdog):
+    """The armed serve tier: after one full warmup request (prefill
+    chunks + decode steps + sampling), a steady stream of same-shaped
+    requests compiles NOTHING — the engine's device mirrors and
+    cached programs re-dispatch, never re-trace — and the hot region's
+    transfer guard held (an unsanctioned implicit transfer inside the
+    decode step would have raised, failing the drive)."""
+    jw = jitwatch_watchdog
+    actor = PagedGeneratorActor(CFG, n_slots=2, block_tokens=16)
+    try:
+        p = _prompt(5)
+        warm = np.asarray(actor.Generate(p, 8))
+        jw.mark_steady()
+        for _ in range(3):
+            out = np.asarray(actor.Generate(p, 8))
+            np.testing.assert_array_equal(out, warm)
+        assert jw.recompiles_since_steady() == {}, \
+            jw.recompiles_since_steady()
+        assert jw.report()["hot_regions"] > 0  # the guard was LIVE
+        assert jw.recompiles() == {} and jw.storms() == []
+    finally:
+        actor.close()

@@ -20,6 +20,11 @@ into a package with a shared scope/dataflow core and a rule registry:
 - ``rules_concurrency`` — PT013 lock-discipline, PT014
                      blocking-under-lock, PT015 thread-hygiene
 - ``rules_jax``  — PT016 donation-safety, PT017 RNG-key-reuse
+- ``rules_dispatch`` — PT018 host-sync-in-hot-path, PT019
+                     retrace-hazard, PT020 f64-drift (the static half
+                     of the dispatch-discipline plane; jitwatch.py is
+                     the runtime half, progaudit.py the program
+                     contract)
 
 The rule catalogue (ID, rationale, example, suppression policy) lives
 in docs/LINTING.md. Exit 0 when clean; 1 with one
@@ -45,3 +50,4 @@ from . import rules_style  # noqa: F401,E402
 from . import rules_domain  # noqa: F401,E402
 from . import rules_concurrency  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
+from . import rules_dispatch  # noqa: F401,E402

@@ -1,0 +1,474 @@
+"""PT018/PT019/PT020 — the dispatch-discipline passes.
+
+The perf work the tree banks on (fused spec windows, overlapped
+bucketed collectives, the steady-state decode step that re-uploads
+nothing) is only as good as the *compiled programs staying compiled*:
+a stray host sync serializes async dispatch, a silent retrace turns a
+hot loop into a compile loop, and an f64 upcast doubles wire and HBM
+bytes without failing a single test. These passes police the three
+classes statically; :mod:`ptype_tpu.jitwatch` is the runtime half and
+:mod:`ptype_tpu.progaudit` the program-level contract.
+
+- **PT018 host-sync-in-hot-path**: ``.item()``, ``jax.device_get``,
+  and ``np.asarray``/``np.array``/``float()``/``int()`` of a
+  DEVICE-POSITIVE value inside a LOOP body in the hot modules
+  (``serve_engine/``, ``train/``, ``models/``, ``parallel/``) — each
+  one blocks the host on the device stream, once per iteration.
+  Device-positive means the pass PROVED the value came off a device:
+  assigned from a ``jnp.*``/``jax.*``/``lax.*`` call or from a call
+  through a ``jax.jit`` binding, in this file. Host mirrors (the
+  engine's ``np.zeros`` slot state, ``nxt_host = np.array(nxt)``)
+  never flag — the false-positive-free charter. Sanctioned seams:
+  meter/telemetry/probe functions (``Info``/``summary``/
+  ``measure_*``/``check_*`` and friends), where a sync is the point.
+
+- **PT019 retrace-hazard**: ``jax.jit`` applied to a ``lambda`` or a
+  locally-defined closure inside a per-call method, ``jax.jit``
+  constructed inside a loop outside the init/builder seams, or the
+  construct-and-call form ``jax.jit(f)(x)`` — every pass builds a
+  FRESH function object, so jit's cache re-keys and the program
+  RE-TRACES per call. The house idiom caches the jitted callable at
+  ``__init__``/module scope or in a ``_build*``/``_make*``/``*_prog``
+  helper memoized by the caller; one-shot probe seams
+  (``measure_*``, ``bench*``) are exempt — their jit runs once by
+  charter.
+
+- **PT020 f64-drift**: ``np.float64`` (call, dtype arg, or
+  ``.astype``), and dtype-less ``np.array``/``np.asarray`` of float
+  literals or dtype-less ``np.zeros``/``ones``/``full``/``empty`` in
+  device-adjacent dirs (``parallel/``, ``serve_engine/``,
+  ``models/``, ``train/``) — numpy defaults to float64, and an f64
+  leaf flowing into device code either upcasts the program (2x HBM +
+  wire bytes) or trips the x64 guard at the worst possible time.
+  A positional or keyword dtype of any kind satisfies the rule (the
+  house idiom is ``np.zeros(n, np.int32)``); int-literal content is
+  exempt (int64 host indexes are normal bookkeeping).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, rule
+from .scopes import ContextWalker, ImportMap, unparse
+
+#: The hot modules: dirs (and top-level files) whose loops dispatch
+#: device programs.
+_HOT_DIRS = ("serve_engine", "train", "models", "parallel")
+_HOT_FILES = ("serve.py",)
+
+#: Function-name shapes that ARE the sanctioned host-sync / one-shot
+#: probe seams: telemetry, meters, summaries, audits, benches — a
+#: sync (or a throwaway jit) there is the contract, not a leak.
+_SANCTIONED_PREFIXES = (
+    "info", "summary", "snapshot", "measure", "check", "audit",
+    "render", "bench", "export", "stats", "dump", "describe",
+)
+_SANCTIONED_EXACT = frozenset({
+    "Info", "__repr__", "__str__", "close",
+})
+
+#: Module paths whose calls produce device values.
+_DEVICE_MODULES = frozenset({
+    "jax", "jax.numpy", "jax.lax", "jax.random", "jax.nn",
+})
+
+
+def _in_hot_dir(ctx: FileContext) -> bool:
+    return ctx.in_pkg and (any(ctx.in_dir(d) for d in _HOT_DIRS)
+                           or ctx.basename in _HOT_FILES)
+
+
+def _is_sanctioned_fn(fn_stack: list[str]) -> bool:
+    for name in fn_stack:
+        if name in _SANCTIONED_EXACT:
+            return True
+        low = name.lstrip("_").lower()
+        if low.startswith(_SANCTIONED_PREFIXES):
+            return True
+    return False
+
+
+class _JaxNames:
+    """Shared alias resolution for the jax/numpy module universe."""
+
+    def __init__(self, tree: ast.AST):
+        self.imports = ImportMap(tree)
+        self.np_mods = (self.imports.module_aliases("numpy")
+                        or {"np", "numpy"})
+        self.jax_mods = self.imports.module_aliases("jax") or {"jax"}
+        self.device_mods: set[str] = set()
+        for dotted in _DEVICE_MODULES:
+            self.device_mods |= self.imports.module_aliases(dotted)
+        self.device_mods |= self.jax_mods
+        self.from_jit = {
+            local for local, (mod, orig)
+            in self.imports.from_names.items()
+            if mod == "jax" and orig == "jit"}
+        self.from_device_get = {
+            local for local, (mod, orig)
+            in self.imports.from_names.items()
+            if mod == "jax" and orig == "device_get"}
+
+    def root_module(self, fn: ast.expr) -> str | None:
+        """The module alias a call chain roots at: ``jnp`` for
+        ``jnp.where(...)``, ``jax`` for ``jax.random.split(...)``."""
+        node = fn
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def is_device_call(self, node: ast.expr) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        root = self.root_module(node.func)
+        return root is not None and root in self.device_mods
+
+    def is_jit_call(self, node: ast.Call) -> bool:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "jit"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in self.jax_mods):
+            return True
+        return isinstance(fn, ast.Name) and fn.id in self.from_jit
+
+
+def _jit_bindings(tree: ast.AST, names: _JaxNames) -> set[str]:
+    """Expression texts bound to a ``jax.jit(...)`` product anywhere
+    in the file (``self._step = jax.jit(...)``, ``fn = jit(...)``) —
+    calls THROUGH these produce device values."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and names.is_jit_call(node.value):
+            for t in node.targets:
+                out.add(unparse(t))
+    return out
+
+
+def _device_names(fn: ast.AST, names: _JaxNames,
+                  jit_bound: set[str]) -> set[str]:
+    """Names/attribute texts PROVEN device-resident inside ``fn``:
+    assigned from a jnp/jax/lax call or a call through a jit
+    binding. File-local, positive evidence only."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        is_dev = (names.is_device_call(v)
+                  or (isinstance(v, ast.Call)
+                      and unparse(v.func) in jit_bound)
+                  or (isinstance(v, ast.Tuple)
+                      and any(names.is_device_call(e)
+                              for e in v.elts)))
+        if not is_dev:
+            continue
+        for t in node.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    out.add(unparse(e))
+            else:
+                out.add(unparse(t))
+    return out
+
+
+# --------------------------------------------------------------- PT018
+
+
+class _Pt018Walker(ContextWalker):
+    """Flag host-sync verbs inside loop bodies of hot modules."""
+
+    def __init__(self, ctx: FileContext, findings: list[Finding]):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+        self.names = _JaxNames(ctx.tree)
+        self.jit_bound = _jit_bindings(ctx.tree, self.names)
+        #: Per-function device-positive name sets (stack).
+        self.dev_names: list[set] = []
+
+    def _fn(self, node) -> None:
+        self.dev_names.append(
+            _device_names(node, self.names, self.jit_bound))
+        super()._fn(node)
+        self.dev_names.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def _device_positive(self, node: ast.expr) -> bool:
+        """True when ``node`` provably came off a device: a
+        device-call expression, a name assigned from one, or a
+        subscript/attr whose base did."""
+        if self.names.is_device_call(node):
+            return True
+        if (isinstance(node, ast.Call)
+                and unparse(node.func) in self.jit_bound):
+            return True
+        base = node
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        text = unparse(base)
+        return any(text in s for s in self.dev_names)
+
+    def _flag(self, node, what: str, hint: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT018",
+            f"{what} inside a hot-path loop — a device-to-host sync "
+            f"per iteration serializes async dispatch (the "
+            f"three-dispatch spec window measured 0.77x before its "
+            f"syncs were fused out); {hint}"))
+
+    def _np_verb(self, fn: ast.expr, verbs: tuple) -> str | None:
+        if (isinstance(fn, ast.Attribute) and fn.attr in verbs
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in self.names.np_mods):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            src = self.names.imports.from_names.get(fn.id)
+            if src is not None and src[0] == "numpy" \
+                    and src[1] in verbs:
+                return src[1]
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if not self.loop_depth or not self.fn_stack \
+                or _is_sanctioned_fn(self.fn_stack):
+            self.generic_visit(node)
+            return
+        fn = node.func
+        # x.item() — the canonical one-scalar-per-iteration sync.
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                and not node.args:
+            self._flag(node, f"{unparse(fn)}()",
+                       "batch the host read: one np.asarray of the "
+                       "whole result after the loop")
+        # jax.device_get(...) — explicit transfer per iteration.
+        elif ((isinstance(fn, ast.Attribute)
+               and fn.attr == "device_get"
+               and isinstance(fn.value, ast.Name)
+               and fn.value.id in self.names.jax_mods)
+              or (isinstance(fn, ast.Name)
+                  and fn.id in self.names.from_device_get)):
+            self._flag(node, "jax.device_get(...)",
+                       "hoist one device_get of the stacked result "
+                       "out of the loop")
+        else:
+            # np.asarray/np.array of a PROVEN device value — implicit
+            # d2h per iteration. Host mirrors and literals never flag.
+            verb = self._np_verb(fn, ("asarray", "array"))
+            if verb is not None and node.args \
+                    and self._device_positive(node.args[0]):
+                self._flag(node, f"np.{verb}({unparse(node.args[0])})",
+                           "pull the whole batch once outside the "
+                           "loop, or keep the value on device")
+            # float(x[i]) / int(x[i]) on a device value — element-wise
+            # host reads.
+            elif (isinstance(fn, ast.Name)
+                  and fn.id in ("float", "int") and node.args
+                  and isinstance(node.args[0], (ast.Subscript,
+                                                ast.Call))
+                  and self._device_positive(node.args[0])):
+                self._flag(node, f"{fn.id}({unparse(node.args[0])})",
+                           "read the array once (np.asarray outside "
+                           "the loop) and index the host copy")
+        self.generic_visit(node)
+
+
+@rule("PT018", "host sync inside a hot-path loop",
+      applies=_in_hot_dir)
+def check_pt018(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _Pt018Walker(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT019
+
+#: Function-name shapes sanctioned to CONSTRUCT jits: builders the
+#: caller memoizes (the `_chunk_prog` idiom) and init paths.
+_PT019_BUILDER_PREFIXES = ("_build", "_make", "build_", "make_",
+                           "init", "_init", "_compile", "compile_")
+_PT019_BUILDER_SUFFIXES = ("_prog", "_fn", "_program", "_step_fn")
+
+
+def _is_builder(name: str) -> bool:
+    return (name.startswith(_PT019_BUILDER_PREFIXES)
+            or name.endswith(_PT019_BUILDER_SUFFIXES)
+            or name in ("__init__", "__new__"))
+
+
+class _Pt019Walker(ContextWalker):
+    def __init__(self, ctx: FileContext, findings: list[Finding]):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+        self.names = _JaxNames(ctx.tree)
+        #: Names of functions DEFINED inside the currently-walked
+        #: function body (stack of sets) — jitting one of these from
+        #: a sibling statement builds a fresh callee per call.
+        self.local_defs: list[set] = []
+        #: Inner jit-call nodes already flagged as part of an outer
+        #: construct-and-call expression — ONE defect, one finding.
+        self._covered: set[int] = set()
+
+    def _fn(self, node) -> None:
+        if self.local_defs:
+            self.local_defs[-1].add(node.name)
+        self.local_defs.append(set())
+        super()._fn(node)
+        self.local_defs.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _fn
+
+    def _sanctioned(self) -> bool:
+        return (any(_is_builder(n) for n in self.fn_stack)
+                or _is_sanctioned_fn(self.fn_stack))
+
+    def _flag(self, node, shape: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT019",
+            f"jax.jit {shape} — the wrapped function object is fresh "
+            f"every pass, so jit's cache re-keys and the program "
+            f"RE-TRACES per call (a silent compile loop; jitwatch's "
+            f"recompile-storm pages on exactly this at runtime); "
+            f"cache the jitted callable at __init__/module scope or "
+            f"in a memoized *_prog builder"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # jax.jit(f)(x): construct-and-call — never cached anywhere.
+        # Checked FIRST and the inner jit call marked covered, so the
+        # one expression yields one finding, not a second from the
+        # lambda/closure branch below.
+        if (isinstance(node.func, ast.Call)
+                and self.names.is_jit_call(node.func) and self.fn_stack
+                and not self._sanctioned()):
+            self._flag(node, "constructed and called in one "
+                             "expression (jax.jit(f)(...))")
+            self._covered.add(id(node.func))
+        if self.names.is_jit_call(node) and self.fn_stack \
+                and id(node) not in self._covered \
+                and not self._sanctioned():
+            target = node.args[0] if node.args else None
+            if self.loop_depth:
+                self._flag(node, "constructed inside a loop")
+            elif isinstance(target, ast.Lambda):
+                self._flag(node, "of a lambda in a per-call method")
+            elif (isinstance(target, ast.Name) and self.local_defs
+                  and target.id in self.local_defs[-1]):
+                self._flag(node, f"of locally-defined closure "
+                                 f"'{target.id}' in a per-call method")
+        self.generic_visit(node)
+
+
+@rule("PT019", "per-call jax.jit construction re-keys the trace cache",
+      applies=lambda ctx: ctx.in_pkg)
+def check_pt019(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _Pt019Walker(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------------------- PT020
+
+_F64_NAMES = frozenset({"float64", "double"})
+#: Positional index of the dtype parameter per constructor.
+_CTOR_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                   "array": 1, "asarray": 1}
+
+
+def _has_float_literal(node: ast.expr) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                        float):
+            return True
+    return False
+
+
+class _Pt020Walker(ContextWalker):
+    def __init__(self, ctx: FileContext, findings: list[Finding]):
+        super().__init__()
+        self.ctx = ctx
+        self.findings = findings
+        self.names = _JaxNames(ctx.tree)
+
+    def _flag(self, node, what: str, hint: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT020",
+            f"{what} in a device-adjacent module — numpy defaults to "
+            f"float64, and an f64 leaf reaching device code either "
+            f"upcasts the whole program (2x HBM + wire bytes) or "
+            f"trips the jax x64 guard; {hint}"))
+
+    def _np_attr(self, fn: ast.expr) -> str | None:
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in self.names.np_mods):
+            return fn.attr
+        return None
+
+    def _is_f64_dtype(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return node.value in ("float64", "double")
+        if isinstance(node, ast.Attribute):
+            return (node.attr in _F64_NAMES
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in self.names.np_mods)
+        if isinstance(node, ast.Name):
+            src = self.names.imports.from_names.get(node.id)
+            return (src is not None and src[0] == "numpy"
+                    and src[1] in _F64_NAMES)
+        return False
+
+    def _dtype_arg(self, node: ast.Call, attr: str) -> ast.expr | None:
+        """The dtype argument of a numpy constructor call, positional
+        (``np.zeros(n, np.int32)`` — the house idiom) or keyword."""
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                return kw.value
+        pos = _CTOR_DTYPE_POS.get(attr)
+        if pos is not None and len(node.args) > pos:
+            return node.args[pos]
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        attr = self._np_attr(node.func)
+        # Explicit f64: np.float64(x), dtype=np.float64/"float64",
+        # .astype(np.float64).
+        if attr in _F64_NAMES:
+            self._flag(node, f"np.{attr}(...)",
+                       "use np.float32 (or the config dtype)")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "astype" and node.args
+              and self._is_f64_dtype(node.args[0])):
+            self._flag(node, f".astype({unparse(node.args[0])})",
+                       "cast to float32 (or the config dtype)")
+        elif attr in _CTOR_DTYPE_POS:
+            dtype = self._dtype_arg(node, attr)
+            if dtype is not None and self._is_f64_dtype(dtype):
+                self._flag(node, f"dtype {unparse(dtype)}",
+                           "name a 32-bit (or config) dtype")
+            elif dtype is None and attr in ("array", "asarray"):
+                # Dtype-less literal construction drifts only when
+                # float content is involved (int64 host indexes are
+                # the normal bookkeeping idiom).
+                if node.args and _has_float_literal(node.args[0]):
+                    self._flag(
+                        node,
+                        f"dtype-less np.{attr} of float literals",
+                        "write dtype=np.float32 — the literal "
+                        "defaults to f64")
+            elif dtype is None:
+                self._flag(node, f"dtype-less np.{attr}(...)",
+                           "name the dtype — np." + attr
+                           + " defaults to float64")
+        self.generic_visit(node)
+
+
+@rule("PT020", "float64 drift into device-adjacent numpy",
+      applies=_in_hot_dir)
+def check_pt020(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _Pt020Walker(ctx, findings).visit(ctx.tree)
+    return findings
