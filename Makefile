@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench spec-bench scale-bench collectives-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench disagg-bench scale-bench collectives-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -33,6 +33,17 @@ serve-bench:
 # serve-bench tail.
 spec-bench:
 	JAX_PLATFORMS=cpu python bench.py --spec
+
+# Disaggregated-serving microbench (docs/OPERATIONS.md
+# "Disaggregated serving"): the same mixed long-prompt/short-decode
+# load through an interleaved fleet vs a prefill+decode split with
+# KV-block migration — the JSON tail carries disagg_ttft_p99_ms vs
+# interleaved_ttft_p99_ms (prefill isolation must win),
+# migrate_ms_per_block (q8 wire) and migrate_dedup_ratio (chain-hash
+# manifest on a shared-prefix family) — the ISSUE 16 acceptance
+# numbers.
+disagg-bench:
+	JAX_PLATFORMS=cpu python bench.py --disagg
 
 # Elastic-reconciler microbench (docs/OPERATIONS.md "Elastic
 # serving"): a reconciler-managed fleet behind the gateway — the JSON

@@ -1144,6 +1144,231 @@ def spec_main() -> None:
     })
 
 
+def _disagg_probe() -> dict:
+    """Disaggregated-serving host probe (ISSUE 16 acceptance
+    numbers): the SAME mixed load — a long-prompt TTFT stream under
+    continuous short-prompt decode traffic — driven through (a) an
+    interleaved fleet (two unified replicas, every engine co-batching
+    chunked prefills with decode steps) and (b) a disaggregated fleet
+    (one prefill-class + one decode-class replica, the gateway's
+    two-stage router migrating KV blocks over the wire). Tail fields:
+
+    - ``disagg_ttft_p99_ms`` vs ``interleaved_ttft_p99_ms``: p99
+      client-observed time-to-first-token of the long-prompt stream
+      (``max_new=1`` — the wall IS the TTFT), measured while the
+      decode load runs. The bar: disagg beats interleaved, because
+      the prefill replica never waits on a co-batched decode step;
+    - ``disagg_greedy_identical``: gateway-routed disagg tokens are
+      bit-equal to solo decode over the exact wire (the zero
+      token-level-divergence acceptance check);
+    - ``migrate_ms_per_block`` / ``migrate_dedup_ratio``: the q8
+      wire's per-block transfer cost and the chain-hash manifest's
+      dedup rate on a shared-prefix request family (first request
+      ships every block, siblings ship only their tails).
+    """
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.serve_engine import PagedGeneratorActor
+
+    PREFIX, TAIL, BT, CHUNK = 224, 4, 16, 32
+    N_TTFT, N_DECODE_THREADS, SHORT_MAX_NEW = 12, 2, 24
+    # Big enough that prefill COMPUTE dominates dispatch on CPU (the
+    # same sizing argument as the paged probe above).
+    cfg = tfm.preset("tiny", d_model=256, n_layers=4, d_ff=512,
+                     max_seq=256, dtype=jnp.float32)
+    rng = np.random.default_rng(16)
+    params_box = [None]
+
+    def mk(n):
+        return jnp.asarray(
+            rng.integers(1, cfg.vocab_size, n).astype(np.int32))[None]
+
+    def mk_tailed(shared):
+        tail = rng.integers(1, cfg.vocab_size, TAIL)
+        return jnp.asarray(
+            np.concatenate([shared, tail]).astype(np.int32))[None]
+
+    def mig_segment(pre, dec):
+        """Direct protocol drive on a shared-prefix family: q8 wire
+        cost per shipped block + the manifest's dedup ratio."""
+        shared = rng.integers(1, cfg.vocab_size, PREFIX)
+        need_tot = res_tot = shipped = 0
+        ship_ms = 0.0
+        for _ in range(4):
+            p = mk_tailed(shared)
+            rep = pre.Prefill(p, 8)
+            plan = dec.MigratePlan(p, 8)
+            need_tot += len(plan["need"])
+            res_tot += int(plan["resident"])
+            t0 = time.perf_counter()
+            wire = pre.ExportBlocks(rep["export_id"], plan["need"],
+                                    "q8")
+            dec.ImportBlocks(plan["ticket"], wire)
+            ship_ms += (time.perf_counter() - t0) * 1e3
+            shipped += len(plan["need"]) + 1  # tail always ships
+            pre.ReleaseExport(rep["export_id"])
+            dec.MigrateDecode(plan["ticket"], rep["first_token"])
+        return {
+            "migrate_ms_per_block": round(ship_ms / shipped, 3),
+            "migrate_dedup_ratio":
+                round(res_tot / (need_tot + res_tot), 3),
+        }
+
+    def run_pass(classes, disagg):
+        state = CoordState(sweep_interval=0.1)
+        registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+        engines, servers, regs = [], [], []
+        for i, scls in enumerate(classes):
+            a = PagedGeneratorActor(
+                cfg, params=params_box[0], n_slots=4,
+                block_tokens=BT, prefill_chunk=CHUNK,
+                serve_class=scls)
+            if params_box[0] is None:
+                params_box[0] = a.params
+            s = ActorServer("127.0.0.1", 0)
+            s.register(a, "Generator")
+            s.serve()
+            regs.append(registry.register("llm-disagg", f"r{i}",
+                                          "127.0.0.1", s.port))
+            engines.append(a)
+            servers.append(s)
+        gw = None
+        stop = threading.Event()
+        errs = []
+        try:
+            # Warm every compile bucket OFF the clock: prefill
+            # chunks, decode steps, and (disagg) the pack/unpack
+            # programs via one direct migration.
+            for a in engines:
+                np.asarray(a.Generate(mk(PREFIX + TAIL), 1))
+                np.asarray(a.Generate(mk(8), SHORT_MAX_NEW))
+            if disagg:
+                pre, dec = engines
+                rep = pre.Prefill(mk(PREFIX + TAIL), 8)
+                plan = dec.MigratePlan(mk(PREFIX + TAIL), 8)
+                wire = pre.ExportBlocks(rep["export_id"],
+                                        plan["need"], "q8")
+                dec.ImportBlocks(plan["ticket"], wire)
+                pre.ReleaseExport(rep["export_id"])
+                dec.MigrateDecode(plan["ticket"], rep["first_token"])
+            gw = InferenceGateway(
+                registry, "llm-disagg",
+                GatewayConfig(probe_interval_s=0.2,
+                              probe_timeout_s=2.0,
+                              default_deadline_s=120.0,
+                              max_queue_depth=64, disagg=disagg,
+                              kv_wire="exact"))
+            want = set(classes)
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and not want <= {r.serve_class()
+                                    for r in gw.pool.healthy()}):
+                time.sleep(0.05)
+
+            def decode_load():
+                p = mk(8)
+                while not stop.is_set():
+                    try:
+                        np.asarray(gw.generate(p, SHORT_MAX_NEW))
+                    except Exception as e:  # noqa: BLE001
+                        if not stop.is_set():
+                            errs.append(e)
+                        return
+
+            threads = [threading.Thread(target=decode_load,
+                                        daemon=True)
+                       for _ in range(N_DECODE_THREADS)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # decode streams reach steady state
+            walls = []
+            for _ in range(N_TTFT):
+                p = mk(PREFIX + TAIL)  # unique: every prefill cold
+                t0 = time.perf_counter()
+                np.asarray(gw.generate(p, 1))
+                walls.append((time.perf_counter() - t0) * 1e3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            if errs:
+                raise errs[0]
+            extra = {}
+            if disagg:
+                pre, dec = engines
+                pv = mk(PREFIX + TAIL)
+                ref = np.asarray(pre.Generate(pv, 8))
+                out = np.asarray(gw.generate(pv, 8))
+                extra["greedy_identical"] = bool((out == ref).all())
+                extra.update(mig_segment(pre, dec))
+            return {"ttft_ms": walls, **extra}
+        finally:
+            stop.set()
+            if gw is not None:
+                gw.close()
+            for r in regs:
+                r.close()
+            for s in servers:
+                s.close()
+            for a in engines:
+                a.close()
+            state.close()
+
+    inter = run_pass(("unified", "unified"), disagg=False)
+    dis = run_pass(("prefill", "decode"), disagg=True)
+    i99 = float(np.percentile(inter["ttft_ms"], 99))
+    d99 = float(np.percentile(dis["ttft_ms"], 99))
+    return {
+        "disagg_ttft_p99_ms": round(d99, 2),
+        "interleaved_ttft_p99_ms": round(i99, 2),
+        "disagg_ttft_p50_ms":
+            round(float(np.percentile(dis["ttft_ms"], 50)), 2),
+        "interleaved_ttft_p50_ms":
+            round(float(np.percentile(inter["ttft_ms"], 50)), 2),
+        "disagg_ttft_speedup":
+            round(i99 / d99, 2) if d99 > 0 else None,
+        "disagg_beats_interleaved": d99 < i99,
+        "disagg_greedy_identical": dis["greedy_identical"],
+        "migrate_ms_per_block": dis["migrate_ms_per_block"],
+        "migrate_dedup_ratio": dis["migrate_dedup_ratio"],
+        "migrate_wire": "q8",
+        "notes": (
+            f"disagg probe: {N_TTFT} cold {PREFIX}+{TAIL}-token "
+            f"prefills (max_new=1, wall = TTFT) under "
+            f"{N_DECODE_THREADS} continuous short-prompt decode "
+            f"streams ({SHORT_MAX_NEW} tokens each), 2 replicas "
+            f"(d_model=256/L4), {CHUNK}-token chunked admission; "
+            f"interleaved = two unified replicas, disagg = "
+            f"prefill+decode classes with KV migration; dedup/cost "
+            f"segment: 4 shared-prefix requests over the q8 wire "
+            f"(first ships every block, siblings only tails)"),
+    }
+
+
+def disagg_main() -> None:
+    """``make disagg-bench``: the ISSUE 16 disaggregated-serving
+    numbers — prefill-isolation TTFT vs the interleaved fleet, the
+    q8 wire's per-block cost, and the manifest dedup ratio."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rec = _disagg_probe()
+    _emit({"probe": "serve_disagg", **rec})
+    _emit({
+        "metric": "disaggregated prefill TTFT p99 under decode load "
+                  "(cpu host, 2 replicas)",
+        "value": rec["disagg_ttft_p99_ms"],
+        "unit": "ms vs interleaved fleet",
+        **rec,
+    })
+
+
 def serve_main() -> None:
     """``make serve-bench``: tail latency THROUGH the inference
     gateway on the host (CPU, tiny preset), against the failure mode
@@ -1487,6 +1712,9 @@ def main() -> None:
         return
     if "--spec" in sys.argv:
         spec_main()
+        return
+    if "--disagg" in sys.argv:
+        disagg_main()
         return
     if "--collectives" in sys.argv:
         collectives_main()

@@ -35,6 +35,14 @@ site                    actions
                         iteration falls back to the plain decode step:
                         correct tokens, just slower) / ``delay`` (stall
                         the draft forward) (serve_engine)
+``serve.migrate``       ``drop`` (abort the KV-block transfer outright) /
+                        ``delay`` (stall it mid-flight) / ``truncate``
+                        (ship a wire missing blocks — the decode side
+                        detects the short manifest and refuses it). All
+                        three land on the same recovery: the request
+                        falls back to local prefill on the decode
+                        replica — correct tokens, never lost
+                        (gateway/frontdoor `_dispatch_disagg`)
 ``scale.spawn``         ``fail`` (the replica process/host dies before it
                         comes up — the reconciler retries next tick) /
                         ``delay`` (slow spawn) (reconciler/replica.py)

@@ -7,6 +7,7 @@ docs/OPERATIONS.md "Serving at scale" for the runbook, and
 
 from ptype_tpu.errors import ShedError
 from ptype_tpu.gateway.admission import AdmissionQueue
+from ptype_tpu.gateway.directory import PrefixDirectory
 from ptype_tpu.gateway.frontdoor import (GatewayActor, GatewayConfig,
                                          InferenceGateway,
                                          least_loaded_picker)
@@ -18,6 +19,7 @@ __all__ = [
     "GatewayActor",
     "GatewayConfig",
     "InferenceGateway",
+    "PrefixDirectory",
     "Replica",
     "ReplicaPool",
     "ScaleHint",

@@ -42,8 +42,9 @@ from ptype_tpu import chaos, logs, metrics as metrics_mod, retry, trace
 from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
                               ShedError)
 from ptype_tpu.gateway.admission import AdmissionQueue
+from ptype_tpu.gateway.directory import PrefixDirectory
 from ptype_tpu.gateway.pool import ReplicaPool
-from ptype_tpu.gateway.slo import SLOTracker
+from ptype_tpu.gateway.slo import ScaleHint, SLOTracker
 from ptype_tpu.registry import Registry
 
 log = logs.get_logger("gateway")
@@ -90,6 +91,24 @@ class GatewayConfig:
     slo_ttft_p99_ms: float | None = None
     #: Rolling window for shed-rate / tokens-per-sec readouts.
     stats_window_s: float = 30.0
+    #: Disaggregated serving (ISSUE 16): route single-row generates
+    #: through the two-stage prefill→decode path — prefill-class pick
+    #: fills the KV blocks, a decode-class pick (steered by the
+    #: prefix directory) imports them over the quantized wire and
+    #: owns the decode lifetime. Any migration failure falls back to
+    #: plain Generate on the decode replica (local prefill): slower,
+    #: never lost.
+    disagg: bool = False
+    #: KV wire encoding for migrations: ``q8`` (int8 + error-feedback
+    #: residuals, ~4x less wire) or ``exact`` (raw dtype — the
+    #: bit-exactness escape hatch parity tests pin against).
+    kv_wire: str = "q8"
+    #: Per-replica entry bound in the global prefix directory.
+    directory_blocks: int = 4096
+    #: Optional decode-side TPOT p99 target (ms) feeding the
+    #: decode-class scale hint (prefill scales on queue/TTFT, decode
+    #: on KV headroom and inter-token tail).
+    slo_tpot_p99_ms: float | None = None
 
 
 def _count_generated(result, stop_token: int) -> int:
@@ -136,6 +155,10 @@ class InferenceGateway:
             self.cfg.max_queue_depth,
             capacity=self._capacity,
             est_service_s=self.slo.est_service_s)
+        #: Fleet-wide KV residency index (ISSUE 16): chain hash →
+        #: holders, content-verified; steers the decode pick so shared
+        #: prefixes migrate once and dedup after.
+        self.directory = PrefixDirectory(self.cfg.directory_blocks)
         self._closed = False
 
     # ----------------------------------------------------------- capacity
@@ -167,7 +190,17 @@ class InferenceGateway:
         overloaded or out of deadline; :class:`RemoteError` when the
         replica's handler itself failed. Transport failures re-route to
         surviving replicas inside the deadline.
+
+        With ``cfg.disagg`` set, eligible requests (single row, no
+        kwargs the migration endpoints don't carry) take the two-stage
+        prefill→migrate→decode path instead; everything else keeps the
+        interleaved path unchanged.
         """
+        if self.cfg.disagg and self._disagg_eligible(prompt,
+                                                     gen_kwargs):
+            return self._generate_disagg(
+                prompt, int(max_new_tokens), deadline_s=deadline_s,
+                affinity_key=affinity_key, **gen_kwargs)
         args = (prompt, int(max_new_tokens))
         stop_token = int(gen_kwargs.get("stop_token", -1))
         if gen_kwargs:
@@ -332,6 +365,382 @@ class InferenceGateway:
             f"request not served within its deadline "
             f"(last error: {last_err})",
             retry_after_s=self.slo.est_service_s())
+
+    # ---------------------------------------- disaggregated (ISSUE 16)
+
+    #: Generate kwargs the migration endpoints carry; the rest
+    #: (pad_token, repetition_penalty) force the interleaved path
+    #: unless left at their defaults.
+    _DISAGG_KW = frozenset(("temperature", "seed", "top_k", "top_p",
+                            "stop_token"))
+    _DISAGG_KW_DEFAULTS = {"pad_token": 0, "repetition_penalty": 1.0}
+
+    def _disagg_eligible(self, prompt, gen_kwargs) -> bool:
+        """Single-row requests with migration-expressible kwargs ride
+        the disaggregated path; everything else stays interleaved."""
+        for k, v in gen_kwargs.items():
+            if k in self._DISAGG_KW:
+                continue
+            if (k in self._DISAGG_KW_DEFAULTS
+                    and v == self._DISAGG_KW_DEFAULTS[k]):
+                continue
+            return False
+        try:
+            arr = np.asarray(prompt)
+        except Exception:  # noqa: BLE001 — let generate() raise it
+            return False
+        return arr.ndim == 2 and arr.shape[0] == 1
+
+    def _mig_method(self, name: str) -> str:
+        """Migration endpoint beside ``generate_method`` (same actor:
+        ``Generator.Generate`` → ``Generator.<name>``)."""
+        prefix = self.cfg.generate_method.rsplit(".", 1)[0]
+        return f"{prefix}.{name}"
+
+    def _rcall(self, r, method: str, args, deadline: float):
+        """One TARGETED dispatch (no re-route — migration legs name
+        their replica), with the same pool accounting and failure
+        taxonomy as :meth:`_dispatch`: replica sheds and handler
+        errors leave the replica healthy, transport failures feed
+        eviction."""
+        conn = r.conn
+        if conn is None or not conn.healthy:
+            raise RPCError(f"replica {r.key} not connected")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise ShedError(
+                f"out of deadline before {method!r} on {r.key}",
+                retry_after_s=self.slo.est_service_s())
+        self.pool.begin(r)
+        t0 = time.perf_counter()
+        fut = None
+        try:
+            with trace.span("rpc.call", method=method, replica=r.key):
+                fut = conn.call_async(method, args)
+                result = fut.result(timeout=remaining)
+        except ShedError:
+            self.pool.done(r, None, ok=True)
+            raise
+        except RemoteError:
+            self.pool.done(r, (time.perf_counter() - t0) * 1000.0,
+                           ok=True)
+            raise
+        except FuturesTimeoutError:
+            conn.forget(fut)
+            self.pool.fail(r, f"{method} exceeded deadline in flight")
+            raise RPCError(
+                f"call {method!r} exceeded its deadline on {r.key}")
+        except Exception as e:  # noqa: BLE001 — transport failure
+            if fut is not None:
+                conn.forget(fut)
+            self.pool.fail(r, str(e))
+            raise
+        self.pool.done(r, (time.perf_counter() - t0) * 1000.0, ok=True)
+        chaos.note_ok("rpc.call", method)
+        return result
+
+    def _generate_disagg(self, prompt, max_new: int, *,
+                         deadline_s: float | None = None,
+                         affinity_key: str | None = None,
+                         **gen_kwargs):
+        """The two-stage serving call: admit once, then prefill-pick →
+        ``Prefill`` → decode-pick (prefix-directory-steered) →
+        ``MigratePlan``/``ExportBlocks``/``ImportBlocks``/
+        ``MigrateDecode``. Output is shaped and padded exactly like
+        :meth:`generate`'s interleaved path."""
+        gen = {"temperature": 0.0, "seed": 0, "top_k": 0,
+               "top_p": 1.0, "stop_token": -1}
+        gen.update({k: v for k, v in gen_kwargs.items() if k in gen})
+        deadline = time.monotonic() + (deadline_s
+                                       if deadline_s is not None
+                                       else self.cfg.default_deadline_s)
+        with trace.span("gateway.request", service=self.service,
+                        method="disagg"):
+            self.slo.arrived()
+            try:
+                with trace.span("gateway.admit"):
+                    self.admission.admit(
+                        key=affinity_key or "disagg",
+                        deadline=deadline)
+            except ShedError:
+                self.slo.shed()
+                self._export_gauges()
+                trace.maybe_dump(f"shed at admission ({self.service})")
+                raise
+            try:
+                return self._dispatch_disagg(prompt, int(max_new),
+                                             gen, deadline,
+                                             affinity_key)
+            finally:
+                self.admission.release()
+                self._export_gauges()
+
+    def _dispatch_disagg(self, prompt, max_new, gen, deadline,
+                         affinity_key):
+        t0 = time.perf_counter()
+        stop_token = int(gen["stop_token"])
+        counter = lambda out: _count_generated(out, stop_token)  # noqa: E731
+        gen_args = (prompt, max_new, gen["temperature"], gen["seed"],
+                    gen["top_k"], gen["top_p"], gen["stop_token"])
+        mig_args = gen_args
+        # ---- stage 1: prefill-class pick + Prefill
+        pre = self.pool.pick(affinity_key, serve_class="prefill")
+        if pre is None or pre.conn is None or not pre.conn.healthy:
+            return self._dispatch(self.cfg.generate_method, gen_args,
+                                  deadline, affinity_key, counter)
+        try:
+            with trace.span("gateway.prefill", replica=pre.key):
+                rep = self._rcall(pre, self._mig_method("Prefill"),
+                                  (prompt, 1, gen["temperature"],
+                                   gen["seed"], gen["top_k"],
+                                   gen["top_p"], gen["stop_token"]),
+                                  deadline)
+        except Exception as e:  # noqa: BLE001 — shed, handler error,
+            # or transport alike: Prefill never started owning
+            # state, so a plain re-routed dispatch IS the recovery
+            # (it accounts itself).
+            log.info("disagg prefill failed; interleaved fallback",
+                     kv={"replica": pre.key, "err": repr(e)[:200]})
+            return self._dispatch(self.cfg.generate_method, gen_args,
+                                  deadline, affinity_key, counter)
+        export_id = rep["export_id"]
+        first = int(rep["first_token"])
+        bt = int(rep["block_tokens"])
+        hashes = [int(h) for h in rep["hashes"]]
+        toks = np.asarray(prompt)[0]
+        contents = [tuple(int(t) for t in toks[i * bt:(i + 1) * bt])
+                    for i in range(len(hashes))]
+        if max_new <= 1 or (stop_token >= 0 and first == stop_token):
+            # Decode budget spent inside prefill: no migration leg.
+            self._release_export(pre, export_id)
+            self.directory.publish(pre.key, zip(hashes, contents))
+            out = np.zeros((1, max_new), np.int32)
+            out[0, 0] = first
+            self.slo.answered((time.perf_counter() - t0) * 1000.0,
+                              counter(out))
+            return out
+        # ---- stage 2: decode-class pick, steered by the directory
+        dec = self._pick_decode(pre, hashes, contents)
+        if dec is None:
+            # One-replica fleet (or nothing else healthy): nowhere to
+            # migrate — finish where the blocks already live.
+            self._release_export(pre, export_id)
+            return self._disagg_fallback(pre, gen_args, deadline,
+                                         counter, t0)
+        ticket = None
+        truncate = False
+        try:
+            # The migration chaos seam: drop kills the transfer
+            # outright, delay stalls it mid-flight, truncate ships a
+            # wire missing blocks (the decode side detects and
+            # refuses it) — every action lands on the fallback path:
+            # local prefill on the decode replica, correct tokens,
+            # never lost.
+            f = chaos.hit("serve.migrate", dec.key)
+            if f is not None:
+                if f.action == "drop":
+                    raise RPCError("chaos: serve.migrate drop")
+                if f.action == "delay":
+                    f.sleep()
+                elif f.action == "truncate":
+                    truncate = True
+            with trace.span("gateway.migrate", prefill=pre.key,
+                            decode=dec.key) as msp:
+                plan = self._rcall(dec,
+                                   self._mig_method("MigratePlan"),
+                                   mig_args, deadline)
+                ticket = plan["ticket"]
+                wire = self._rcall(
+                    pre, self._mig_method("ExportBlocks"),
+                    (export_id, plan["need"], self.cfg.kv_wire),
+                    deadline)
+                if truncate and wire.get("blocks"):
+                    wire = dict(wire)
+                    wire["blocks"] = wire["blocks"][:-1]
+                imp = self._rcall(dec,
+                                  self._mig_method("ImportBlocks"),
+                                  (ticket, wire), deadline)
+                msp.set_attr("blocks", len(wire.get("blocks", ())))
+                msp.set_attr("bytes", int(imp.get("nbytes", 0)))
+                msp.set_attr("resident", int(plan.get("resident", 0)))
+            self._release_export(pre, export_id)
+            export_id = None
+            tokens = self._rcall(dec,
+                                 self._mig_method("MigrateDecode"),
+                                 (ticket, first), deadline)
+            ticket = None
+        except ShedError:
+            # The decode replica refused the plan typed (KV pool
+            # exhausted / draining): nothing migrated, nothing owed —
+            # unwind and re-route like any replica shed.
+            if ticket is not None:
+                self._abort_migration(dec, ticket)
+            if export_id is not None:
+                self._release_export(pre, export_id)
+            trace.add_event("gateway.migrate_shed", decode=dec.key)
+            return self._dispatch(self.cfg.generate_method, gen_args,
+                                  deadline, affinity_key, counter)
+        except Exception as e:  # noqa: BLE001 — any mid-transfer
+            # failure (chaos drop/truncate, transport, handler): the
+            # request falls back to LOCAL prefill on the decode
+            # replica. Unwind first — the abort releases the decode
+            # side's reservation so the fallback's own admission has
+            # the blocks the plan was holding.
+            log.info("migration failed; local-prefill fallback",
+                     kv={"prefill": pre.key, "decode": dec.key,
+                         "err": repr(e)[:200]})
+            trace.add_event("gateway.migrate_failed",
+                            decode=dec.key, err=str(e)[:200])
+            if ticket is not None:
+                self._abort_migration(dec, ticket)
+            if export_id is not None:
+                self._release_export(pre, export_id)
+            out = self._disagg_fallback(dec, gen_args, deadline,
+                                        counter, t0)
+            # The decode replica prefilled locally: it now holds the
+            # prompt's sealed blocks — publish them, and pair the
+            # injected fault (the request completed; the seam
+            # recovered by falling back).
+            self.directory.publish(dec.key, zip(hashes, contents))
+            chaos.note_ok("serve.migrate", dec.key)
+            return out
+        # ---- success: account, publish, pair the seam
+        out = np.zeros((1, max_new), np.int32)
+        emitted = [int(t) for t in tokens][:max_new]
+        out[0, :len(emitted)] = emitted
+        self.directory.publish(dec.key, zip(hashes, contents))
+        self.slo.answered((time.perf_counter() - t0) * 1000.0,
+                          counter(out))
+        chaos.note_ok("serve.migrate", dec.key)
+        chaos.note_ok("gateway.call", dec.key)
+        return out
+
+    def _pick_decode(self, pre, hashes, contents):
+        """The decode pick: healthy decode-class replicas (minus the
+        prefill pick), scored by content-verified directory overlap
+        first (blocks NOT shipped), load second. Eviction counters
+        are folded in before the directory is trusted — a replica
+        whose pool churned drops its entries here, not after a
+        mis-route."""
+        cands = [r for r in self.pool.healthy_class("decode")
+                 if r.key != pre.key
+                 and r.conn is not None and r.conn.healthy
+                 and r.lifecycle() != "draining"]
+        if not cands:
+            return None
+        for r in cands:
+            self.directory.note_evictions(r.key, r.kv_evictions())
+        best, best_ov = None, -1
+        for r in sorted(cands, key=lambda r: (r.score(), r.key)):
+            ov = self.directory.overlap(r.key, hashes, contents)
+            if ov > best_ov:
+                best, best_ov = r, ov
+        return best
+
+    def _disagg_fallback(self, dec, gen_args, deadline, counter, t0):
+        """Local prefill on the decode replica — the migration
+        failure path. The replica re-prefills from the prompt (its
+        prefix cache may still shortcut it) and owns the decode; only
+        if IT fails too does the request re-enter the general
+        re-routed dispatch."""
+        if dec is not None and dec.conn is not None \
+                and dec.conn.healthy:
+            try:
+                out = self._rcall(dec, self.cfg.generate_method,
+                                  gen_args, deadline)
+                self.slo.answered(
+                    (time.perf_counter() - t0) * 1000.0,
+                    counter(out))
+                return out
+            except Exception as e:  # noqa: BLE001 — fall through to
+                # the re-routed dispatch, which sheds typed if no one
+                # can serve.
+                log.info("decode-replica fallback failed; re-routing",
+                         kv={"replica": dec.key,
+                             "err": repr(e)[:200]})
+        return self._dispatch(self.cfg.generate_method, gen_args,
+                              deadline, None, counter)
+
+    def _release_export(self, pre, export_id) -> None:
+        """Best-effort: free the prefill side's parked blocks (they
+        re-enter its LRU, still content-addressed for local reuse)."""
+        try:
+            self._rcall(pre, self._mig_method("ReleaseExport"),
+                        (export_id,),
+                        time.monotonic() + self.cfg.probe_timeout_s)
+        except Exception:  # noqa: BLE001 — the engine's drained()
+            # gate and Info() surface any leak; a failed release must
+            # not fail the request.
+            pass
+
+    def _abort_migration(self, dec, ticket) -> None:
+        """Best-effort: unwind the decode side's plan (derefs +
+        reservation release + ledger retire as ``cancelled``)."""
+        try:
+            self._rcall(dec, self._mig_method("AbortMigration"),
+                        (ticket,),
+                        time.monotonic() + self.cfg.probe_timeout_s)
+        except Exception:  # noqa: BLE001 — same contract as release
+            pass
+
+    def class_hint(self, serve_class: str) -> ScaleHint:
+        """Per-class autoscale signal for a disaggregated fleet: the
+        prefill pool scales on queue depth and the TTFT tail (prompt
+        bursts), the decode pool on KV-block headroom and the TPOT
+        tail (long decodes). Run one reconciler per class with
+        ``hints=lambda: gw.class_hint("prefill")`` etc.; the combined
+        :meth:`scale_hint` stays the unified-fleet signal."""
+        reps = [r for r in self.pool.healthy()
+                if r.serve_class() == serve_class]
+        n = len(reps)
+        queue = self.admission.depth
+        inflight = sum(r.inflight for r in reps)
+        signals = {"serve_class": serve_class, "n_replicas": n,
+                   "queue_depth": queue, "inflight": inflight}
+        if serve_class == "prefill":
+            ttft = self.slo.h_ttft.percentile(99)
+            signals["ttft_p99_ms"] = round(ttft, 2)
+            if (self.cfg.max_queue_depth
+                    and queue >= self.cfg.max_queue_depth // 2):
+                return ScaleHint(1, "prefill queue above half depth",
+                                 signals)
+            if (self.cfg.slo_ttft_p99_ms is not None
+                    and self.slo.h_ttft.count >= 20
+                    and ttft > self.cfg.slo_ttft_p99_ms):
+                return ScaleHint(
+                    1, f"ttft p99 {ttft:.0f}ms over SLO "
+                       f"{self.cfg.slo_ttft_p99_ms:.0f}ms", signals)
+            if n > 1 and queue == 0 and inflight == 0:
+                return ScaleHint(-1, "prefill pool idle", signals)
+            return ScaleHint(0, "steady", signals)
+        if serve_class == "decode":
+            frees = [v for v in (r.kv_free_blocks() for r in reps)
+                     if v is not None]
+            signals["min_kv_free_blocks"] = (min(frees) if frees
+                                             else None)
+            tpots = [v for v in
+                     (r.reported_float("tpot_p99_ms") for r in reps)
+                     if v is not None]
+            signals["tpot_p99_ms"] = (round(max(tpots), 2) if tpots
+                                      else None)
+            if frees and min(frees) == 0:
+                return ScaleHint(1, "decode kv pool exhausted",
+                                 signals)
+            if (self.cfg.slo_tpot_p99_ms is not None and tpots
+                    and max(tpots) > self.cfg.slo_tpot_p99_ms):
+                return ScaleHint(
+                    1, f"tpot p99 {max(tpots):.0f}ms over SLO "
+                       f"{self.cfg.slo_tpot_p99_ms:.0f}ms", signals)
+            if n > 1 and inflight == 0 and queue == 0:
+                return ScaleHint(-1, "decode pool idle", signals)
+            return ScaleHint(0, "steady", signals)
+        return ScaleHint(0, f"unknown class {serve_class!r}", signals)
+
+    def disagg_hints(self) -> dict:
+        """Both per-class hints at once (``GatewayActor.Info`` /
+        operator surface)."""
+        return {cls: self.class_hint(cls)
+                for cls in ("prefill", "decode")}
 
     # --------------------------------------------------------- inspection
 

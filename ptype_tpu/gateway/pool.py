@@ -123,6 +123,35 @@ class Replica:
             v = self.reported.get("lifecycle")
             return None if v is None else str(v)
 
+    def serve_class(self) -> str | None:
+        """Replica-reported serving class (ISSUE 16: ``prefill`` /
+        ``decode`` / ``unified``). None until a probe observes it —
+        the two-stage router treats an unclassed replica as unified
+        (it serves every endpoint, classes are advisory)."""
+        with self.lock:
+            v = self.reported.get("serve_class")
+            return None if v is None else str(v)
+
+    def kv_evictions(self) -> int | None:
+        """Replica-reported cumulative LRU eviction count
+        (``kv_evictions`` in ``BlockPool.stats``): the prefix
+        directory's coherence signal — any movement drops the
+        replica's directory entries before the router trusts them."""
+        with self.lock:
+            v = self.reported.get("kv_evictions")
+            return None if v is None else int(v)
+
+    def reported_float(self, key: str) -> float | None:
+        """One probe-reported numeric field, or None (absent replica
+        surface / malformed value) — the per-class scale hints read
+        ledger tails (``tpot_p99_ms``) through this."""
+        with self.lock:
+            v = self.reported.get(key)
+        try:
+            return None if v is None else float(v)
+        except (TypeError, ValueError):
+            return None
+
     def snapshot(self) -> dict:
         with self.lock:
             snap = {"key": self.key, "up": self.up,
@@ -141,6 +170,15 @@ class Replica:
             # bare actor with no lifecycle story stays distinguishable.
             if "lifecycle" in self.reported:
                 snap["lifecycle"] = str(self.reported["lifecycle"])
+            # Serving class + migration counters (ISSUE 16): the
+            # disaggregated-fleet view — only when reported, same as
+            # lifecycle, so pre-disagg replicas render "-".
+            if "serve_class" in self.reported:
+                snap["serve_class"] = str(self.reported["serve_class"])
+            for k in ("migrations", "migrate_bytes",
+                      "migrate_dedup_hits", "migrate_inflight"):
+                if k in self.reported:
+                    snap[k] = int(self.reported[k] or 0)
             # Paged-engine load signal (ISSUE 9): pool headroom and
             # prefix-cache effectiveness, when the replica reports it.
             if "kv_free_blocks" in self.reported:
@@ -393,16 +431,33 @@ class ReplicaPool:
     def n_healthy(self) -> int:
         return len(self.healthy())
 
+    def healthy_class(self, serve_class: str) -> list[Replica]:
+        """Healthy replicas of one serving class (ISSUE 16). Falls
+        back to ALL healthy replicas when none report the class —
+        classes are advisory (every engine serves every endpoint), so
+        a unified fleet keeps serving when the operator asks for a
+        class it never deployed."""
+        reps = self.healthy()
+        cls = [r for r in reps if r.serve_class() == serve_class]
+        return cls or reps
+
     def pick(self, affinity_key: str | None = None,
-             exclude=()) -> Replica | None:
+             exclude=(),
+             serve_class: str | None = None) -> Replica | None:
         """Route one request: affinity first (when sane), else least
         loaded. None when the fleet has no healthy replica.
 
         ``exclude`` (replica keys) steers a RE-route away from
         replicas that already failed this request — when every healthy
         replica has failed it, exclusion lapses (retrying someone
-        beats shedding with survivors idle)."""
-        candidates = self.healthy()
+        beats shedding with survivors idle).
+
+        ``serve_class`` (ISSUE 16) narrows to one serving class —
+        softly, via :meth:`healthy_class`: the two-stage router's
+        prefill/decode picks, degrading to the whole fleet when no
+        replica reports the class."""
+        candidates = (self.healthy() if serve_class is None
+                      else self.healthy_class(serve_class))
         if not candidates:
             return None
         if exclude:

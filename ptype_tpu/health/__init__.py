@@ -25,6 +25,7 @@ from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
                                     ClusterView, CoordFlapRule,
                                     KvPressureRule, LossRule,
                                     MemoryGrowthRule, MfuGapRule,
+                                    MigrationStallRule,
                                     P99Rule, PrefixHitCollapseRule,
                                     RecompileStormRule, Rule,
                                     ServeStallRule, StallRule,
@@ -49,7 +50,7 @@ __all__ = [
     "P99Rule", "StallRule", "StragglerRule", "LossRule",
     "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "TtftRule",
     "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
-    "RecompileStormRule", "default_rules",
+    "RecompileStormRule", "MigrationStallRule", "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
     "render_scale", "run_scale", "render_jit", "run_jit",
 ]

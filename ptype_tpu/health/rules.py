@@ -739,6 +739,53 @@ class RecompileStormRule(Rule):
         return out
 
 
+class MigrationStallRule(Rule):
+    """A disaggregated migration wedged mid-transfer: a replica's
+    ``serve.migrate_inflight`` gauge stayed above zero for the whole
+    window while its ``serve.migrations`` completion counter did not
+    advance — an export ticket parked on a prefill replica or an
+    import reservation pinned on a decode replica whose gateway leg
+    died without the abort landing. Pinned blocks are pool capacity
+    the admission gate can't hand out, so a stall quietly becomes
+    KV-pressure sheds on a fleet that looks idle. Structural: the
+    gauge only exists on migration-armed engines (ISSUE 16), so a
+    unified fleet never pays a false page. Start at ``obs serve`` —
+    the migration counters and per-replica class column name the
+    wedged side."""
+
+    name = "migration-stall"
+    severity = "page"
+
+    def __init__(self, window_s: float = 60.0,
+                 inflight_series: str = "serve.migrate_inflight",
+                 done_series: str = "serve.migrations"):
+        self.window_s = float(window_s)
+        self.inflight_series = inflight_series
+        self.done_series = done_series
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = [p for p in view.series(node, self.inflight_series)
+                   if p[0] >= view.now - self.window_s]
+            if len(pts) < 2 or min(v for _, v in pts) <= 0:
+                continue  # empty, briefly sampled, or drained mid-window
+            done = counter_delta(
+                view.series(node, self.done_series),
+                self.window_s, view.now)
+            if done > 0:
+                continue  # migrations ARE completing; just busy
+            out.append(self._alert(
+                node,
+                f"{pts[-1][1]:.0f} migration(s) in flight for "
+                f"{self.window_s:.0f}s with none completing — a "
+                f"parked export or pinned import reservation is "
+                f"holding KV blocks; read `obs serve` first (class "
+                f"column + migration counters name the wedged side)",
+                value=pts[-1][1], threshold=0.0))
+        return out
+
+
 def default_rules(service: str = "llm",
                   slo_p99_ms: float | None = None,
                   slo_ttft_ms: float | None = None) -> list[Rule]:
@@ -748,7 +795,7 @@ def default_rules(service: str = "llm",
     page is opt-in (a healthy prompt-heavy fleet over an arbitrary
     default would page, and auto-capture profiles, out of the box).
     The structural serving rules (kv-pressure / prefix-hit-collapse /
-    serve-stall) are always in the set — they key on ``serve.*`` /
+    serve-stall / migration-stall) are always in the set — they key on ``serve.*`` /
     ``kv.*`` series only a serving replica emits and need no target,
     so a training fleet never pays a false page for their presence."""
     rules: list[Rule] = [
@@ -763,6 +810,7 @@ def default_rules(service: str = "llm",
         PrefixHitCollapseRule(),
         ServeStallRule(),
         RecompileStormRule(),
+        MigrationStallRule(),
     ]
     if slo_ttft_ms is not None:
         rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))

@@ -87,7 +87,8 @@ class RequestRecord:
     __slots__ = ("tp", "prompt_tokens", "max_new", "reused_blocks",
                  "t_enqueue", "w_enqueue", "t_head", "t_admit",
                  "chunks", "t_first", "w_first", "tok_t",
-                 "t_done", "reason", "closed")
+                 "t_done", "reason", "closed", "t_mig0", "w_mig0",
+                 "t_mig1", "migrate_blocks", "migrate_bytes")
 
     def __init__(self, prompt_tokens: int, max_new: int,
                  tp: str | None):
@@ -108,6 +109,14 @@ class RequestRecord:
         self.t_done: float | None = None
         self.reason: str | None = None
         self.closed = False
+        #: Migration leg (ISSUE 16, decode-side records only): plan →
+        #: import-complete stamps plus the transfer's block/byte
+        #: totals — its own TTFT attribution inside the request.
+        self.t_mig0: float | None = None
+        self.w_mig0: float | None = None
+        self.t_mig1: float | None = None
+        self.migrate_blocks = 0
+        self.migrate_bytes = 0
 
     # ------------------------------------------------------- durations
 
@@ -145,6 +154,13 @@ class RequestRecord:
         return [round((b - a) * 1e3, 3)
                 for a, b in zip(self.tok_t, self.tok_t[1:])]
 
+    def migrate_s(self) -> float | None:
+        """Migration-leg wall (plan → import complete); None when the
+        request never migrated or the transfer never finished."""
+        if self.t_mig0 is None or self.t_mig1 is None:
+            return None
+        return max(0.0, self.t_mig1 - self.t_mig0)
+
     def to_dict(self) -> dict:
         ttft = self.ttft_s()
         tpot = self.tpot_s()
@@ -164,6 +180,11 @@ class RequestRecord:
         }
         if ttft is not None:
             d["ttft_ms"] = round(ttft * 1e3, 3)
+        mig = self.migrate_s()
+        if mig is not None:
+            d["migrate_ms"] = round(mig * 1e3, 3)
+            d["migrate_blocks"] = self.migrate_blocks
+            d["migrate_bytes"] = self.migrate_bytes
         if tpot is not None:
             d["tpot_ms"] = round(tpot * 1e3, 3)
             d["decode_deltas_ms"] = self.decode_deltas_ms()
@@ -301,6 +322,10 @@ class ServingLedger:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_tokens = 0
+        #: Requests whose KV arrived by migration (ISSUE 16); the
+        #: migrate histogram/summary keys stay absent until > 0, so
+        #: a non-disaggregated replica's Info() is migration-free.
+        self._migrated = 0
 
     # --------------------------------------------------- request seams
 
@@ -332,6 +357,28 @@ class ServingLedger:
         rec.w_first = time.time()
         rec.t_first = time.perf_counter()
         rec.tok_t.append(rec.t_first)
+
+    def migrate_begin(self, rec: RequestRecord) -> None:
+        """Decode-side migration plan accepted (blocks reserved,
+        resident refs taken): the migration leg opens here."""
+        rec.w_mig0 = time.time()
+        rec.t_mig0 = time.perf_counter()
+
+    def migrate_done(self, rec: RequestRecord, blocks: int,
+                     nbytes: int) -> None:
+        """The migration wire landed (imported + sealed): close the
+        leg, fold ``serve.migrate_ms`` — the histogram behind the
+        migration leg's own TTFT attribution (a slow transfer shows
+        up HERE before it shows up in ttft_p99)."""
+        rec.t_mig1 = time.perf_counter()
+        rec.migrate_blocks = int(blocks)
+        rec.migrate_bytes = int(nbytes)
+        mig = rec.migrate_s()
+        if mig is not None:
+            self.registry.histogram("serve.migrate_ms").observe(
+                mig * 1e3)
+        with self._lock:
+            self._migrated += 1
 
     def tokens_emitted(self, recs, counts=None) -> None:
         """One decode step emitted a token on each of ``recs`` — one
@@ -480,6 +527,7 @@ class ServingLedger:
             spec_prop = self._spec_proposed
             spec_acc = self._spec_accepted
             spec_toks = self._spec_tokens
+            migrated = self._migrated
         out = {}
         if spec_prop:
             # Only once speculation actually ran: a non-speculative
@@ -487,6 +535,13 @@ class ServingLedger:
             # tell "no speculation" from "accept rate 0".
             out["spec_accept_rate"] = round(spec_acc / spec_prop, 4)
             out["spec_tokens"] = spec_toks
+        if migrated:
+            # Same contract for migration: only once a wire actually
+            # landed here.
+            out["migrated_requests"] = migrated
+            out["migrate_p99_ms"] = round(
+                self.registry.histogram("serve.migrate_ms")
+                .percentile(99), 3)
         return {
             **out,
             "requests_retired": retired,
@@ -553,6 +608,15 @@ class ServingLedger:
         elif rec.reason not in ("complete", "stop"):
             admit.status = rec.reason or "error"
         recd.record(admit)
+        mig = rec.migrate_s()
+        if mig is not None:
+            sp = trace.Span("serve.migrate", trace_id, parent_id)
+            sp.start_s = rec.w_mig0
+            sp.dur_s = mig
+            sp.attrs = {"blocks": rec.migrate_blocks,
+                        "bytes": rec.migrate_bytes,
+                        "dedup_blocks": rec.reused_blocks}
+            recd.record(sp)
         for i, (w0, dur, tokens) in enumerate(rec.chunks):
             sp = trace.Span(f"serve.prefill.chunk[{i}]", trace_id,
                             parent_id)

@@ -109,12 +109,30 @@ def _lifecycle_name(code) -> str | None:
             else "?")
 
 
+#: Code → name for the ``serve.class`` gauge (disaggregated serving,
+#: ISSUE 16). Mirrors ``ptype_tpu.serve_engine.SERVE_CLASSES`` — same
+#: inline-copy contract as ``_LIFECYCLE_NAMES``; a test pins the two
+#: in sync.
+_SERVE_CLASS_NAMES = ("unified", "prefill", "decode")
+
+
+def _serve_class_name(code) -> str | None:
+    if code is None:
+        return None
+    i = int(code)
+    return (_SERVE_CLASS_NAMES[i] if 0 <= i < len(_SERVE_CLASS_NAMES)
+            else "?")
+
+
 def render_serve(snapshot: dict, alerts=(),
                  max_nodes: int = 32) -> str:
     """``obs serve``: the serving-plane one-pager — per-replica
     TTFT/TPOT/e2e tails from the serving ledger's histograms, queue
     and batch occupancy, KV-pool pressure (free blocks, utilization,
-    prefix hit rate, evictions), and the co-batched prefill stall.
+    prefix hit rate, evictions), the co-batched prefill stall, and —
+    on a disaggregated fleet (ISSUE 16) — each replica's serving
+    class plus its migration counters (completed transfers, wire
+    bytes, dedup hits).
     Replicas are rows; nodes with no serving metrics (trainers, the
     coordinator) are skipped — this is the serving view, ``obs top``
     is the fleet view."""
@@ -127,10 +145,11 @@ def render_serve(snapshot: dict, alerts=(),
         f"ptype serving @ {snapshot.get('ts')} — "
         f"{len(serving)} serving replicas "
         f"({len(nodes)} nodes, {len(errors)} unreachable)",
-        f"{'replica':<28} {'state':>9} {'ttft99':>8} {'tpot':>7} "
-        f"{'e2e99':>8} {'q':>4} {'live':>5} {'kvfree':>7} "
-        f"{'util%':>6} {'hit%':>6} {'spec%':>6} {'evic':>6} "
-        f"{'stall':>7}",
+        f"{'replica':<28} {'state':>9} {'class':>8} {'ttft99':>8} "
+        f"{'tpot':>7} {'e2e99':>8} {'q':>4} {'live':>5} "
+        f"{'kvfree':>7} {'util%':>6} {'hit%':>6} {'spec%':>6} "
+        f"{'evic':>6} {'stall':>7} {'mig':>5} {'migMB':>7} "
+        f"{'dedup':>6}",
     ]
 
     def num(v, fmt="{:.1f}", dash="-"):
@@ -157,14 +176,27 @@ def render_serve(snapshot: dict, alerts=(),
         # reconciler's state machine; "-" = the replica predates the
         # lifecycle story (no serve.lifecycle gauge).
         state = _lifecycle_name(_gauge(t, "serve.lifecycle")) or "-"
+        # Serving class + migration counters (ISSUE 16): a
+        # disaggregated fleet reads its split and its wire traffic
+        # here first (the migration-stall runbook starts at this
+        # view); "-" class = a replica predating the disagg story.
+        cls = _serve_class_name(_gauge(t, "serve.class")) or "-"
+        counters = t.get("metrics", {}).get("counters", {})
+        mig = counters.get("serve.migrations")
+        mig_mb = counters.get("serve.migrate_bytes")
+        mig_mb = mig_mb / 1e6 if mig_mb is not None else None
+        dedup = counters.get("serve.migrate_dedup_hits")
         lines.append(
-            f"{key[:28]:<28} {state:>9} {num(ttft, '{:.0f}'):>7}m "
+            f"{key[:28]:<28} {state:>9} {cls:>8} "
+            f"{num(ttft, '{:.0f}'):>7}m "
             f"{num(tpot):>6}m {num(e2e, '{:.0f}'):>7}m "
             f"{num(q, '{:.0f}'):>4} {num(live, '{:.0f}'):>5} "
             f"{num(free, '{:.0f}'):>7} {num(util):>6} "
             f"{num(hit * 100 if hit is not None else None):>6} "
             f"{num(spec * 100 if spec is not None else None):>6} "
-            f"{num(evic, '{:.0f}'):>6} {num(stall):>6}m")
+            f"{num(evic, '{:.0f}'):>6} {num(stall):>6}m "
+            f"{num(mig, '{:.0f}'):>5} {num(mig_mb, '{:.2f}'):>7} "
+            f"{num(dedup, '{:.0f}'):>6}")
     if not serving:
         lines.append("  (no serving replicas report serve.* metrics)")
     for key in sorted(errors)[:8]:

@@ -252,7 +252,7 @@ _REGISTRY: dict[str, Callable[[], AuditReport]] = {}
 DEFAULT_PROGRAMS = (
     "train.grads", "zero.shard_apply", "collectives.bucket_allreduce",
     "collectives.bucket_reduce_scatter", "serve.decode_step",
-    "serve.spec_window",
+    "serve.spec_window", "serve.kv_pack", "serve.kv_unpack",
 )
 
 
@@ -471,6 +471,63 @@ def _build_spec_window(preset: str, k: int):
     return builder
 
 
+def _build_kv_pack(preset: str, n_blocks: int, block_tokens: int):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.models import transformer as tfm
+        from ptype_tpu.serve_engine.migrate import make_pack_prog
+
+        cfg = tfm.preset(preset, dtype=jnp.float32)
+        kvh = cfg.n_kv_heads or cfg.n_heads
+        hd = cfg.d_model // cfg.n_heads
+        blk = jax.ShapeDtypeStruct(
+            (cfg.n_layers, block_tokens, kvh, hd), jnp.float32)
+        # Residuals donated (consumed into the pre-quantization sum,
+        # replaced by the new per-block error): a dropped donation
+        # doubles the wire path's live residual memory per transfer.
+        return audit(make_pack_prog(), (blk, blk, blk, blk),
+                     name="serve.kv_pack", donate_argnums=(2, 3),
+                     expect_collectives=0)
+
+    return builder
+
+
+def _build_kv_unpack(preset: str, n_blocks: int, block_tokens: int):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.models import transformer as tfm
+        from ptype_tpu.serve_engine.migrate import (make_pack_prog,
+                                                    make_unpack_prog)
+
+        cfg = tfm.preset(preset, dtype=jnp.float32)
+        kvh = cfg.n_kv_heads or cfg.n_heads
+        hd = cfg.d_model // cfg.n_heads
+        shape = (cfg.n_layers, block_tokens, kvh, hd)
+        blk = jax.ShapeDtypeStruct(shape, jnp.float32)
+        # The wire avals come from the pack program itself, so the
+        # audited unpack consumes exactly what pack emits.
+        qk, sk, _, qv, sv, _ = jax.eval_shape(
+            make_pack_prog(), blk, blk, blk, blk)
+        bank = jax.ShapeDtypeStruct(
+            (cfg.n_layers, n_blocks, block_tokens, kvh, hd),
+            jnp.float32)
+        args = (bank, bank,
+                jax.ShapeDtypeStruct(qk.shape, qk.dtype),
+                jax.ShapeDtypeStruct(sk.shape, sk.dtype),
+                jax.ShapeDtypeStruct(qv.shape, qv.dtype),
+                jax.ShapeDtypeStruct(sv.shape, sv.dtype),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        # Banks donated (scatter-in-place): a dropped donation copies
+        # the decode replica's WHOLE KV pool per imported block.
+        return audit(make_unpack_prog(shape, jnp.float32), args,
+                     name="serve.kv_unpack", donate_argnums=(0, 1),
+                     expect_collectives=0)
+
+    return builder
+
+
 def register_default_programs(preset: str = "tiny", batch: int = 4,
                               seq: int = 16, spec_k: int = 3) -> None:
     """Install the standing hot-program registry (idempotent): the
@@ -487,3 +544,7 @@ def register_default_programs(preset: str = "tiny", batch: int = 4,
              _build_decode_step(preset, n_slots=2, n_blocks=12,
                                 block_tokens=16))
     register("serve.spec_window", _build_spec_window(preset, spec_k))
+    register("serve.kv_pack",
+             _build_kv_pack(preset, n_blocks=12, block_tokens=16))
+    register("serve.kv_unpack",
+             _build_kv_unpack(preset, n_blocks=12, block_tokens=16))

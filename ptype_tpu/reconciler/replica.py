@@ -561,13 +561,20 @@ class ProcessLauncher:
       a 1-token Generate so activation never pays the cold compile;
     - ``custom`` — ``factory="module:function"``: any actor (a
       trainer, an eval server) rides the same lifecycle.
+
+    ``serve_class`` (disaggregated serving, ISSUE 16) stamps every
+    worker this launcher spawns as ``"prefill"``, ``"decode"``, or
+    the default ``"unified"`` — a per-class fleet is two launchers
+    (one per class) each driven by its own reconciler off its own
+    gateway hint (``InferenceGateway.class_hint``).
     """
 
     def __init__(self, coordinator_address: str, service: str = "llm",
                  kind: str = "fake", preset: str = "tiny",
                  factory: str = "",
                  spawn_timeout_s: float = 60.0,
-                 env: dict | None = None):
+                 env: dict | None = None,
+                 serve_class: str = "unified"):
         self.coordinator_address = coordinator_address
         self.service = service
         self.kind = kind
@@ -575,6 +582,7 @@ class ProcessLauncher:
         #: ``module:function`` for ``kind="custom"`` (trainer or any
         #: other actor riding the same lifecycle).
         self.factory = factory
+        self.serve_class = serve_class
         self.spawn_timeout_s = float(spawn_timeout_s)
         self._env = dict(env or {})
         self.procs: list[subprocess.Popen] = []
@@ -598,6 +606,7 @@ class ProcessLauncher:
                "PTYPE_REPLICA_PRESET": self.preset,
                "PTYPE_REPLICA_FACTORY": self.factory,
                "PTYPE_REPLICA_WARM": "1" if warm_hold else "0",
+               "PTYPE_REPLICA_SERVE_CLASS": self.serve_class,
                "PTYPE_REPLICA_READY_FILE": ready}
         proc = subprocess.Popen(
             [sys.executable, "-m", "ptype_tpu.reconciler.worker"],

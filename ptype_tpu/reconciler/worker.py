@@ -18,6 +18,11 @@ zero code changes):
                            lifecycle with zero worker changes
                            (an optional ``warmup`` attribute on the
                            function is the warm-up hook)
+``PTYPE_REPLICA_SERVE_CLASS`` ``unified`` | ``prefill`` | ``decode``
+                           — the disaggregated-serving role stamped
+                           on a ``paged`` engine (ISSUE 16); the
+                           gateway's two-stage router reads it back
+                           from ``Info()``
 ``PTYPE_REPLICA_WARM``     ``1`` = hold warm (spawn + load params +
                            compile, but do NOT register — the
                            standby-pool state; the reconciler's
@@ -57,7 +62,10 @@ def _actor_factory(kind: str, preset: str):
             from ptype_tpu.models import transformer as tfm
             from ptype_tpu.serve_engine.engine import PagedGeneratorActor
 
-            return PagedGeneratorActor(tfm.preset(preset))
+            serve_class = os.environ.get("PTYPE_REPLICA_SERVE_CLASS",
+                                         "unified")
+            return PagedGeneratorActor(tfm.preset(preset),
+                                       serve_class=serve_class)
 
         def warmup(actor):
             import jax.numpy as jnp

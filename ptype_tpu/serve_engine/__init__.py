@@ -29,8 +29,12 @@ lives in :mod:`ptype_tpu.ops.paged_attention`, gated behind the same
 
 from ptype_tpu.serve_engine.blocks import (BlockPool, block_hashes,
                                            prefix_affinity_key)
-from ptype_tpu.serve_engine.engine import (PagedGeneratorActor,
+from ptype_tpu.serve_engine.engine import (SERVE_CLASS_CODES,
+                                           SERVE_CLASSES,
+                                           PagedGeneratorActor,
                                            SpecConfig)
+from ptype_tpu.serve_engine.migrate import WIRE_MODES, KVMigrator
 
 __all__ = ["BlockPool", "block_hashes", "prefix_affinity_key",
-           "PagedGeneratorActor", "SpecConfig"]
+           "PagedGeneratorActor", "SpecConfig", "SERVE_CLASSES",
+           "SERVE_CLASS_CODES", "KVMigrator", "WIRE_MODES"]

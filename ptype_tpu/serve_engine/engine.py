@@ -48,6 +48,7 @@ answers "where did this request's latency go" across processes.
 
 from __future__ import annotations
 
+import itertools
 import threading
 
 from ptype_tpu import lockcheck
@@ -66,8 +67,21 @@ from ptype_tpu.models import transformer as tfm
 from ptype_tpu.serve import (LIFECYCLE_CODES, GeneratorActor, _norm_prompt,
                              _pow2)
 from ptype_tpu.serve_engine.blocks import BlockPool, block_hashes
+from ptype_tpu.serve_engine.migrate import WIRE_MODES, KVMigrator
 
 log = logs.get_logger("serve_engine")
+
+#: Replica classes for disaggregated serving (ISSUE 16): a "prefill"
+#: replica fills KV blocks and exports them; a "decode" replica
+#: imports migrated block sets and owns the decode lifetime;
+#: "unified" does both (the pre-disaggregation behavior, and the
+#: fallback class the router uses when a class pool is empty). The
+#: class is ADVISORY — every engine serves every endpoint — routing
+#: and the reconciler's per-class scaling are where it binds.
+SERVE_CLASSES = ("unified", "prefill", "decode")
+#: Numeric codes for the ``serve.class`` gauge (obs serve renders
+#: the names back; same pattern as ``serve.lifecycle``).
+SERVE_CLASS_CODES = {"unified": 0, "prefill": 1, "decode": 2}
 
 
 @dataclass
@@ -119,7 +133,7 @@ class _PagedRow:
                  "top_k", "top_p", "key", "emitted", "done", "err",
                  "table", "hashes", "reused", "prefill_pos",
                  "reserve_left", "rec", "cancelled", "draft_table",
-                 "draft_reserve_left")
+                 "draft_reserve_left", "export_id", "migrated")
 
     def __init__(self, prompt, max_new, stop_token, temperature,
                  top_k, top_p, key):
@@ -148,6 +162,14 @@ class _PagedRow:
         #: pool — the draft's KV state rides its own BlockPool).
         self.draft_table: list[int] = []
         self.draft_reserve_left = 0
+        #: Disaggregated serving (ISSUE 16): a non-None export_id
+        #: marks a prefill-class row — at prompt completion its block
+        #: refs park under the id for ExportBlocks instead of taking
+        #: a slot; ``migrated`` marks a decode-class row whose prompt
+        #: KV arrived over the wire (admission skips reservation and
+        #: prefill — both already happened).
+        self.export_id: int | None = None
+        self.migrated = False
 
 
 class PagedGeneratorActor(GeneratorActor):
@@ -183,7 +205,7 @@ class PagedGeneratorActor(GeneratorActor):
                  attn: str = "gather",
                  spec: SpecConfig | None = None,
                  metrics_registry: metrics_mod.MetricsRegistry | None
-                 = None):
+                 = None, serve_class: str = "unified"):
         super().__init__(cfg, params, rng)
         #: Registry the engine's gauges/histograms land in (default:
         #: the process-global one; drills and simulated multi-replica
@@ -213,6 +235,29 @@ class PagedGeneratorActor(GeneratorActor):
         if attn not in ("gather", "kernel"):
             raise ValueError(f"attn must be 'gather'|'kernel', "
                              f"got {attn!r}")
+        if serve_class not in SERVE_CLASSES:
+            raise ValueError(f"serve_class must be one of "
+                             f"{SERVE_CLASSES}, got {serve_class!r}")
+        #: Disaggregated-serving class (ISSUE 16) — advisory: routing
+        #: and per-class scaling key on it; every endpoint still
+        #: answers (the gateway's fallback path relies on that).
+        self.serve_class = serve_class
+        #: KV wire state: pack/unpack programs + the prefill-side EF
+        #: residual store (docs/OPERATIONS.md "Disaggregated
+        #: serving"). One per engine — residuals are keyed by chain
+        #: hash, so they follow block CONTENT, not requests.
+        self._migrator = KVMigrator(
+            (cfg.n_layers, bt, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+        #: export_id -> finished prefill row whose block refs are
+        #: parked for migration (released by ReleaseExport).
+        self._exports: dict[int, _PagedRow] = {}
+        #: ticket -> decode-side migration state (reserved blocks,
+        #: resident refs, the ledger record with the migration leg).
+        self._tickets: dict[int, dict] = {}
+        self._mig_ids = itertools.count(1)
+        self._migrations = 0
+        self._migrate_bytes = 0
+        self._migrate_dedup_hits = 0
         if attn == "kernel" and jax.default_backend() != "cpu":
             from ptype_tpu.ops.paged_attention import check_tpu_lowering
 
@@ -477,6 +522,367 @@ class PagedGeneratorActor(GeneratorActor):
         per = self.ledger.svc_ewma_s() or 0.1
         return round(max(0.05, backlog * per), 3)
 
+    # -------------------------------------------- migration (ISSUE 16)
+
+    def Prefill(self, prompt, max_new_tokens: int = 16,
+                temperature: float = 0.0, seed: int = 0,
+                top_k: int = 0, top_p: float = 1.0,
+                stop_token: int = -1) -> dict:
+        """Disaggregated prefill: run the prompt through chunked
+        prefill (prefix reuse and all), emit the FIRST token, and park
+        the prompt's KV blocks under an export id instead of taking a
+        decode slot. The gateway pairs this with MigratePlan/
+        ImportBlocks/MigrateDecode on a decode-class replica;
+        ``max_new_tokens`` is advisory here (the decode side reserves
+        for it) — this replica only ever computes token one."""
+        prompt = _norm_prompt(prompt)
+        if prompt.shape[0] != 1:
+            raise ValueError("Prefill is single-row (the gateway "
+                             "migrates one request at a time)")
+        L = int(prompt.shape[1])
+        if L + 1 > self.reach:
+            raise ValueError(f"prompt {L} exceeds engine reach "
+                             f"{self.reach}")
+        self._enter_request()
+        try:
+            if self._draining:
+                self.ledger.shed_untracked()
+                raise ShedError("replica draining (scale-down in "
+                                "progress); route elsewhere",
+                                retry_after_s=0.05)
+            f = chaos.hit("serve.admit", "prefill")
+            if f is not None:
+                if f.action == "delay":
+                    f.sleep()
+                elif f.action == "shed":
+                    self.ledger.shed_untracked()
+                    raise ShedError("chaos: serve.admit shed",
+                                    retry_after_s=self._retry_after())
+            key = (np.asarray(jax.random.PRNGKey(int(seed)))
+                   if float(temperature) != 0.0
+                   else np.zeros(2, np.uint32))
+            row = _PagedRow(np.asarray(prompt[0]), 1, int(stop_token),
+                            float(temperature), int(top_k),
+                            float(top_p), key)
+            row.export_id = next(self._mig_ids)
+            row.rec = self.ledger.enqueued(L, 1,
+                                           tp=trace.traceparent())
+            with self._lock:
+                self._calls += 1
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                if (self.max_queue
+                        and len(self._queue) + 1 > self.max_queue):
+                    self.ledger.retired(row.rec, "shed")
+                    raise ShedError(
+                        f"serving backlog full ({len(self._queue)} "
+                        f"queued, cap {self.max_queue})",
+                        retry_after_s=self._retry_after())
+                self._queue.append(row)
+                self._reg.gauge("serve.queue_depth").set(
+                    len(self._queue))
+                self._cond.notify()
+            chaos.note_ok("serve.admit")
+            row.done.wait()
+            if row.err is not None:
+                raise row.err
+            return {"export_id": int(row.export_id),
+                    "first_token": int(row.emitted[0]),
+                    "n_tokens": L,
+                    "block_tokens": self.block_tokens,
+                    "reused": int(row.reused),
+                    "hashes": [int(h) for h in row.hashes]}
+        finally:
+            self._exit_request()
+
+    def ExportBlocks(self, export_id: int, need_idx=None,
+                     kv_wire: str = "q8") -> dict:
+        """Pack an export's blocks for the wire: the full blocks in
+        ``need_idx`` (None = all of them) plus the unsealed partial
+        tail — only what the decode side doesn't already hold rides
+        the transfer (the manifest dedup MigratePlan computed)."""
+        if kv_wire not in WIRE_MODES:
+            raise ValueError(f"kv_wire must be one of {WIRE_MODES}, "
+                             f"got {kv_wire!r}")
+        with self._cond:
+            row = self._exports.get(int(export_id))
+        if row is None:
+            raise RuntimeError(f"unknown export {export_id}")
+        toks = row.prompt
+        L = len(toks)
+        bt = self.block_tokens
+        nfull = L // bt
+        want = sorted(set(int(i) for i in need_idx)
+                      if need_idx is not None else range(nfull))
+        if any(i < 0 or i >= nfull for i in want):
+            raise ValueError(f"need_idx out of range for {nfull} "
+                             f"full blocks: {want}")
+        if L % bt:
+            want.append(nfull)  # the partial tail always ships
+        blocks: list[dict] = []
+        nbytes = 0
+        # Under the dispatch lock: pack reads the banks the engine
+        # thread's prefill/decode programs DONATE — lock-ordered
+        # dispatch keeps every read on a live buffer. The hot region
+        # holds the pack path to explicit-transfers-only (the wire
+        # hop is the one sanctioned sync).
+        with self._lock:
+            with jitwatch.hot_region("serve.migrate"):
+                for i in want:
+                    h = row.hashes[i] if i < nfull else None
+                    payload, nb = self._migrator.pack_block(
+                        self.pool.k, self.pool.v, row.table[i], h,
+                        kv_wire)
+                    entry = {"idx": int(i),
+                             "hash": int(h) if h is not None else None}
+                    entry.update(payload)
+                    blocks.append(entry)
+                    nbytes += nb
+        return {"mode": kv_wire, "block_tokens": bt, "n_tokens": L,
+                "nbytes": int(nbytes), "blocks": blocks}
+
+    def ReleaseExport(self, export_id: int) -> bool:
+        """Drop an export's parked block refs (after migration, or on
+        abort). Sealed full blocks park in the LRU — the next request
+        sharing the prefix still reuses them here."""
+        with self._cond:
+            row = self._exports.pop(int(export_id), None)
+        if row is None:
+            return False
+        for bid in row.table:
+            self.pool.deref(bid)
+        row.table = []
+        self._export_gauges()
+        return True
+
+    def MigratePlan(self, prompt, max_new_tokens: int = 16,
+                    temperature: float = 0.0, seed: int = 0,
+                    top_k: int = 0, top_p: float = 1.0,
+                    stop_token: int = -1) -> dict:
+        """Decode-side admission for a migrating request: reserve the
+        worst-case block count BEFORE any bytes move (a transfer that
+        could land nowhere is wasted wire), then walk the chain-hash
+        manifest and take refs on every block already resident — the
+        dedup leg: those are never re-sent. Returns the ticket plus
+        ``need`` (full-block indices to ship); a pool that can't
+        cover the worst case sheds typed, same contract as
+        admission."""
+        prompt = _norm_prompt(prompt)
+        if prompt.shape[0] != 1:
+            raise ValueError("MigratePlan is single-row")
+        toks = np.asarray(prompt[0])
+        L = int(toks.shape[0])
+        max_new = int(max_new_tokens)
+        if max_new <= 0:
+            raise ValueError("max_new_tokens must be >= 1")
+        if L + max_new > self.reach:
+            raise ValueError(
+                f"prompt {L} + max_new {max_new} exceeds engine "
+                f"reach {self.reach}")
+        bt = self.block_tokens
+        need_total = -(-(L + max_new) // bt)
+        if need_total > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need_total} blocks; pool holds "
+                f"{self.pool.capacity}")
+        self._enter_request()
+        try:
+            if self._draining:
+                self.ledger.shed_untracked()
+                raise ShedError("replica draining (scale-down in "
+                                "progress); route elsewhere",
+                                retry_after_s=0.05)
+            reserved = self.pool.try_reserve(need_total)
+            if reserved and self._dpool is not None \
+                    and not self._dpool.try_reserve(need_total):
+                self.pool.unreserve(need_total)
+                reserved = False
+            if not reserved:
+                self.ledger.shed_untracked()
+                raise ShedError(
+                    f"kv pool cannot cover migration: need "
+                    f"{need_total} blocks, free "
+                    f"{self.pool.free_blocks()}",
+                    retry_after_s=self._retry_after())
+            hashes = block_hashes(toks, bt)
+            nfull = L // bt
+            table: dict[int, int] = {}
+            for i in range(nfull):
+                bid = self.pool.lookup(hashes[i],
+                                       toks[i * bt:(i + 1) * bt])
+                if bid is not None:
+                    self.pool.ref(bid)  # consumes one reserved unit
+                    table[i] = bid
+            resident = len(table)
+            self._prefix_hits += resident
+            self._prefix_misses += nfull - resident
+            self._migrate_dedup_hits += resident
+            self._reg.counter("serve.migrate_dedup_hits").add(resident)
+            key = (np.asarray(jax.random.PRNGKey(int(seed)))
+                   if float(temperature) != 0.0
+                   else np.zeros(2, np.uint32))
+            rec = self.ledger.enqueued(L, max_new,
+                                       tp=trace.traceparent())
+            rec.reused_blocks = resident
+            self.ledger.migrate_begin(rec)
+            need = [i for i in range(nfull) if i not in table]
+            tail = L % bt
+            ticket = next(self._mig_ids)
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                self._tickets[ticket] = {
+                    "toks": toks, "hashes": hashes, "table": table,
+                    "need": set(need), "tail": tail,
+                    "max_new": max_new, "stop_token": int(stop_token),
+                    "temperature": float(temperature),
+                    "top_k": int(top_k), "top_p": float(top_p),
+                    "key": key, "rec": rec, "resident": resident,
+                    "reserve_left": need_total - resident,
+                    "draft_reserve_left": (need_total
+                                           if self._dpool is not None
+                                           else 0),
+                    "imported": not need and not tail,
+                }
+            self._export_gauges()
+            return {"ticket": int(ticket), "need": need,
+                    "resident": resident, "tail": int(tail),
+                    "block_tokens": bt}
+        finally:
+            self._exit_request()
+
+    def ImportBlocks(self, ticket: int, wire: dict) -> dict:
+        """Land a migration wire into the pool: allocate from the
+        ticket's reservation, scatter each block through the unpack
+        program (bank-donating, inside the dispatch lock — imports
+        INTERLEAVE with in-flight decode iterations instead of
+        stalling them), then seal the full blocks so the whole fleet
+        cache warms. A wire missing planned blocks raises — the
+        gateway's fallback leg (local prefill on the decode replica)
+        owns recovery."""
+        with self._cond:
+            t = self._tickets.get(int(ticket))
+        if t is None:
+            raise RuntimeError(f"unknown migration ticket {ticket}")
+        mode = wire.get("mode")
+        if mode not in WIRE_MODES:
+            raise RuntimeError(f"bad kv_wire mode on wire: {mode!r}")
+        bt = self.block_tokens
+        if int(wire.get("block_tokens", -1)) != bt:
+            raise RuntimeError(
+                f"wire block_tokens {wire.get('block_tokens')} != "
+                f"engine {bt}")
+        toks = t["toks"]
+        L = len(toks)
+        nfull = L // bt
+        entries = {}
+        for b in wire.get("blocks", ()):
+            i = int(b["idx"])
+            if i not in t["table"]:  # resident blocks never re-land
+                entries[i] = b
+        expected = set(t["need"]) | ({nfull} if t["tail"] else set())
+        missing = expected - set(entries)
+        if missing:
+            raise RuntimeError(
+                f"migration wire truncated: missing blocks "
+                f"{sorted(missing)} of {sorted(expected)}")
+        for i in sorted(entries):
+            bid = self.pool.alloc()  # consumes one reserved unit
+            t["reserve_left"] -= 1
+            t["table"][i] = bid
+        with self._lock:
+            with jitwatch.hot_region("serve.migrate"):
+                for i in sorted(entries):
+                    self.pool.k, self.pool.v = \
+                        self._migrator.unpack_block(
+                            self.pool.k, self.pool.v, entries[i],
+                            t["table"][i], mode)
+        for i in sorted(entries):
+            if i < nfull:
+                self.pool.seal(t["table"][i], t["hashes"][i],
+                               toks[i * bt:(i + 1) * bt])
+        nbytes = int(wire.get("nbytes", 0))
+        t["imported"] = True
+        self._migrations += 1
+        self._migrate_bytes += nbytes
+        self._reg.counter("serve.migrations").add(1)
+        self._reg.counter("serve.migrate_bytes").add(nbytes)
+        self.ledger.migrate_done(t["rec"], len(entries), nbytes)
+        self._export_gauges()
+        return {"imported": len(entries), "nbytes": nbytes}
+
+    def MigrateDecode(self, ticket: int, first_token: int):
+        """Own the decode lifetime of a migrated request: build the
+        row from the ticket's imported table, ride the normal
+        admission/decode path (slot activation runs the LOCAL draft
+        prefill when speculation is armed), and return the full
+        emitted token list — ``first_token`` (computed by the prefill
+        replica) included."""
+        self._enter_request()
+        try:
+            if self._draining:
+                self.ledger.shed_untracked()
+                raise ShedError("replica draining (scale-down in "
+                                "progress); route elsewhere",
+                                retry_after_s=0.05)
+            with self._cond:
+                t = self._tickets.get(int(ticket))
+                if t is not None and not t["imported"]:
+                    t = None  # leave it for AbortMigration
+                else:
+                    self._tickets.pop(int(ticket), None)
+            if t is None:
+                raise RuntimeError(
+                    f"migration ticket {ticket} unknown or not "
+                    f"imported")
+            row = _PagedRow(t["toks"], t["max_new"], t["stop_token"],
+                            t["temperature"], t["top_k"], t["top_p"],
+                            t["key"])
+            row.migrated = True
+            row.hashes = t["hashes"]
+            row.reused = t["resident"]
+            row.table = [t["table"][i] for i in range(len(t["table"]))]
+            row.prefill_pos = len(t["toks"])
+            row.reserve_left = t["reserve_left"]
+            row.draft_reserve_left = t["draft_reserve_left"]
+            row.emitted = [int(first_token)]
+            row.rec = t["rec"]
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("generator actor is closed")
+                # No max_queue gate: this request was admitted (and
+                # its blocks committed) at MigratePlan time.
+                self._queue.append(row)
+                self._reg.gauge("serve.queue_depth").set(
+                    len(self._queue))
+                self._cond.notify()
+            row.done.wait()
+            if row.err is not None:
+                raise row.err
+            return [int(x) for x in row.emitted]
+        finally:
+            self._exit_request()
+
+    def AbortMigration(self, ticket: int) -> bool:
+        """Unwind a ticket whose transfer failed (chaos, transport, a
+        dead prefill replica): drop refs, return the reservation,
+        retire the ledger record — the request itself is NOT lost,
+        the gateway re-runs it as a local prefill on this replica."""
+        with self._cond:
+            t = self._tickets.pop(int(ticket), None)
+        if t is None:
+            return False
+        for bid in t["table"].values():
+            self.pool.deref(bid)
+        if t["reserve_left"] > 0:
+            self.pool.unreserve(t["reserve_left"])
+        if self._dpool is not None and t["draft_reserve_left"] > 0:
+            self._dpool.unreserve(t["draft_reserve_left"])
+        self.ledger.retired(t["rec"], "cancelled")
+        self._export_gauges()
+        return True
+
     # ------------------------------------------------------------ engine
 
     def _engine(self) -> None:
@@ -586,6 +992,14 @@ class PagedGeneratorActor(GeneratorActor):
         if self._active.all():
             return  # no slot to land in
         row = self._queue[0]
+        if row.migrated:
+            # A migrated row's worst case was reserved at MigratePlan
+            # and its prompt KV imported already — admission is just
+            # taking the slot.
+            self._queue.pop(0)
+            self.ledger.admitted(row.rec)
+            self._admitting = row
+            return
         need = -(-(len(row.prompt) + row.max_new) // self.block_tokens)
         reserved = self.pool.try_reserve(need)
         if reserved and self._dpool is not None \
@@ -640,6 +1054,8 @@ class PagedGeneratorActor(GeneratorActor):
         ``self._admitting`` here would be a bare cross-thread read);
         returns (prompt tokens written — the budget consumed, chunk
         seconds — the stall charge)."""
+        if row.migrated:
+            return self._activate_migrated(row)
         toks = row.prompt
         L = len(toks)
         bt = self.block_tokens
@@ -684,10 +1100,16 @@ class PagedGeneratorActor(GeneratorActor):
         # chunk span) by the final chunk's compute.
         cm = self.ledger.chunk(row.rec, n)
         with cm:
-            logits, self.pool.k, self.pool.v = self._chunk_prog(C)(
-                self.params, self.pool.k, self.pool.v,
-                jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
-                jnp.asarray(table_arr))
+            # The dispatch lock orders this bank-donating call against
+            # ExportBlocks' pack reads on RPC threads (ISSUE 16): a
+            # pack that dispatched first still reads the pre-donation
+            # buffers; one that dispatches after sees the NEW bank
+            # refs — never a half-donated alias.
+            with self._lock:
+                logits, self.pool.k, self.pool.v = self._chunk_prog(C)(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(padded), jnp.int32(start), jnp.int32(n),
+                    jnp.asarray(table_arr))
             row.prefill_pos += n
             done = row.prefill_pos >= L
             if done:
@@ -722,6 +1144,9 @@ class PagedGeneratorActor(GeneratorActor):
         with self._cond:
             self._admitting = None
         self._export_gauges()
+        if row.export_id is not None:
+            self._stash_export(row)
+            return n, cm.dur_s
         if (row.max_new == 1
                 or (row.stop_token >= 0 and first == row.stop_token)):
             self._finish_row(row,
@@ -729,6 +1154,12 @@ class PagedGeneratorActor(GeneratorActor):
                                         and first == row.stop_token)
                              else "complete")
             return n, cm.dur_s
+        self._take_slot(row, first, L)
+        return n, cm.dur_s
+
+    def _take_slot(self, row: _PagedRow, first: int, L: int) -> None:
+        """Land a prompt-complete row in a free slot (the caller
+        guaranteed one exists — admission gates on it)."""
         slot = int(np.flatnonzero(~self._active)[0])
         self._slot_state[slot] = row
         self._tables[slot] = 0
@@ -751,7 +1182,55 @@ class PagedGeneratorActor(GeneratorActor):
             self._dpos[slot] = L  # draft prefill wrote 0..L-1
         self._dev = None  # slot state changed: re-upload next step
         self._sdev = None
-        return n, cm.dur_s
+
+    def _activate_migrated(self, row: _PagedRow) -> tuple[int, float]:
+        """Land an imported migration in a slot: no prefill — the
+        prompt KV arrived over the wire — but when speculation is
+        armed the DRAFT model prefills locally from the prompt tokens
+        (draft KV is draft-params specific and never rides the wire),
+        so migration cannot introduce draft/target disagreement and
+        the accept rate is untouched by the transfer. The TTFT stamp
+        here is the decode replica's own attribution: plan →
+        activation, the migration leg included."""
+        toks = row.prompt
+        L = len(toks)
+        first = row.emitted[0]
+        cm = self.ledger.chunk(row.rec, 0)
+        with cm:
+            if (self._dpool is not None and row.max_new > 1
+                    and not (row.stop_token >= 0
+                             and first == row.stop_token)):
+                self._draft_prefill(row, toks, L)
+        self.ledger.first_token(row.rec)
+        with self._cond:
+            self._admitting = None
+        self._export_gauges()
+        if (row.max_new == 1
+                or (row.stop_token >= 0 and first == row.stop_token)):
+            self._finish_row(row,
+                             "stop" if (row.stop_token >= 0
+                                        and first == row.stop_token)
+                             else "complete")
+            return 0, cm.dur_s
+        self._take_slot(row, first, L)
+        return 0, cm.dur_s
+
+    def _stash_export(self, row: _PagedRow) -> None:
+        """Disaggregated prefill complete: park the prompt's block
+        refs under the export id (ExportBlocks packs from them;
+        ReleaseExport drops them) and return every unused reservation
+        unit now — an export row never decodes here, so holding its
+        decode worst-case would starve admission for nothing."""
+        if row.reserve_left > 0:
+            self.pool.unreserve(row.reserve_left)
+            row.reserve_left = 0
+        if self._dpool is not None and row.draft_reserve_left > 0:
+            self._dpool.unreserve(row.draft_reserve_left)
+            row.draft_reserve_left = 0
+        with self._cond:
+            self._exports[row.export_id] = row
+        self.ledger.retired(row.rec, "complete")
+        row.done.set()
 
     def _step(self, meter=None) -> None:
         """One engine iteration over the live slots: a speculative
@@ -1210,12 +1689,25 @@ class PagedGeneratorActor(GeneratorActor):
         with self._cond:
             if self._queue or self._admitting is not None:
                 return False
+            if self._exports or self._tickets:
+                # An in-flight migration still references this
+                # replica's blocks (export refs on the prefill side,
+                # a planned-but-undecoded ticket on the decode side)
+                # — exiting now would strand it mid-transfer.
+                return False
         return not self._active.any()
 
     def _export_gauges(self) -> None:
         reg = self._reg
         reg.gauge("serve.lifecycle").set(
             LIFECYCLE_CODES.get(self.lifecycle, 2))
+        reg.gauge("serve.class").set(
+            SERVE_CLASS_CODES.get(self.serve_class, 0))
+        # Open migration legs on this replica (tickets planned but not
+        # yet decoded) — the migration-stall health rule pages when
+        # this sits non-zero while serve.migrations stops advancing.
+        reg.gauge("serve.migrate_inflight").set(
+            len(self._tickets) + len(self._exports))
         st = self.pool.stats()
         reg.gauge("serve.kv_free_blocks").set(st["kv_free_blocks"])
         reg.gauge("serve.kv_util_pct").set(st["kv_util_pct"])
@@ -1238,6 +1730,16 @@ class PagedGeneratorActor(GeneratorActor):
         info["n_slots"] = self.n_slots
         info["engine_steps"] = self._steps
         info["max_live_slots"] = self._max_live
+        # Disaggregated-serving surface (ISSUE 16): the class the
+        # gateway's two-stage router and the per-class reconcilers
+        # key on, plus the migration counters `obs serve` renders.
+        info["serve_class"] = self.serve_class
+        info["migrations"] = self._migrations
+        info["migrate_bytes"] = self._migrate_bytes
+        info["migrate_dedup_hits"] = self._migrate_dedup_hits
+        with self._cond:
+            info["migrate_inflight"] = (len(self._tickets)
+                                        + len(self._exports))
         with self._cond:
             info["queue_depth"] = len(self._queue)
         info["live_slots"] = int(self._active.sum())

@@ -1,0 +1,204 @@
+"""KV-block migration: the quantized wire between serving classes.
+
+Disaggregated serving (ISSUE 16) splits the fleet into prefill-class
+and decode-class replicas: a prefill replica fills a prompt's KV
+blocks, then migrates the block set to the decode replica that owns
+the request for its whole decode lifetime. This module is the wire
+between them — and the ONE module in ``serve_engine/`` where KV wire
+serialization may live (lint PT021 bars ``quantize_leaf`` /
+``dequantize_leaf`` on block banks anywhere else, the same
+single-home discipline PT008/PT011 apply to collectives and RNG).
+
+Wire format, by analogy with the training plane: the int8+EF codec
+that quantizes gradient collectives (``parallel/collectives.py``,
+PR 6 — the EQuARX move, arXiv 2506.17615) quantizes the KV transfer
+leg too. Per migrated block:
+
+- ``kv_wire="q8"`` (default): block-scaled int8 with per-block
+  error-feedback residuals. The residual stays on the PREFILL side,
+  keyed by the block's chain hash — a shared prefix block re-exported
+  to a second decode replica carries the previous transfer's
+  quantization error folded in, so repeated transfers of the same
+  content do not accumulate bias (exactly the EF contract the
+  quantized allreduce keeps across steps).
+- ``kv_wire="exact"``: raw-dtype passthrough — the bit-exactness
+  escape hatch parity tests pin greedy token equality with (int8 is
+  lossy; "migrated decode == solo decode" is only a theorem in exact
+  mode).
+
+Only blocks the target does not already hold ride the wire: the
+transfer manifest is :func:`~ptype_tpu.serve_engine.blocks.
+block_hashes`'s chain-hash family (hash i commits to the whole prefix
+through block i), so the decode side's content-verified residency
+check is exact, and dedup hits are counted, never re-sent.
+
+The pack/unpack programs carry the dispatch-discipline contracts the
+rest of the data plane lives by: pack DONATES the residual buffers
+(consumed into the pre-quantization sum, replaced by the new error),
+unpack DONATES the target banks (scatter-in-place) — both registered
+with ``progaudit`` as ``serve.kv_pack`` / ``serve.kv_unpack``
+(donation consumed, no callbacks, no f64), and the engine runs them
+inside a ``jitwatch.hot_region("serve.migrate")``.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ptype_tpu.parallel.collectives import (_Q8_KEY, DEFAULT_QUANT_BLOCK,
+                                            dequantize_leaf, quantize_leaf)
+
+#: The two wire encodings ``kv_wire`` accepts.
+WIRE_MODES = ("q8", "exact")
+
+
+def _wire_leaf(arr: np.ndarray) -> dict:
+    """Codec-safe exact-mode leaf: the socket codec buffers standard
+    dtypes only, so a non-native bank dtype (bf16) ships as its raw
+    bits + the dtype name; bit-exactness is a view, not a cast."""
+    try:
+        memoryview(arr)
+        return {"raw": arr}
+    except (ValueError, TypeError):
+        return {"raw": arr.view(np.uint8), "dtype": arr.dtype.name}
+
+
+def _unwire_leaf(leaf: dict) -> np.ndarray:
+    raw = np.ascontiguousarray(leaf["raw"])
+    if "dtype" in leaf:
+        raw = raw.view(np.dtype(leaf["dtype"]))
+    return raw
+
+
+def make_pack_prog(q_block: int | None = DEFAULT_QUANT_BLOCK):
+    """One jitted program quantizing a single block's K/V pair for the
+    wire: ``(k_blk, v_blk, res_k, res_v) -> (qk, sk, new_res_k, qv,
+    sv, new_res_v)``. The residuals are DONATED — consumed into the
+    pre-quantization sum and replaced by the new per-block error (the
+    ``serve.kv_pack`` progaudit contract)."""
+
+    def pack(kblk, vblk, rk, rv):
+        wk, nrk = quantize_leaf(kblk, q_block, rk)
+        wv, nrv = quantize_leaf(vblk, q_block, rv)
+        return wk["q"], wk["s"], nrk, wv["q"], wv["s"], nrv
+
+    return jax.jit(pack, donate_argnums=(2, 3))
+
+
+def make_unpack_prog(block_shape, bank_dtype):
+    """One jitted program scattering a quantized block pair into the
+    target banks at ``bid``: ``(kb, vb, qk, sk, qv, sv, bid) -> (kb,
+    vb)``. The banks are DONATED — the import is a scatter-in-place,
+    never a bank copy (the ``serve.kv_unpack`` progaudit contract)."""
+    shape = [int(d) for d in block_shape]
+    dstr = np.dtype(bank_dtype).name
+
+    def unpack(kb, vb, qk, sk, qv, sv, bid):
+        kblk = dequantize_leaf(
+            {_Q8_KEY: 1, "q": qk, "s": sk, "shape": shape, "dtype": dstr})
+        vblk = dequantize_leaf(
+            {_Q8_KEY: 1, "q": qv, "s": sv, "shape": shape, "dtype": dstr})
+        kb = kb.at[:, bid].set(kblk.astype(kb.dtype))
+        vb = vb.at[:, bid].set(vblk.astype(vb.dtype))
+        return kb, vb
+
+    return jax.jit(unpack, donate_argnums=(0, 1))
+
+
+def make_unpack_exact_prog():
+    """Exact-mode import scatter (no dequantize): ``(kb, vb, k_blk,
+    v_blk, bid) -> (kb, vb)``, banks donated."""
+
+    def unpack(kb, vb, kblk, vblk, bid):
+        kb = kb.at[:, bid].set(kblk.astype(kb.dtype))
+        vb = vb.at[:, bid].set(vblk.astype(vb.dtype))
+        return kb, vb
+
+    return jax.jit(unpack, donate_argnums=(0, 1))
+
+
+class KVMigrator:
+    """Per-engine wire state: the jitted pack/unpack programs plus the
+    prefill-side error-feedback residual store.
+
+    Residuals are keyed by the block's CHAIN hash (content-stable —
+    the same key the pool's dedup index and the gateway's prefix
+    directory use), bounded by an LRU of ``max_residuals`` block
+    pairs; the unsealed partial tail block of a prompt has no hash
+    and carries no residual (it is exported at most once per
+    request). Thread contract: calls come from the engine's RPC
+    handler threads under the engine's dispatch lock — the same lock
+    that orders bank-donating programs."""
+
+    def __init__(self, block_shape, bank_dtype, *,
+                 q_block: int | None = DEFAULT_QUANT_BLOCK,
+                 max_residuals: int = 64):
+        self.block_shape = tuple(int(d) for d in block_shape)
+        self.bank_dtype = np.dtype(bank_dtype)
+        self.q_block = q_block
+        self.max_residuals = int(max_residuals)
+        self._pack = make_pack_prog(q_block)
+        self._unpack = make_unpack_prog(self.block_shape, bank_dtype)
+        self._unpack_exact = make_unpack_exact_prog()
+        #: hash -> (res_k, res_v), LRU oldest-first.
+        self._res: collections.OrderedDict[int, tuple] = \
+            collections.OrderedDict()
+
+    # ------------------------------------------------------------- pack
+
+    def pack_block(self, kb, vb, bid: int, h: int | None,
+                   mode: str) -> tuple[dict, int]:
+        """Encode block ``bid`` of banks ``(kb, vb)`` for the wire.
+        Returns ``(payload, nbytes)`` — the payload is codec-
+        marshalable (numpy leaves only)."""
+        if mode not in WIRE_MODES:
+            raise ValueError(f"kv_wire must be one of {WIRE_MODES}, "
+                             f"got {mode!r}")
+        if mode == "exact":
+            # device_get, not np.asarray: the engine packs inside an
+            # armed hot_region, where only EXPLICIT transfers are
+            # legal — the wire hop IS the contract here.
+            k = np.ascontiguousarray(jax.device_get(kb[:, bid]))
+            v = np.ascontiguousarray(jax.device_get(vb[:, bid]))
+            payload = {"k": _wire_leaf(k), "v": _wire_leaf(v)}
+            return payload, k.nbytes + v.nbytes
+        rk = rv = None
+        if h is not None:
+            rk, rv = self._res.pop(h, (None, None))
+        if rk is None:
+            rk = jnp.zeros(self.block_shape, self.bank_dtype)
+            rv = jnp.zeros(self.block_shape, self.bank_dtype)
+        qk, sk, nrk, qv, sv, nrv = self._pack(kb[:, bid], vb[:, bid],
+                                              rk, rv)
+        if h is not None:
+            self._res[h] = (nrk, nrv)
+            while len(self._res) > self.max_residuals:
+                self._res.popitem(last=False)
+        qk, sk = jax.device_get(qk), jax.device_get(sk)
+        qv, sv = jax.device_get(qv), jax.device_get(sv)
+        payload = {"k": {"q": qk, "s": sk}, "v": {"q": qv, "s": sv}}
+        return payload, (qk.nbytes + sk.nbytes + qv.nbytes + sv.nbytes)
+
+    # ----------------------------------------------------------- unpack
+
+    def unpack_block(self, kb, vb, payload: dict, bid: int, mode: str):
+        """Scatter one wire payload into banks at ``bid``; returns the
+        new ``(kb, vb)`` (the old ones are donated)."""
+        if mode == "exact":
+            return self._unpack_exact(
+                kb, vb, jnp.asarray(_unwire_leaf(payload["k"])),
+                jnp.asarray(_unwire_leaf(payload["v"])),
+                jnp.int32(bid))
+        pk, pv = payload["k"], payload["v"]
+        return self._unpack(
+            kb, vb, jnp.asarray(pk["q"]), jnp.asarray(pk["s"]),
+            jnp.asarray(pv["q"]), jnp.asarray(pv["s"]), jnp.int32(bid))
+
+    # -------------------------------------------------------- residuals
+
+    def residual_count(self) -> int:
+        return len(self._res)

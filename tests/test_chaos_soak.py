@@ -819,6 +819,138 @@ def test_elastic_soak_scale_seams_under_gateway_chaos():
             state.close()
 
 
+def test_disagg_migration_soak_under_wire_chaos():
+    """The migration soak (ISSUE 16): a prefill-class and a
+    decode-class paged engine behind the disaggregated gateway over
+    REAL sockets, under a seeded plan that drops, delays, and
+    truncates the KV migration wire (``serve.migrate``) while a
+    mixed shared-prefix load runs through. Invariants:
+
+    - zero requests lost AND zero tokens wrong: every request returns
+      the bit-exact greedy tokens of a solo decode — a migration hit
+      by a drop or a truncated manifest lands on the decode replica's
+      LOCAL prefill fallback (slower, never incorrect), a delayed
+      wire just finishes late;
+    - both engines unwind clean (no parked export, no pinned import
+      reservation — the ``migration-stall`` rule's failure mode);
+    - every injected fault drains to a paired recovery
+      (``chaos.unrecovered() == {}``): the fallback beacons its own
+      recovery and clean migrations pair the rest."""
+    import jax
+    import jax.numpy as jnp
+
+    from ptype_tpu import actor as actor_mod
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.serve_engine import PagedGeneratorActor
+
+    tiny = tfm.preset("tiny", dtype=jnp.float32)
+    params = jax.jit(
+        lambda r: tfm.init_params(r, tiny))(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(16)
+    # Shared 48-token prefix (3 sealed blocks at block_tokens=16) with
+    # per-request tails: the dedup path and the directory both engage.
+    base = [int(t) for t in rng.integers(1, 5000, 48)]
+    prompts = [np.asarray([base + [101 + i] * 4], np.int32)
+               for i in range(6)]
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("serve.migrate", "drop", times=1),
+        FaultSpec("serve.migrate", "delay", after=1, times=1,
+                  delay_s=0.02),
+        FaultSpec("serve.migrate", "truncate", after=2, times=1),
+    ], seed=16, name="migration-soak"))
+    actors, servers, regs = [], [], []
+    gw = None
+    # Real TCP end to end, matching the other serving soaks.
+    with mock.patch.object(actor_mod, "lookup_local",
+                           lambda a, p: None):
+        try:
+            for name, cls in (("pre0", "prefill"),
+                              ("dec0", "decode")):
+                a = PagedGeneratorActor(
+                    tiny, params=params, n_slots=2, block_tokens=16,
+                    prefill_chunk=32, serve_class=cls,
+                    metrics_registry=MetricsRegistry())
+                s = ActorServer("127.0.0.1", 0)
+                s.register(a, "Generator")
+                s.serve()
+                # Hold the registration: it carries the lease
+                # heartbeat (discarding it expires the replica).
+                regs.append(registry.register(
+                    "llm-mig-soak", name, "127.0.0.1", s.port))
+                actors.append(a)
+                servers.append(s)
+            chaos.pause()
+            # Solo greedy references double as the compile warm-up,
+            # OFF the soak clock.
+            refs = [np.asarray(actors[0].Generate(p, 8))
+                    for p in prompts]
+            chaos.resume()
+            gw = InferenceGateway(
+                registry, "llm-mig-soak",
+                GatewayConfig(probe_interval_s=0.1,
+                              probe_timeout_s=2.0,
+                              default_deadline_s=60.0,
+                              disagg=True, kv_wire="exact"),
+                metrics_registry=MetricsRegistry())
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and not {"prefill", "decode"} <= {
+                       r.serve_class()
+                       for r in gw.pool.healthy()}):
+                time.sleep(0.05)
+            assert {"prefill", "decode"} <= {
+                r.serve_class() for r in gw.pool.healthy()}
+
+            for p, ref in zip(prompts, refs):
+                out = np.asarray(gw.generate(p, max_new_tokens=8))
+                np.testing.assert_array_equal(out, ref)
+            fired = [e for e in plan.fired()
+                     if e.site == "serve.migrate"]
+            assert len(fired) == 3, plan.trace()
+            assert {e.action for e in fired} == {
+                "drop", "delay", "truncate"}
+            # Settle: keep offering work until every fault pairs.
+            deadline = time.monotonic() + 10
+            i = 0
+            while (chaos.unrecovered()
+                   and time.monotonic() < deadline):
+                p = prompts[i % len(prompts)]
+                out = np.asarray(gw.generate(p, max_new_tokens=8))
+                np.testing.assert_array_equal(
+                    out, refs[i % len(refs)])
+                i += 1
+            assert chaos.unrecovered() == {}, (
+                f"unpaired: {chaos.unrecovered()}: {plan.trace()}")
+            # Nothing parked, nothing leaked: the stall rule's
+            # failure mode never materializes after the dust settles.
+            for a in actors:
+                assert a.pool.check_invariants() == []
+                assert a.Info()["migrate_inflight"] == 0
+        except BaseException:
+            print(f"\nMIGRATION SOAK FAILED; plan: {plan.to_json()}")
+            raise
+        finally:
+            chaos.disarm()
+            if gw is not None:
+                gw.close()
+            for r in regs:
+                r.close()
+            for s in servers:
+                s.close()
+            for a in actors:
+                a.close()
+            state.close()
+
+
 # --------------------------------------------------- health plane (ISSUE 5)
 
 

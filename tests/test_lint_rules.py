@@ -852,3 +852,85 @@ def test_package_is_pt012_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt012 = [f for f in findings if "PT012" in f]
     assert not pt012, pt012
+
+
+# --------------------------------------------------------------- PT021
+
+
+PT021_RAW_WIRE = (
+    "from ptype_tpu.parallel import collectives\n"
+    "def ship(kb, bid, res):\n"
+    "    w, r = collectives.quantize_leaf(kb[:, bid], 128, res)\n"
+    "    blk = collectives.dequantize_leaf(w)\n"
+    "    return blk, r\n")
+
+
+def test_pt021_flags_raw_kv_wire_in_serve_engine(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sneak21.py",
+                      PT021_RAW_WIRE)
+    assert sum("PT021" in f for f in findings) == 2, findings
+
+
+def test_pt021_flags_aliased_and_from_import_forms(tmp_path):
+    src = ("import ptype_tpu.parallel.collectives as coll\n"
+           "from ptype_tpu.parallel import collectives as cc\n"
+           "from ptype_tpu.parallel.collectives import (\n"
+           "    quantize_leaf as qz, dequantize_leaf)\n"
+           "def ship(kb, res):\n"
+           "    a = coll.quantize_leaf(kb, 128, res)\n"
+           "    b = cc.dequantize_leaf(a)\n"
+           "    c = qz(kb, 128, res)\n"
+           "    d = dequantize_leaf(b)\n"
+           "    return a, b, c, d\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/forms21.py",
+                      src)
+    assert sum("PT021" in f for f in findings) == 4, findings
+
+
+def test_pt021_silent_in_migration_home_and_outside_serve_engine(
+        tmp_path):
+    # migrate.py IS the wire home; the training plane (parallel/,
+    # train/) and tests use the codec legitimately.
+    for rel in ("ptype_tpu/serve_engine/migrate.py",
+                "ptype_tpu/parallel/zero.py", "ptype_tpu/train/loop.py",
+                "tests/t21.py", "examples/demo21.py"):
+        findings = _check(tmp_path, rel, PT021_RAW_WIRE)
+        assert not any("PT021" in f for f in findings), (rel, findings)
+
+
+def test_pt021_ignores_unrelated_receivers(tmp_path):
+    # A quantize_leaf attr on a non-collectives base and an unbound
+    # bare name are not flagged — the rule tracks the import alias,
+    # conservatively.
+    src = ("def f(codec, kb):\n"
+           "    a = codec.quantize_leaf(kb, 128, None)\n"
+           "    b = kb.dequantize_leaf()\n"
+           "    return a, b\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sim21.py", src)
+    assert not any("PT021" in f for f in findings), findings
+
+
+def test_pt021_honors_noqa(tmp_path):
+    src = ("from ptype_tpu.parallel import collectives\n"
+           "def ship(kb, res):\n"
+           "    return collectives.quantize_leaf(kb, 128, res)"
+           "  # noqa: parity probe\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sup21.py", src)
+    assert not any("PT021" in f for f in findings), findings
+
+
+def test_serve_engine_package_is_pt021_clean():
+    """KV wire serialization has one home (ISSUE 16): no codec calls
+    in serve_engine/ outside migrate.py."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "serve_engine")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt021 = [f for f in findings if "PT021" in f]
+    assert not pt021, pt021

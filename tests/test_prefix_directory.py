@@ -1,0 +1,109 @@
+"""The gateway's global prefix directory (ISSUE 16): content-verified
+chain-hash lookup (collision = miss, the ``BlockPool.lookup`` contract
+fleet-wide), eviction coherence (a replica whose pool churned — or
+restarted — drops out of the directory BEFORE the router trusts it),
+per-replica LRU bounds, and survival of replica death."""
+
+import numpy as np
+
+from ptype_tpu.gateway import PrefixDirectory
+from ptype_tpu.serve_engine import block_hashes
+
+RNG = np.random.default_rng(21)
+
+
+def _blocks(n, bt=16):
+    """n sealed full blocks: (hashes, contents) off one token run."""
+    toks = [int(t) for t in RNG.integers(1, 5000, n * bt)]
+    hashes = block_hashes(toks, bt)
+    contents = [tuple(toks[i * bt:(i + 1) * bt]) for i in range(n)]
+    return hashes, contents
+
+
+def test_publish_holders_and_content_verified_collision():
+    d = PrefixDirectory()
+    hashes, contents = _blocks(3)
+    assert d.publish("r1", zip(hashes, contents)) == 3
+    d.publish("r2", zip(hashes[:1], contents[:1]))
+    assert d.holders(hashes[0], contents[0]) == ["r1", "r2"]
+    assert d.holders(hashes[2], contents[2]) == ["r1"]
+    # The collision contract: same hash, different tokens = a MISS,
+    # never a wrong route (mirrors BlockPool.lookup's content check).
+    wrong = tuple(t ^ 1 for t in contents[0])
+    assert d.holders(hashes[0], wrong) == []
+    assert d.overlap("r1", hashes, contents) == 3
+    assert d.overlap("r1", hashes, [wrong] + contents[1:]) == 2
+    assert d.overlap("ghost", hashes, contents) == 0
+
+
+def test_eviction_counter_advance_drops_replica_entries():
+    """Eviction coherence: any advance in a replica's kv_evictions
+    means the LRU reclaimed SOMETHING — the directory can't know
+    which block, so it drops all the replica's entries (a stale entry
+    may cost a re-send, never a mis-route)."""
+    d = PrefixDirectory()
+    hashes, contents = _blocks(2)
+    d.publish("r1", zip(hashes, contents))
+    # First observation just records the baseline; None is a no-op.
+    assert not d.note_evictions("r1", None)
+    assert not d.note_evictions("r1", 5)
+    assert not d.note_evictions("r1", 5)  # unchanged: still trusted
+    assert d.n_blocks("r1") == 2
+    assert d.note_evictions("r1", 6)  # the pool churned
+    assert d.n_blocks("r1") == 0
+    assert d.holders(hashes[0], contents[0]) == []
+    # Re-publish after the drop: trusted again at the new baseline.
+    d.publish("r1", zip(hashes, contents))
+    assert not d.note_evictions("r1", 6)
+    assert d.n_blocks("r1") == 2
+
+
+def test_restart_counter_backwards_also_drops():
+    """A replica restarting under the same key comes back with a
+    fresh pool and an eviction counter reset to 0 — observed as the
+    counter going BACKWARDS, which drops the stale entries (the same
+    high-water reset the pool's TTFT drain applies)."""
+    d = PrefixDirectory()
+    hashes, contents = _blocks(2)
+    d.publish("r1", zip(hashes, contents))
+    assert not d.note_evictions("r1", 9)
+    assert d.note_evictions("r1", 0)  # restarted
+    assert d.n_blocks("r1") == 0
+
+
+def test_drop_replica_reaps_entries_and_survives_death():
+    """A dead replica's entries never mis-route (only healthy
+    candidates are scored) and drop_replica reaps them; the OTHER
+    replicas' entries survive untouched."""
+    d = PrefixDirectory()
+    hashes, contents = _blocks(2)
+    d.publish("r1", zip(hashes, contents))
+    d.publish("r2", zip(hashes, contents))
+    d.note_evictions("r1", 3)
+    d.drop_replica("r1")
+    assert d.n_blocks("r1") == 0
+    assert d.holders(hashes[0], contents[0]) == ["r2"]
+    assert d.stats() == {"replicas": {"r2": 2}, "blocks": 2}
+    # Idempotent; and a re-registered r1 starts from a clean slate
+    # (its baseline was reaped with it).
+    d.drop_replica("r1")
+    d.publish("r1", zip(hashes[:1], contents[:1]))
+    assert not d.note_evictions("r1", 0)  # fresh baseline, no drop
+    assert d.n_blocks("r1") == 1
+
+
+def test_per_replica_lru_bound():
+    d = PrefixDirectory(max_blocks=4)
+    hashes, contents = _blocks(6)
+    d.publish("r1", zip(hashes, contents))
+    assert d.n_blocks("r1") == 4
+    # Oldest published fell out; the newest four are addressable.
+    assert d.holders(hashes[0], contents[0]) == []
+    assert d.holders(hashes[5], contents[5]) == ["r1"]
+    # Re-publishing an existing entry refreshes its LRU position: it
+    # outlives three newer arrivals in a 4-deep directory.
+    d.publish("r1", [(hashes[2], contents[2])])
+    h2, c2 = _blocks(3)
+    d.publish("r1", zip(h2, c2))
+    assert d.holders(hashes[2], contents[2]) == ["r1"]
+    assert d.n_blocks("r1") == 4

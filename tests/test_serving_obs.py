@@ -286,6 +286,36 @@ def test_serve_stall_rule_queue_gate_and_threshold():
         ClusterView(_snap(nodes, ts=942.0))) == []
 
 
+def test_migration_stall_rule_requires_sustained_inflight():
+    from ptype_tpu.health import MigrationStallRule
+
+    rule = MigrationStallRule(window_s=60.0)
+
+    def node(inflight_pts, done_pts):
+        return {"series": {"serve.migrate_inflight": inflight_pts,
+                           "serve.migrations": done_pts}}
+
+    held = [[t, 2.0] for t in (950.0, 970.0, 990.0)]
+    flat = [[950.0, 5.0], [990.0, 5.0]]
+    # In flight the whole window, completions flat: the wedge.
+    alerts = rule.evaluate(ClusterView(_snap(
+        {"serve/a:1": node(held, flat)})))
+    assert len(alerts) == 1 and alerts[0].node == "serve/a:1"
+    assert alerts[0].severity == "page"
+    assert "obs serve" in alerts[0].message
+    # Completions advancing: busy, not wedged.
+    moving = [[950.0, 5.0], [990.0, 7.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"serve/a:1": node(held, moving)}))) == []
+    # Drained mid-window (gauge touched zero): the abort landed.
+    drained = [[950.0, 2.0], [970.0, 0.0], [990.0, 1.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"serve/a:1": node(drained, flat)}))) == []
+    # A unified fleet (no gauge at all) never pays a false page.
+    assert rule.evaluate(ClusterView(_snap(
+        {"serve/a:1": {"series": {}}}))) == []
+
+
 def test_default_rules_include_serving_set():
     # Structural serving rules are always armed; the TTFT page is an
     # SLO target only the operator can pick, so like P99Rule it is
@@ -293,7 +323,7 @@ def test_default_rules_include_serving_set():
     # auto-capture profiles) against an arbitrary default.
     names = {r.name for r in default_rules()}
     assert {"kv-pressure", "prefix-hit-collapse",
-            "serve-stall"} <= names
+            "serve-stall", "migration-stall"} <= names
     assert "ttft-p99" not in names
     armed = {r.name for r in default_rules(slo_ttft_ms=2000.0)}
     assert "ttft-p99" in armed
@@ -697,6 +727,40 @@ def test_render_serve_rows_and_skips_non_serving_nodes():
     assert "140" in view and "UNREACHABLE" in view
     empty = render_serve({"ts": 0.0, "nodes": {}, "errors": {}})
     assert "no serving replicas" in empty
+
+
+def test_render_serve_class_column_and_migration_counters():
+    """The disaggregated columns (ISSUE 16): `obs serve` names each
+    replica's serving class and its migration counters; also pins
+    top.py's inline class-name copy to ``SERVE_CLASSES`` /
+    ``SERVE_CLASS_CODES`` (the docstring's sync contract)."""
+    from ptype_tpu.health.top import _SERVE_CLASS_NAMES
+    from ptype_tpu.serve_engine import (SERVE_CLASS_CODES,
+                                        SERVE_CLASSES)
+
+    assert _SERVE_CLASS_NAMES == SERVE_CLASSES
+    assert SERVE_CLASS_CODES == {
+        n: i for i, n in enumerate(_SERVE_CLASS_NAMES)}
+    snap = {"ts": 123.0, "nodes": {
+        "serve/dec:1": {"metrics": {
+            "histograms": {"serve.ttft_ms": {"p99": 40.0}},
+            "gauges": {"serve.queue_depth": 0.0,
+                       "serve.class":
+                           float(SERVE_CLASS_CODES["decode"])},
+            "counters": {"serve.migrations": 7.0,
+                         "serve.migrate_bytes": 2_500_000.0,
+                         "serve.migrate_dedup_hits": 12.0}}},
+        "serve/uni:2": {"metrics": {
+            "histograms": {"serve.ttft_ms": {"p99": 55.0}},
+            "gauges": {"serve.queue_depth": 1.0}, "counters": {}}},
+    }, "errors": {}}
+    view = render_serve(snap)
+    assert "class" in view and "decode" in view
+    assert "7" in view and "2.50" in view and "12" in view
+    # A unified replica (no class gauge, no counters) renders dashes,
+    # not zeros — "never migrated" is not "migrated nothing".
+    uni_row = next(ln for ln in view.splitlines() if "uni" in ln)
+    assert "-" in uni_row and "decode" not in uni_row
 
 
 def test_run_serve_loop_renders_and_returns_engine(coord):

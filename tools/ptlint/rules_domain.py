@@ -1,9 +1,12 @@
-"""PT001–PT012: the house rules, migrated from tools/lint.py.
+"""PT001–PT012 (plus PT021): the house rules.
 
-Each rule guards one architectural seam this repo earned the hard way
-(the full rationale per rule lives in docs/LINTING.md). Migration is
-behavior-preserving: the golden-output test in tests/test_ptlint.py
-pins these against the old walker's findings on a fixture tree.
+PT001–PT012 were migrated from tools/lint.py; each rule guards one
+architectural seam this repo earned the hard way (the full rationale
+per rule lives in docs/LINTING.md). Migration is behavior-preserving:
+the golden-output test in tests/test_ptlint.py pins these against the
+old walker's findings on a fixture tree. PT021 (KV wire serialization
+outside the migration home, ISSUE 16) joins them here because it is
+the same single-home family as PT008/PT011.
 """
 
 from __future__ import annotations
@@ -472,4 +475,81 @@ def check_pt012(ctx: FileContext) -> list[Finding]:
                 "neither drain nor replace a replica it didn't "
                 "build; construct through reconciler.replica."
                 "serve_actor / ReplicaHost"))
+    return findings
+
+
+# --------------------------------------------------------------- PT021
+
+
+class _KVWireCheck(ast.NodeVisitor):
+    """KV wire serialization outside the migration home.
+
+    ``quantize_leaf``/``dequantize_leaf`` are the int8+EF codec's only
+    entry points; in ``serve_engine/`` they may appear in exactly ONE
+    module — ``migrate.py``, the wire between serving classes. A
+    second call site forks the wire format: its residual store and the
+    migrator's drift apart, and the error-feedback contract (repeated
+    transfers of the same block don't accumulate bias) silently
+    breaks. Same single-home discipline PT008 applies to collectives
+    and PT011 to sampling. Catches the direct call, the module-
+    attribute form (``collectives.quantize_leaf`` under any alias),
+    and aliased from-imports.
+    """
+
+    _VERBS = frozenset({"quantize_leaf", "dequantize_leaf"})
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.mods: set[str] = set()
+        self.funcs: dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "ptype_tpu.parallel.collectives" and a.asname:
+                self.mods.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module in ("ptype_tpu.parallel", "ptype_tpu"):
+            for a in node.names:
+                if a.name == "collectives":
+                    self.mods.add(a.asname or "collectives")
+        elif node.module in ("ptype_tpu.parallel.collectives",
+                             "ptype_tpu.serve_engine.migrate"):
+            for a in node.names:
+                if a.name in self._VERBS:
+                    self.funcs[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, verb: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT021",
+            f"{verb} on the serving path outside serve_engine/"
+            f"migrate.py — KV wire serialization has ONE home (the "
+            f"migration module); a second codec call site forks the "
+            f"wire format and breaks the per-block error-feedback "
+            f"contract (residuals keyed by chain hash, one store)"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in self._VERBS:
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id in (
+                    self.mods or {"collectives"}):
+                self._flag(node, fn.attr)  # collectives.quantize_leaf
+            elif (isinstance(base, ast.Attribute)
+                    and base.attr == "collectives"):
+                self._flag(node, fn.attr)  # parallel.collectives.q...
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node, self.funcs[fn.id])
+        self.generic_visit(node)
+
+
+@rule("PT021", "KV wire serialization outside the migration home",
+      applies=lambda ctx: (ctx.in_pkg and ctx.in_dir("serve_engine")
+                           and ctx.basename != "migrate.py"))
+def check_pt021(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _KVWireCheck(ctx, findings).visit(ctx.tree)
     return findings
