@@ -210,6 +210,11 @@ def worker_main() -> None:
         "zero_opt_mem_mb": None,
         "zero_step_ms": None,
         "zero_note": None,
+        "zero2_grad_mem_mb": None,
+        "zero3_param_mem_mb": None,
+        "zero_ladder_note": None,
+        "reshard_resume_steps": None,
+        "reshard_note": None,
         "profile_overhead_pct": None,
         "profile_note": None,
         "lockcheck_overhead_pct": None,
@@ -414,6 +419,30 @@ def _zero_hostmesh() -> tuple[dict | None, str]:
         STORE_PROBE_TIMEOUT)
 
 
+def _zero_ladder_hostmesh() -> tuple[dict | None, str]:
+    """The full ZeRO ladder (ISSUE 17): per-replica resident bytes for
+    moments / grads / params at stages 0-3 — fills
+    ``zero2_grad_mem_mb`` / ``zero3_param_mem_mb``."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.parallel.mesh import build_mesh\n"
+        "from ptype_tpu.train.store_dp import measure_zero_ladder\n"
+        "print(json.dumps(measure_zero_ladder(build_mesh({'data': 8}),"
+        " steps=3)))\n",
+        STORE_PROBE_TIMEOUT)
+
+
+def _reshard_hostmesh() -> tuple[dict | None, str]:
+    """Live mid-run reshard 8→4 vs the checkpoint-restore round trip
+    (ISSUE 17) — fills ``reshard_resume_steps`` (recovery wall time in
+    steady-step units)."""
+    return _hostmesh_probe(
+        "import json\n"
+        "from ptype_tpu.train.store_dp import measure_reshard\n"
+        "print(json.dumps(measure_reshard(steps=3)))\n",
+        STORE_PROBE_TIMEOUT)
+
+
 def _profile_hostmesh() -> tuple[dict | None, str]:
     """Capture-disabled cost of the profiling plane on the host-mesh
     store-DP loop — fills ``profile_overhead_pct`` (ISSUE 8
@@ -560,6 +589,36 @@ def _patch_store_metric(rec: dict) -> None:
             f"{probe['zero_step_ms']} ms; loss "
             f"{probe['final_loss_repl']} vs {probe['final_loss_zero']}"
             f"; {note}"
+            if probe else note)
+    if rec.get("zero2_grad_mem_mb") is None:
+        # The rest of the ladder (ISSUE 17): ZeRO-2 scattered grads and
+        # ZeRO-3 resident param shards, per replica.
+        probe, note = _zero_ladder_hostmesh()
+        rec["zero2_grad_mem_mb"] = (
+            probe["zero2_grad_mem_mb"] if probe else None)
+        rec["zero3_param_mem_mb"] = (
+            probe["zero3_param_mem_mb"] if probe else None)
+        rec["zero_ladder_note"] = (
+            f"grads {probe['repl_grad_mem_mb']} → "
+            f"{probe['zero2_grad_mem_mb']} MB (zero-2), params "
+            f"{probe['repl_param_mem_mb']} → "
+            f"{probe['zero3_param_mem_mb']} MB (zero-3) per replica, "
+            f"{probe['n_replicas']} replicas, loss identical across "
+            f"rungs; {note}"
+            if probe else note)
+    if rec.get("reshard_resume_steps") is None:
+        # Live mid-run reshard vs the checkpoint-restore round trip
+        # it replaces (ISSUE 17).
+        probe, note = _reshard_hostmesh()
+        rec["reshard_resume_steps"] = (
+            probe["reshard_resume_steps"] if probe else None)
+        rec["reshard_note"] = (
+            f"8→4 live reshard {probe['reshard_ms']} ms, training "
+            f"again in {probe['live_resume_ms']} ms "
+            f"({probe['reshard_resume_steps']} steps) vs checkpoint "
+            f"restore {probe['ckpt_resume_ms']} ms "
+            f"({probe['ckpt_resume_steps']} steps) — "
+            f"{probe['resume_speedup']}x; {note}"
             if probe else note)
     if rec.get("profile_overhead_pct") is None:
         # Profiling plane idle cost on the same host-mesh loop, plus
@@ -747,6 +806,26 @@ def zero_main() -> None:
     breakdown = ledger.summary()["step_breakdown"]
     _emit({"probe": "zero_breakdown", "step_breakdown": breakdown})
 
+    # The full ladder + the live-reshard-vs-checkpoint race (ISSUE 17).
+    from ptype_tpu.train.store_dp import (measure_reshard,
+                                          measure_zero_ladder)
+
+    ladder = measure_zero_ladder(mesh, steps=4)
+    _emit({"probe": "zero_ladder", **ladder})
+    reshard = measure_reshard(steps=3)
+    _emit({"probe": "zero_reshard", **reshard})
+    print(f"\n  ZeRO ladder ({n}-device host mesh, per replica):")
+    print(f"  {'mode':<7}{'opt MB':>9}{'grad MB':>9}"
+          f"{'param MB':>10}{'step ms':>9}{'loss':>10}")
+    for name, r in ladder["ladder"].items():
+        print(f"  {name:<7}{r['opt_mem_mb']:>9}{r['grad_mem_mb']:>9}"
+              f"{r['param_mem_mb']:>10}{r['step_ms']:>9}"
+              f"{r['final_loss']:>10}")
+    print(f"  live reshard 8→4: {reshard['reshard_ms']} ms, training "
+          f"again in {reshard['reshard_resume_steps']} steps vs "
+          f"{reshard['ckpt_resume_steps']} steps via checkpoint "
+          f"restore ({reshard['resume_speedup']}x)\n")
+
     _emit({
         "metric": "zero-1 sharded optimizer update "
                   f"({n}-device host mesh)",
@@ -760,6 +839,9 @@ def zero_main() -> None:
         "optimizer_ms": breakdown.get("optimizer_ms"),
         "final_loss_zero": exact["final_loss_zero"],
         "final_loss_repl": exact["final_loss_repl"],
+        "zero2_grad_mem_mb": ladder["zero2_grad_mem_mb"],
+        "zero3_param_mem_mb": ladder["zero3_param_mem_mb"],
+        "reshard_resume_steps": reshard["reshard_resume_steps"],
     })
 
 

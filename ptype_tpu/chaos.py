@@ -50,6 +50,14 @@ site                    actions
                         drive it past its deadline so the reconciler's
                         escalation path fires) / ``delay``
                         (reconciler/replica.py)
+``train.reshard``       ``drop`` (abort the live reshard mid-move — the
+                        atomic swap means the OLD plan/mesh/arrays are
+                        fully intact and the caller retries:
+                        ``ElasticZeroTrainer.recover``) / ``delay`` /
+                        ``wedge`` (stall one bucket's re-place — drives
+                        the ``reshard-stall`` health rule)
+                        (parallel/zero.py ``ZeroState.reshard``; keyed
+                        by ``bucketNNNNN``)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

@@ -697,6 +697,12 @@ class ZeroCheckpoint:
                         for i in range(n_buckets)},
             "count": 0,
         }
+        if getattr(zero_state, "pflat", None) is not None:
+            # ZeRO-3: the restoring state holds resident param shards,
+            # so pull the saved ones too (state_tree emits them on
+            # save; Checkpointer.restore only loads skeleton leaves).
+            skeleton["pbuckets"] = {f"{i:05d}": {"p": 0}
+                                    for i in range(n_buckets)}
         tree = self._ckpt.restore(skeleton, step=step)
         zero_state.load_state_tree(tree, saved_plan)
         return step

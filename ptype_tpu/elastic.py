@@ -18,6 +18,16 @@ restore (resharded) → resume.
   workers' device ordinals, restores into the new shardings, and
   recompiles the step. The Checkpointer's reshard-on-restore does the
   heavy lifting (checkpoint.py).
+- :class:`ElasticZeroTrainer` — the LIVE half of the story (ISSUE 17):
+  wraps the store-DP ZeRO trainer, and on churn ``recover()`` reshards
+  the resident sharded state in memory
+  (``StoreDPTrainer.reshard`` → ``ZeroState.reshard`` — strip old tail
+  pads, re-pad, re-place, moments bit-preserved) instead of the
+  checkpoint round trip. A reconciler-ordered trainer scale event
+  (``ProcessLauncher(kind="custom")`` launching/stopping trainer
+  replicas) reaches the same path: the scaled replica set changes the
+  registry membership, the detector reports it, and the next ``step``
+  raises :class:`MembershipChanged`.
 - Fault injection for tests/drills: ``inject_loss`` revokes a
   registration the way a SIGKILL would (lease revoke ⇒ immediate
   expiry), so the whole path is exercisable in-process.
@@ -134,6 +144,24 @@ def inject_loss(registration) -> None:
     registration.close(revoke=True)
 
 
+def devices_from_nodes(detector: FailureDetector) -> list:
+    """The survivor device set: every ordinal the registered workers
+    advertise, resolved against this process's visible devices."""
+    nodes = detector.current()
+    ordinals: list[int] = []
+    for n in nodes:
+        ordinals.extend(n.device_ordinals)
+    if not ordinals:
+        raise ClusterError(
+            "elastic: surviving workers advertise no devices")
+    by_id = {d.id: d for d in jax.devices()}
+    missing = [o for o in ordinals if o not in by_id]
+    if missing:
+        raise ClusterError(
+            f"elastic: registry devices {missing} not visible")
+    return [by_id[o] for o in sorted(set(ordinals))]
+
+
 class ElasticTrainer:
     """GSPMD trainer + failure detector + checkpoint-reshard-resume."""
 
@@ -155,19 +183,7 @@ class ElasticTrainer:
     # ------------------------------------------------------------ build
 
     def _devices_from_nodes(self) -> list:
-        nodes = self.detector.current()
-        ordinals: list[int] = []
-        for n in nodes:
-            ordinals.extend(n.device_ordinals)
-        if not ordinals:
-            raise ClusterError(
-                "elastic: surviving workers advertise no devices")
-        by_id = {d.id: d for d in jax.devices()}
-        missing = [o for o in ordinals if o not in by_id]
-        if missing:
-            raise ClusterError(
-                f"elastic: registry devices {missing} not visible")
-        return [by_id[o] for o in sorted(set(ordinals))]
+        return devices_from_nodes(self.detector)
 
     def _build(self, fresh: bool) -> None:
         from ptype_tpu.parallel.mesh import build_mesh
@@ -242,3 +258,95 @@ class ElasticTrainer:
                  kv={"step": saved, "old_devices": old,
                      "new_devices": self.mesh.devices.size})
         return {"restored_step": saved, "devices": self.mesh.devices.size}
+
+
+class ElasticZeroTrainer:
+    """Store-DP ZeRO trainer + failure detector + LIVE reshard-resume.
+
+    The elastic story WITHOUT the restore round trip: the resident
+    state is already sharded over the flat bucket space
+    (parallel/zero.py), so a survivor-set change is a re-pad +
+    re-place (``StoreDPTrainer.reshard``), not a checkpoint cycle.
+    ``step`` raises :class:`MembershipChanged` on churn; ``recover``
+    reshards onto the survivor mesh and the caller simply retries the
+    step — the step budget lost to a replica kill is the ONE step that
+    raised, nothing more.
+    """
+
+    def __init__(self, cfg, registry, service_name: str,
+                 mesh_axis: str = "data", zero=2,
+                 rng: jax.Array | None = None, wire=None,
+                 zero_hparams=None):
+        from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.tensorstore import TensorStore
+        from ptype_tpu.train.store_dp import StoreDPTrainer
+
+        self.cfg = cfg
+        self.mesh_axis = mesh_axis
+        self.detector = FailureDetector(registry, service_name)
+        self.detector.wait_seeded()
+        devices = devices_from_nodes(self.detector)
+        mesh = build_mesh({mesh_axis: len(devices)}, devices=devices)
+        store = TensorStore(mesh, axis=mesh_axis, wire=wire)
+        self.trainer = StoreDPTrainer(cfg, store, rng=rng, zero=zero,
+                                      zero_hparams=zero_hparams)
+        log.info("elastic zero trainer up",
+                 kv={"devices": len(devices),
+                     "zero_stage": self.trainer.zero_stage})
+
+    # ------------------------------------------------------------- step
+
+    def step(self, batch: dict) -> dict:
+        if self.detector.changed:
+            lost, joined = self.detector.drain_changes()
+            raise MembershipChanged(lost, joined)
+        return self.trainer.step(batch)
+
+    def params(self) -> dict:
+        return self.trainer.params()
+
+    # ---------------------------------------------------------- recover
+
+    def recover(self, reshard_retries: int = 3) -> dict:
+        """Live reshard after :class:`MembershipChanged`.
+
+        Same bounded drain-and-rebuild loop as
+        :meth:`ElasticTrainer.recover` (churn keeps arriving mid-
+        recover), but the rebuild is ``trainer.reshard`` — in memory,
+        atomic, moments bit-preserved. The reshard itself retries
+        ``reshard_retries`` times: a mid-reshard fault (the
+        ``train.reshard`` chaos seam's drop) raises with the OLD
+        plan/mesh/arrays fully intact, so the retry runs against
+        consistent state."""
+        old = int(self.trainer.n_workers)
+        from ptype_tpu.parallel.mesh import build_mesh
+
+        info: dict = {}
+        for _ in range(5):
+            self.detector.drain_changes()
+            devices = devices_from_nodes(self.detector)
+            mesh = build_mesh({self.mesh_axis: len(devices)},
+                              devices=devices)
+            last: Exception | None = None
+            for attempt in range(reshard_retries):
+                try:
+                    info = self.trainer.reshard(mesh, self.mesh_axis)
+                    last = None
+                    break
+                except ClusterError as e:
+                    last = e
+                    log.warning("live reshard attempt failed; retrying",
+                                kv={"attempt": attempt,
+                                    "error": str(e)})
+            if last is not None:
+                raise last
+            if not self.detector.changed:
+                break
+        chaos.note_ok("elastic.recover",
+                      f"{old}->{self.trainer.n_workers}")
+        log.info("elastic live reshard complete",
+                 kv={"old_devices": old,
+                     "new_devices": self.trainer.n_workers,
+                     "reshard_ms": info.get("reshard_ms")})
+        return {"old_devices": old,
+                "new_devices": self.trainer.n_workers, **info}

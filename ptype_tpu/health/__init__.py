@@ -27,7 +27,8 @@ from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
                                     MemoryGrowthRule, MfuGapRule,
                                     MigrationStallRule,
                                     P99Rule, PrefixHitCollapseRule,
-                                    RecompileStormRule, Rule,
+                                    RecompileStormRule,
+                                    ReshardStallRule, Rule,
                                     ServeStallRule, StallRule,
                                     StragglerRule, TtftRule,
                                     default_rules)
@@ -50,7 +51,8 @@ __all__ = [
     "P99Rule", "StallRule", "StragglerRule", "LossRule",
     "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "TtftRule",
     "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
-    "RecompileStormRule", "MigrationStallRule", "default_rules",
+    "RecompileStormRule", "MigrationStallRule", "ReshardStallRule",
+    "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
     "render_scale", "run_scale", "render_jit", "run_jit",
 ]

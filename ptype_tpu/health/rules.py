@@ -786,6 +786,55 @@ class MigrationStallRule(Rule):
         return out
 
 
+class ReshardStallRule(Rule):
+    """A live elastic reshard wedged mid-move: a node's
+    ``train.reshard_inflight`` gauge stayed above zero for the whole
+    window while its ``train.reshards`` completion counter did not
+    advance. The trainer raises the gauge before the re-pad/re-place
+    loop and only clears it after the atomic swap lands
+    (``StoreDPTrainer.reshard``), so a stuck gauge means the move is
+    stalled (a wedged bucket re-place, a retry loop that keeps losing)
+    and training is NOT stepping — the survivor set is paid for but
+    idle. Structural: the series only exists on trainers that armed a
+    reshard, so steady-state fleets never page. Start at ``obs
+    scale``/the trace plane first — the ``train.reshard`` span (and
+    its per-bucket chaos trace, if a drill is armed) names the bucket
+    the move died in."""
+
+    name = "reshard-stall"
+    severity = "page"
+
+    def __init__(self, window_s: float = 60.0,
+                 inflight_series: str = "train.reshard_inflight",
+                 done_series: str = "train.reshards"):
+        self.window_s = float(window_s)
+        self.inflight_series = inflight_series
+        self.done_series = done_series
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            pts = [p for p in view.series(node, self.inflight_series)
+                   if p[0] >= view.now - self.window_s]
+            if len(pts) < 2 or min(v for _, v in pts) <= 0:
+                continue  # no reshard, briefly sampled, or completed
+            done = counter_delta(
+                view.series(node, self.done_series),
+                self.window_s, view.now)
+            if done > 0:
+                continue  # reshards ARE landing; just churning
+            out.append(self._alert(
+                node,
+                f"a live reshard has been in flight for "
+                f"{self.window_s:.0f}s without completing — training "
+                f"is parked on the survivor set; read `obs scale` and "
+                f"the train.reshard trace span first (they name the "
+                f"bucket the move stalled in), then the elastic "
+                f"recover log for retry exhaustion",
+                value=pts[-1][1], threshold=0.0))
+        return out
+
+
 def default_rules(service: str = "llm",
                   slo_p99_ms: float | None = None,
                   slo_ttft_ms: float | None = None) -> list[Rule]:
@@ -794,10 +843,12 @@ def default_rules(service: str = "llm",
     nobody but the operator can pick, so like ``P99Rule`` the TTFT
     page is opt-in (a healthy prompt-heavy fleet over an arbitrary
     default would page, and auto-capture profiles, out of the box).
-    The structural serving rules (kv-pressure / prefix-hit-collapse /
-    serve-stall / migration-stall) are always in the set — they key on ``serve.*`` /
-    ``kv.*`` series only a serving replica emits and need no target,
-    so a training fleet never pays a false page for their presence."""
+    The structural rules (kv-pressure / prefix-hit-collapse /
+    serve-stall / migration-stall / reshard-stall) are always in the
+    set — they key on ``serve.*`` / ``kv.*`` / reshard-armed
+    ``train.*`` series only the relevant plane emits and need no
+    target, so other fleets never pay a false page for their
+    presence."""
     rules: list[Rule] = [
         BurnRateRule(service=service),
         StallRule(),
@@ -811,6 +862,7 @@ def default_rules(service: str = "llm",
         ServeStallRule(),
         RecompileStormRule(),
         MigrationStallRule(),
+        ReshardStallRule(),
     ]
     if slo_ttft_ms is not None:
         rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))

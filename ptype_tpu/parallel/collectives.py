@@ -877,6 +877,14 @@ class ScatteredTree:
     over ``axis`` — each device owns ``elems/n`` contiguous elements
     (the ZeRO/FSDP resident form). :meth:`gather` reassembles the full
     tree via one allgather-reshard per bucket.
+
+    This flat-bucket layout is the repo's ONE resident sharded form
+    (ISSUE 17): grads here, Adam moments and ZeRO-3 param shards in
+    ``zero.ZeroState`` all live as ``(elems,)`` flats over the same
+    ``ShardPlan`` slot space. Because slot offsets are replica-count
+    independent (only tail pads depend on n), live resharding across
+    a survivor set is strip-pad / re-pad / re-place — no layout
+    translation (``ZeroState.reshard``).
     """
 
     treedef: object

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu import chaos, logs
@@ -354,6 +355,49 @@ class TensorStore:
         self._publish(key)
         chaos.note_ok("store.push", key)
         return value
+
+    def commit_sharded(self, key: str, flat: jax.Array) -> jax.Array:
+        """Commit an ALREADY-PLACED ``P(axis)`` flat under ``key`` with
+        push epoch semantics (epoch bumps, manifest publishes) — the
+        ZeRO-3 trainer's per-step resident-param commit. No collective,
+        no re-placement: the caller's fused apply produced the flat in
+        its final sharding already."""
+        return self._commit(key, flat, Binding(P(self.axis)))
+
+    def reshard(self, mesh: Mesh, axis: str | None = None) -> None:
+        """Re-home the store on a new (survivor) mesh — the live
+        elastic reshard's store leg. Replicated entries are re-placed
+        onto the new mesh with their epochs preserved; axis-SHARDED
+        entries (scatter-path grad flats, ZeRO-3 param flats) are
+        dropped, because their payloads are padded for the OLD replica
+        count — their owner re-commits them in the new layout (the
+        trainer re-pads via ``ZeroState.reshard``). Error-feedback
+        residuals reset for the same reason: they are laid out per the
+        old contribution count."""
+        axis = axis or self.axis
+        with self._lock:
+            entries = list(self._entries.items())
+        for key, entry in entries:
+            if entry.binding.spec == P():
+                arr = jax.device_put(np.asarray(entry.value),
+                                     NamedSharding(mesh, P()))
+                with self._lock:
+                    cur = self._entries.get(key)
+                    if cur is entry:
+                        self._entries[key] = _Entry(
+                            arr, entry.epoch, entry.binding,
+                            self._stamp_locked(key))
+            else:
+                with self._lock:
+                    self._entries.pop(key, None)
+                    self._stamp_locked(key)
+        with self._lock:
+            self._residuals.clear()
+        # mesh/axis are rebind-on-reshard like __init__'s bare writes:
+        # the trainer quiesces pushes across a reshard (the step that
+        # raised never ran), so no concurrent reader sees the old mesh.
+        self.mesh = mesh
+        self.axis = axis
 
     # -------------------------------------------------------------- tree
 

@@ -250,7 +250,9 @@ def audit(fn, args, *, name: str = "", donate_argnums=(),
 _REGISTRY: dict[str, Callable[[], AuditReport]] = {}
 
 DEFAULT_PROGRAMS = (
-    "train.grads", "zero.shard_apply", "collectives.bucket_allreduce",
+    "train.grads", "zero.shard_apply", "zero1.shard_apply",
+    "zero2.grad_reduce_scatter", "zero3.param_gather",
+    "zero3.shard_apply", "collectives.bucket_allreduce",
     "collectives.bucket_reduce_scatter", "serve.decode_step",
     "serve.spec_window", "serve.kv_pack", "serve.kv_unpack",
 )
@@ -344,6 +346,113 @@ def _build_zero_apply():
         # the fusion regressed to per-leaf assembly.
         return audit(fn, avals, name="zero.shard_apply",
                      expect_collectives={"all_gather": 1})
+
+    return builder
+
+
+def _build_zero1_apply():
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import zero as zero_mod
+        from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.train.trainer import default_optimizer_hparams
+
+        n = jax.device_count()
+        mesh = build_mesh({"data": n})
+        shapes = ((4, 4), (8,))
+        total = 24
+        pad = (-total) % n
+        elems = total + pad
+        fn = zero_mod._shard_apply_full_fn(
+            mesh, "data", shapes, "float32", pad,
+            default_optimizer_hparams())
+        f32 = jnp.float32
+        avals = ([jax.ShapeDtypeStruct(s, f32) for s in shapes] * 2
+                 + [jax.ShapeDtypeStruct((elems,), f32)] * 3
+                 + [jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), f32)])
+        # The ZeRO-1 rung: full grads in, ONE param all_gather out —
+        # same fusion contract as zero.shard_apply.
+        return audit(fn, avals, name="zero1.shard_apply",
+                     expect_collectives={"all_gather": 1})
+
+    return builder
+
+
+def _build_zero2_grad_rs():
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import collectives as coll
+        from ptype_tpu.parallel.mesh import build_mesh
+
+        n = jax.device_count()
+        mesh = build_mesh({"data": n})
+        shapes = ((4, 4), (8,))
+        pad = (-24) % n
+        avals = [jax.ShapeDtypeStruct((n, *s), jnp.float32)
+                 for s in shapes]
+        fn = coll._bucket_reduce_scatter_fn(
+            mesh, "data", "mean", shapes, "float32", pad, None,
+            False, q_block=None)
+        # ZeRO-2's whole point: grads arrive shard-resident from ONE
+        # reduce_scatter per bucket and are NEVER allgathered — a
+        # stray all_gather here silently rebuilds the full-grad
+        # memory the rung exists to shed.
+        return audit(fn, avals, name="zero2.grad_reduce_scatter",
+                     expect_collectives={"reduce_scatter": 1,
+                                         "all_gather": 0})
+
+    return builder
+
+
+def _build_zero3_gather():
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import zero as zero_mod
+        from ptype_tpu.parallel.mesh import build_mesh
+
+        n = jax.device_count()
+        mesh = build_mesh({"data": n})
+        shapes = ((4, 4), (8,))
+        total = 24
+        pad = (-total) % n
+        fn = zero_mod._bucket_gather_fn(mesh, "data", shapes,
+                                        "float32", pad)
+        aval = jax.ShapeDtypeStruct((total + pad,), jnp.float32)
+        # The just-in-time param materialization: ONE all_gather per
+        # bucket, however many leaves it unpacks to — per-leaf gathers
+        # un-fuse the forward's dispatch overlap.
+        return audit(fn, (aval,), name="zero3.param_gather",
+                     expect_collectives={"all_gather": 1})
+
+    return builder
+
+
+def _build_zero3_apply():
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import zero as zero_mod
+        from ptype_tpu.train.trainer import default_optimizer_hparams
+
+        n = jax.device_count()
+        total = 24
+        elems = total + (-total) % n
+        fn = zero_mod._shard_apply3_fn(default_optimizer_hparams())
+        f32 = jnp.float32
+        flat = jax.ShapeDtypeStruct((elems,), f32)
+        args = (flat, flat, flat, flat, flat,
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), f32))
+        # ZeRO-3's update is purely elementwise on the resident flats
+        # (the one all_gather lives in zero3.param_gather), and the
+        # param/moment buffers are donated — a dropped donation
+        # doubles the rung's resident footprint mid-step.
+        return audit(fn, args, name="zero3.shard_apply",
+                     donate_argnums=(0, 2, 3), expect_collectives=0)
 
     return builder
 
@@ -536,6 +645,10 @@ def register_default_programs(preset: str = "tiny", batch: int = 4,
     operator surface."""
     register("train.grads", _build_train_grads(preset, batch, seq))
     register("zero.shard_apply", _build_zero_apply())
+    register("zero1.shard_apply", _build_zero1_apply())
+    register("zero2.grad_reduce_scatter", _build_zero2_grad_rs())
+    register("zero3.param_gather", _build_zero3_gather())
+    register("zero3.shard_apply", _build_zero3_apply())
     register("collectives.bucket_allreduce",
              _build_bucket_collective("allreduce"))
     register("collectives.bucket_reduce_scatter",

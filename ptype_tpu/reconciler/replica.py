@@ -567,6 +567,15 @@ class ProcessLauncher:
     the default ``"unified"`` — a per-class fleet is two launchers
     (one per class) each driven by its own reconciler off its own
     gateway hint (``InferenceGateway.class_hint``).
+
+    Elastic training (ISSUE 17): a reconciler scaling a
+    ``kind="custom"`` trainer fleet needs no extra plumbing into the
+    training loop. Spawning or killing a worker changes registry
+    membership; each survivor's ``FailureDetector`` reports the
+    churn; the running step raises ``MembershipChanged``; and
+    ``ElasticZeroTrainer.recover`` live-reshards the ZeRO state
+    across the survivor set in place (``elastic.py``) — no restart,
+    no checkpoint round trip.
     """
 
     def __init__(self, coordinator_address: str, service: str = "llm",

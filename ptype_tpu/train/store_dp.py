@@ -39,23 +39,41 @@ Gradient-exchange modes (``overlap``):
   the whole-tree apply with streamed waits (an arbitrary optax chain
   can't be split per bucket safely).
 
-``zero=True`` replaces the gradient allreduce entirely with the
-ZeRO-1 sharded weight update (parallel/zero.py, PAPERS.md arXiv
-2004.13336): gradients reduce-SCATTER bucket-by-bucket
-(``TensorStore.push_tree_scatter_iter`` — half the wire bytes, same
-int8+EF wire, residuals owned per shard), the default AdamW applies
-shard-locally (each replica materializes 1/N of the moments and does
-1/N of the update FLOPs), and the updated params allgather back —
-fused into the per-bucket apply program — before committing to the
-Store. The allgathers dispatch asynchronously, so they overlap the
-next step's data staging the same way the push_tree_iter stream
-overlaps the reduce.
+``zero`` selects a rung of the cross-replica sharding LADDER
+(parallel/zero.py, PAPERS.md arXiv 2004.13336); every rung shards the
+optimizer state 1/N and runs the identical shard-local AdamW:
+
+- ``zero=1``: grads ride the bucketed ALLREDUCE stream
+  (``push_tree_iter``) and stay replicated; the fused apply slices
+  each replica's shard of params and grads, then allgathers the
+  updated params back.
+- ``zero=2`` (also the back-compat ``zero=True``): gradients
+  reduce-SCATTER bucket-by-bucket
+  (``TensorStore.push_tree_scatter_iter`` — half the wire bytes, same
+  int8+EF wire, residuals owned per shard), each replica's grad shard
+  feeds the update directly, and the updated params allgather back —
+  fused into the per-bucket apply program — before committing to the
+  Store. The allgathers dispatch asynchronously, so they overlap the
+  next step's data staging the same way the push_tree_iter stream
+  overlaps the reduce.
+- ``zero=3``: params are RESIDENT sharded too (``ZeroState.pflat`` —
+  ``ScatteredTree``-style flats are the only layout); each bucket
+  allgathers just-in-time for the forward (one fused launch per
+  bucket, the gathered buffers donated to the grads program so they
+  die after the forward), the update is purely elementwise on the
+  flats, and the new param flats commit straight back to the Store.
+
+All rungs survive churn in-place: :meth:`StoreDPTrainer.reshard`
+re-pads and re-places the whole resident state onto a survivor mesh
+(``ZeroState.reshard`` — atomic, moments bit-preserved) without a
+checkpoint round trip.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu import jitwatch
@@ -69,6 +87,20 @@ from ptype_tpu.train.trainer import (_decay_mask, default_optimizer,
 
 _OVERLAP_MODES = (False, "drain", True)
 
+#: Per-bucket partial square-norm over FULL reduced leaves (the zero=1
+#: allreduce stream) — same global-norm coordination as the sharded
+#: flats' _sqnorm, summed across buckets by clip_scale.
+_leaves_sqnorm = jax.jit(
+    lambda vs: sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                   for v in vs))
+
+
+def _resident_nbytes(arr) -> int:
+    """Bytes THIS replica holds of ``arr`` (one addressable shard for
+    sharded arrays, the whole buffer for replicated ones)."""
+    shards = getattr(arr, "addressable_shards", None)
+    return shards[0].data.nbytes if shards else arr.nbytes
+
 
 class StoreDPTrainer:
     """Data-parallel trainer whose gradient exchange IS the Store."""
@@ -81,6 +113,21 @@ class StoreDPTrainer:
             raise ValueError(
                 f"StoreDPTrainer: overlap must be one of "
                 f"{_OVERLAP_MODES}, got {overlap!r}")
+        # Normalize the ladder knob: bool True predates the ladder and
+        # IS the reduce-scatter rung (kept as the back-compat
+        # spelling); integers name the rung explicitly. The identity
+        # check matters — ``True == 1`` but the bool spelling must map
+        # to stage 2, not 1.
+        if zero is True:
+            zero_stage = 2
+        elif zero in (False, 0, None):
+            zero_stage = 0
+        elif zero in (1, 2, 3):
+            zero_stage = int(zero)
+        else:
+            raise ValueError(
+                f"StoreDPTrainer: zero must be False, True (= stage "
+                f"2), or a ZeRO ladder stage 1/2/3, got {zero!r}")
         if zero and optimizer is not None:
             raise ValueError(
                 "StoreDPTrainer: zero=True shards the DEFAULT AdamW "
@@ -103,7 +150,8 @@ class StoreDPTrainer:
         self.axis = store.axis
         self.n_workers = int(self.mesh.shape[self.axis])
         self.overlap = overlap
-        self.zero = bool(zero)
+        self.zero = zero_stage > 0
+        self.zero_stage = zero_stage
         self._custom_opt = optimizer is not None
         self.optimizer = optimizer or default_optimizer()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -165,6 +213,26 @@ class StoreDPTrainer:
                 plan, self.mesh, self.axis,
                 zero_hparams or default_optimizer_hparams(),
                 [mask_leaves[i] for i in order])
+            if self.zero_stage == 3:
+                # Params leave the replicated world entirely: resident
+                # as P(axis) bucket flats. The seed put_tree's
+                # replicated leaf entries are dropped from the store
+                # and replaced with per-bucket flat commits (epoch
+                # semantics like the grad scatter path) — no replica
+                # holds the full tree after this point.
+                self._zero.scatter_params(
+                    [self._param_leaves[i] for i in order])
+                for k in self._keys:
+                    self.store.delete(k)
+                for bi, flat in enumerate(self._zero.pflat):
+                    self.store.commit_sharded(
+                        f"params/bucket{bi:05d}", flat)
+                self._param_leaves = None
+                self._params_seq = self.store.tree_seq("params")
+        #: Per-replica resident gradient bytes of the last step's
+        #: exchange (full leaves under zero=1, one shard per replica
+        #: under zero=2/3) — the bench ladder's grad column.
+        self.last_grad_bytes: int | None = None
 
         # Per-worker grad fn, vmapped over the stacked worker batch dim —
         # one compiled program computing every worker's local grads, laid
@@ -175,6 +243,12 @@ class StoreDPTrainer:
             )
             return loss, grads
 
+        # Under zero=3 the gathered param leaves are TRANSIENT: they
+        # live only for the forward (locals of _step) and die when it
+        # returns — the resident footprint stays the sharded flats,
+        # and the apply program's donation (parallel/zero.py
+        # _shard_apply3_fn, pinned by progaudit) keeps the update
+        # in-place on those flats.
         self._grads_fn = jax.jit(jax.vmap(local_grads, in_axes=(None, 0)))
         self._apply_fn = make_apply_fn(self.optimizer)
         #: (params avals, stacked-batch avals) stashed on the first
@@ -186,7 +260,17 @@ class StoreDPTrainer:
         """The current parameter tree. Served from the locally-kept
         committed views — the store is only re-pulled when its write
         stamp says some OTHER writer touched the namespace since this
-        trainer's own last put (external mutation / epoch mismatch)."""
+        trainer's own last put (external mutation / epoch mismatch).
+
+        Under ``zero=3`` there IS no replicated residency: the tree is
+        materialized just-in-time from the resident shards via the ONE
+        sanctioned full-tree gather (``ZeroState.gather_params``)."""
+        if self.zero_stage == 3:
+            gathered = self._zero.gather_params()
+            leaves = [None] * len(self._keys)
+            for slot, i in enumerate(self._zero_order):
+                leaves[i] = gathered[slot]
+            return jax.tree_util.tree_unflatten(self._treedef, leaves)
         seq = self.store.tree_seq("params")
         if seq == self._params_seq and self._param_leaves is not None:
             return jax.tree_util.tree_unflatten(
@@ -261,7 +345,11 @@ class StoreDPTrainer:
             # else crossing the host boundary here is a leak.
             losses, grads = self._grads_fn(params, stacked)
 
-            if self.zero:
+            if self.zero_stage == 1:
+                self._reduce_apply_zero1(grads)
+            elif self.zero_stage == 3:
+                self._reduce_apply_zero3(grads)
+            elif self.zero:
                 self._reduce_apply_zero(grads)
             elif self.overlap is True:
                 self._reduce_apply_overlapped(params, grads)
@@ -315,7 +403,7 @@ class StoreDPTrainer:
             "grad_epoch": self.store.epoch(self._grad_key0()),
         }
 
-    # ------------------------------------------- ZeRO-1 sharded update
+    # ------------------------------------------- ZeRO sharded updates
 
     def _reduce_apply_zero(self, grads) -> None:
         """The sharded weight update: stream the per-bucket gradient
@@ -353,9 +441,145 @@ class StoreDPTrainer:
                 for i, leaf in zip(idxs, newp):
                     self._param_leaves[i] = leaf
             self._zero.finish_step()
+        self.last_grad_bytes = sum(_resident_nbytes(h.flat)
+                                   for h in handles)
         new_params = jax.tree_util.tree_unflatten(
             self._treedef, self._param_leaves)
         self._params_seq = self.store.put_tree("params", new_params)
+
+    def _reduce_apply_zero1(self, grads) -> None:
+        """ZeRO-1 rung: grads ride the bucketed ALLREDUCE stream
+        (``push_tree_iter`` — full reduced leaves, replicated) and the
+        fused apply slices each replica's shard of params AND grads
+        before the shard-local AdamW + param allgather. Optimizer
+        memory is 1/N like the other rungs; grad memory stays full —
+        the ladder's measured middle step."""
+        from ptype_tpu.metrics import annotate
+
+        handles = []
+        sqs = []
+        prev = None
+        for h in self.store.push_tree_iter("grads", grads, op="mean"):
+            handles.append(h)
+            sqs.append(_leaves_sqnorm([v for _, v in h.items()]))
+            if prev is not None:
+                prev.wait()
+            prev = h
+        if prev is not None:
+            prev.wait()
+        if len(handles) != len(self._zero.plan.buckets):
+            raise ValueError(
+                f"zero=1: grad stream produced {len(handles)} "
+                f"buckets, the shard plan has "
+                f"{len(self._zero.plan.buckets)} — plans diverged")
+        with annotate("train.opt/zero"):
+            scale = self._zero.clip_scale(sqs)
+            grad_bytes = 0
+            for bi, h in enumerate(handles):
+                idxs = [self._grad_index(k) for k in h.keys]
+                gleaves = [v for _, v in h.items()]
+                grad_bytes += sum(v.nbytes for v in gleaves)
+                newp = self._zero.apply_bucket_full(
+                    bi, [self._param_leaves[i] for i in idxs],
+                    gleaves, scale)
+                for i, leaf in zip(idxs, newp):
+                    self._param_leaves[i] = leaf
+            self._zero.finish_step()
+        self.last_grad_bytes = grad_bytes
+        new_params = jax.tree_util.tree_unflatten(
+            self._treedef, self._param_leaves)
+        self._params_seq = self.store.put_tree("params", new_params)
+
+    def _reduce_apply_zero3(self, grads) -> None:
+        """ZeRO-3 rung: grads reduce-scatter exactly like ZeRO-2, but
+        params are resident sharded too — the apply is purely
+        elementwise on the flats (NO collective; progaudit pins it at
+        zero launches) and each bucket's new param flat commits
+        straight back to the store with an epoch bump. The full tree
+        is never materialized on the update path."""
+        from ptype_tpu.metrics import annotate
+
+        handles = []
+        sqs = []
+        prev = None
+        for h in self.store.push_tree_scatter_iter("grads", grads,
+                                                   op="mean"):
+            handles.append(h)
+            sqs.append(self._zero.partial_sqnorm(h.flat))
+            if prev is not None:
+                prev.wait()
+            prev = h
+        if prev is not None:
+            prev.wait()
+        with annotate("train.opt/zero"):
+            scale = self._zero.clip_scale(sqs)
+            grad_bytes = 0
+            for bi, h in enumerate(handles):
+                grad_bytes += _resident_nbytes(h.flat)
+                newflat = self._zero.apply_bucket3(bi, h.flat, scale)
+                self.store.commit_sharded(
+                    f"params/bucket{bi:05d}", newflat)
+            self._zero.finish_step()
+        self.last_grad_bytes = grad_bytes
+        self._params_seq = self.store.tree_seq("params")
+
+    # ---------------------------------------------- live resharding
+
+    def reshard(self, mesh: Mesh, axis: str | None = None) -> dict:
+        """LIVE reshard onto a survivor mesh — no checkpoint round
+        trip. Re-pads and re-places the resident ZeRO state
+        (``ZeroState.reshard`` — atomic, moments bit-preserved),
+        re-homes the store, re-places the params, and training
+        continues on the next ``step()`` call (the jitted programs
+        retrace for the new mesh on first use).
+
+        The move runs as a ``train.reshard`` span with an inflight
+        gauge and a completion counter — the ``reshard-stall`` health
+        rule's series. On a raise (the per-bucket ``train.reshard``
+        chaos seam's drop, a placement failure) EVERYTHING is left
+        intact — old plan, old mesh, old arrays — and the inflight
+        gauge stays up (that IS the stall signal); the caller
+        (``ElasticZeroTrainer.recover``) just retries."""
+        import time as _t
+
+        from ptype_tpu.metrics import annotate, metrics
+
+        if not self.zero:
+            raise ValueError(
+                "StoreDPTrainer.reshard: live resharding needs the "
+                "sharded ZeRO state — construct with zero=True/1/2/3 "
+                "(replicated modes restart from a checkpoint instead)")
+        axis = axis or self.axis
+        old_n = self.n_workers
+        new_n = int(mesh.shape[axis])
+        t0 = _t.perf_counter()
+        metrics.gauge("train.reshard_inflight").set(1.0)
+        with annotate("train.reshard"):
+            self._zero.reshard(mesh, axis)
+            self.store.reshard(mesh, axis)
+            self.mesh = mesh
+            self.axis = axis
+            self.n_workers = new_n
+            if self.zero_stage == 3:
+                for bi, flat in enumerate(self._zero.pflat):
+                    self.store.commit_sharded(
+                        f"params/bucket{bi:05d}", flat)
+                self._params_seq = self.store.tree_seq("params")
+            else:
+                new_params = jax.tree_util.tree_unflatten(
+                    self._treedef,
+                    [jax.device_put(np.asarray(x),
+                                    NamedSharding(mesh, P()))
+                     for x in self._param_leaves])
+                self._param_leaves = list(
+                    jax.tree_util.tree_leaves(new_params))
+                self._params_seq = self.store.put_tree("params",
+                                                       new_params)
+            self._cost_avals = None
+        metrics.gauge("train.reshard_inflight").set(0.0)
+        metrics.counter("train.reshards").add(1)
+        return {"old_n": old_n, "new_n": new_n,
+                "reshard_ms": round((_t.perf_counter() - t0) * 1e3, 2)}
 
     # --------------------------------------- compiled-cost accounting
 
@@ -551,8 +775,9 @@ class StoreDPTrainer:
         self._scale_fn = jax.jit(scale_of)
 
     def _grad_key0(self) -> str:
-        if self.zero:
-            # The scatter path commits per BUCKET, not per leaf.
+        if self.zero_stage >= 2:
+            # The scatter path commits per BUCKET, not per leaf (the
+            # zero=1 allreduce stream commits per leaf like overlap).
             return "grads/bucket00000"
         return self._keys[0].replace("params/", "grads/", 1)
 
@@ -664,4 +889,131 @@ def measure_zero(mesh: Mesh, preset: str = "tiny", steps: int = 6,
         "n_replicas": int(mesh.shape["data"]),
         "steps": steps,
         "compress": compress,
+    }
+
+
+def measure_zero_ladder(mesh: Mesh, preset: str = "tiny",
+                        steps: int = 4, batch: int = 16) -> dict:
+    """The full ladder measured (ISSUE 17): replicated baseline vs
+    ZeRO-1/2/3, same seed and stream — per-replica resident bytes for
+    optimizer moments, the grad reduction, and params, plus step time
+    and final loss (which must match across rungs; the ladder changes
+    residency, never math). Feeds ``zero2_grad_mem_mb`` /
+    ``zero3_param_mem_mb`` in the bench tail and the ``make
+    zero-bench`` ladder table."""
+    import time as _t
+
+    from ptype_tpu.train.data import synthetic_batches
+
+    cfg = tfm.preset(preset)
+    seq = min(cfg.max_seq, 128)
+    n = int(mesh.shape["data"])
+    rows = {}
+    for stage in (0, 1, 2, 3):
+        trainer = StoreDPTrainer(cfg, TensorStore(mesh),
+                                 rng=jax.random.PRNGKey(0),
+                                 zero=stage if stage else False)
+        stream = synthetic_batches(cfg.vocab_size, batch, seq, seed=5)
+        trainer.step(next(stream))  # compile + warm
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            out = trainer.step(next(stream))
+        dt = (_t.perf_counter() - t0) / steps
+        if stage:
+            opt_b = trainer.zero_state().moment_bytes_per_replica()
+            param_b = trainer.zero_state().param_bytes_per_replica()
+        else:
+            opt_b = sum(
+                _resident_nbytes(x) for x in
+                jax.tree_util.tree_leaves(trainer.opt_state))
+            param_b = 0
+        if not param_b:  # replicated leaves resident (stages 0-2)
+            param_b = sum(x.nbytes for x in
+                          jax.tree_util.tree_leaves(trainer.params()))
+        rows[f"zero{stage}" if stage else "repl"] = {
+            "step_ms": round(dt * 1e3, 2),
+            "opt_mem_mb": round(opt_b / 2**20, 3),
+            "grad_mem_mb": round((trainer.last_grad_bytes or 0)
+                                 / 2**20, 3),
+            "param_mem_mb": round(param_b / 2**20, 3),
+            "final_loss": round(float(out["loss"]), 5),
+        }
+    return {
+        "ladder": rows,
+        "zero2_grad_mem_mb": rows["zero2"]["grad_mem_mb"],
+        "zero3_param_mem_mb": rows["zero3"]["param_mem_mb"],
+        "repl_grad_mem_mb": rows["zero1"]["grad_mem_mb"],
+        "repl_param_mem_mb": rows["repl"]["param_mem_mb"],
+        "n_replicas": n,
+        "steps": steps,
+    }
+
+
+def measure_reshard(preset: str = "tiny", steps: int = 3,
+                    batch: int = 16, zero: int = 2) -> dict:
+    """Live reshard vs the checkpoint-restore round trip it replaces
+    (ISSUE 17): train on the full 8-device host mesh, shrink to 4
+    survivors both ways, and report each recovery in STEP units
+    (``reshard_resume_steps`` — wall time to be training again on the
+    survivor set, divided by the steady step time). The live path is
+    ``StoreDPTrainer.reshard`` (in memory, atomic); the baseline is
+    ZeroCheckpoint + StoreCheckpoint save → fresh trainer → restore."""
+    import tempfile
+    import time as _t
+
+    from ptype_tpu.checkpoint import StoreCheckpoint, ZeroCheckpoint
+    from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.train.data import synthetic_batches
+
+    cfg = tfm.preset(preset)
+    seq = min(cfg.max_seq, 128)
+    mesh8 = build_mesh({"data": 8})
+    mesh4 = build_mesh({"data": 4}, devices=jax.devices()[:4])
+
+    def trained():
+        tr = StoreDPTrainer(cfg, TensorStore(mesh8),
+                            rng=jax.random.PRNGKey(0), zero=zero)
+        stream = synthetic_batches(cfg.vocab_size, batch, seq, seed=5)
+        tr.step(next(stream))
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            tr.step(next(stream))
+        return tr, (_t.perf_counter() - t0) / steps, stream
+
+    # Live path: reshard + the first survivor step (pays the retrace).
+    tr, step_s, stream = trained()
+    t0 = _t.perf_counter()
+    info = tr.reshard(mesh4)
+    tr.step(next(stream))
+    live_s = _t.perf_counter() - t0
+
+    # Checkpoint path on an identical twin: save, fresh trainer on
+    # the survivor mesh, restore, first step.
+    twin, _, stream2 = trained()
+    with tempfile.TemporaryDirectory() as td:
+        t0 = _t.perf_counter()
+        ZeroCheckpoint(td + "/zero").save(steps, twin.zero_state())
+        StoreCheckpoint(twin.store, td + "/store",
+                        keys_prefix="params/").save(steps)
+        fresh = StoreDPTrainer(cfg, TensorStore(mesh4),
+                               rng=jax.random.PRNGKey(0), zero=zero)
+        StoreCheckpoint(fresh.store, td + "/store",
+                        keys_prefix="params/").resume()
+        ZeroCheckpoint(td + "/zero").restore_into(fresh.zero_state())
+        if zero == 3:
+            for bi, flat in enumerate(fresh.zero_state().pflat):
+                fresh.store.commit_sharded(
+                    f"params/bucket{bi:05d}", flat)
+        fresh.step(next(stream2))
+        ckpt_s = _t.perf_counter() - t0
+
+    return {
+        "zero_stage": zero,
+        "step_ms": round(step_s * 1e3, 2),
+        "reshard_ms": info["reshard_ms"],
+        "live_resume_ms": round(live_s * 1e3, 2),
+        "ckpt_resume_ms": round(ckpt_s * 1e3, 2),
+        "reshard_resume_steps": round(live_s / step_s, 2),
+        "ckpt_resume_steps": round(ckpt_s / step_s, 2),
+        "resume_speedup": round(ckpt_s / live_s, 2),
     }

@@ -934,3 +934,70 @@ def test_serve_engine_package_is_pt021_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt021 = [f for f in findings if "PT021" in f]
     assert not pt021, pt021
+
+
+# --------------------------------------------------------------- PT022
+
+
+PT022_SNEAKY_GATHER = (
+    "from jax import lax\n"
+    "def assemble(flat, scattered, store):\n"
+    "    full = lax.all_gather(flat, 'data')\n"
+    "    tree = scattered.gather()\n"
+    "    leaf = store.pull('params/w', gather=True)\n"
+    "    return full, tree, leaf\n")
+
+
+def test_pt022_flags_ad_hoc_param_gather_in_train(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/train/sneak22.py",
+                      PT022_SNEAKY_GATHER)
+    assert sum("PT022" in f for f in findings) == 3, findings
+
+
+def test_pt022_silent_in_zero_home_and_outside_train(tmp_path):
+    # parallel/zero.py is the one sanctioned home; serve/ and tests
+    # assemble trees for their own (non-ZeRO) reasons.
+    for rel in ("ptype_tpu/parallel/zero.py",
+                "ptype_tpu/parallel/collectives.py",
+                "ptype_tpu/serve_engine/kv.py", "tests/t22.py",
+                "examples/demo22.py"):
+        findings = _check(tmp_path, rel, PT022_SNEAKY_GATHER)
+        assert not any("PT022" in f for f in findings), (rel, findings)
+
+
+def test_pt022_ignores_sanctioned_delegation(tmp_path):
+    # gather_params() is the sanctioned API; pull without gather=True
+    # and unrelated attrs stay silent.
+    src = ("def params(self, store):\n"
+           "    leaves = self._zero.gather_params()\n"
+           "    w = store.pull('params/w')\n"
+           "    g = store.pull('grads/b', gather=False)\n"
+           "    return leaves, w, g\n")
+    findings = _check(tmp_path, "ptype_tpu/train/ok22.py", src)
+    assert not any("PT022" in f for f in findings), findings
+
+
+def test_pt022_honors_noqa(tmp_path):
+    src = ("from jax import lax\n"
+           "def probe(flat):\n"
+           "    return lax.all_gather(flat, 'data')"
+           "  # noqa: parity probe\n")
+    findings = _check(tmp_path, "ptype_tpu/train/sup22.py", src)
+    assert not any("PT022" in f for f in findings), findings
+
+
+def test_train_package_is_pt022_clean():
+    """Full-tree param gather has one home (ISSUE 17): no ad-hoc
+    allgather in train/ outside parallel/zero.py."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu",
+                       "train")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt022 = [f for f in findings if "PT022" in f]
+    assert not pt022, pt022

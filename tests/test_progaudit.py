@@ -145,3 +145,106 @@ def test_real_hot_programs_audit_clean():
     win = reports["serve.spec_window"]
     assert win.donated_consumed == win.donated_expected == 4
     assert win.collectives == {} and dec.collectives == {}
+
+
+# ----------------------------------------------- ZeRO ladder programs
+
+
+def test_zero_ladder_programs_pin_their_collectives():
+    """The ladder's launch-count contract (ISSUE 17), pinned program
+    by program: ZeRO-2 reduce-scatters each grad bucket ONCE and never
+    allgathers grads; ZeRO-3 allgathers each param bucket ONCE
+    just-in-time and its shard-local apply launches NOTHING (and eats
+    its donated param/moment flats)."""
+    progaudit.register_default_programs()
+    reports = progaudit.audit_all(raise_on_failure=True)
+    assert reports["zero1.shard_apply"].collectives == \
+        {"all_gather": 1}
+    rs = reports["zero2.grad_reduce_scatter"]
+    assert rs.collectives.get("reduce_scatter") == 1
+    assert rs.collectives.get("all_gather", 0) == 0
+    assert reports["zero3.param_gather"].collectives == \
+        {"all_gather": 1}
+    ap3 = reports["zero3.shard_apply"]
+    assert ap3.collectives == {}
+    assert ap3.donated_consumed == ap3.donated_expected == 3
+
+
+def test_split_bucket_two_reduce_scatters_breaks_the_pin():
+    """Synthetic un-fusion: the SAME flat reduced as two half-bucket
+    reduce-scatters — the per-prim pin {reduce_scatter: 1} catches
+    what a total-count-only check would if it summed to the same."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ptype_tpu.compat import shard_map
+
+    mesh = Mesh(jax.devices(), ("data",))
+    n = jax.device_count()
+
+    def split(x):
+        h = x.shape[-1] // 2
+        a = jax.lax.psum_scatter(x[..., :h], "data",
+                                 scatter_dimension=0, tiled=True)
+        b = jax.lax.psum_scatter(x[..., h:], "data",
+                                 scatter_dimension=0, tiled=True)
+        return jnp.concatenate([a, b])
+
+    fn = shard_map(split, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    rep = progaudit.audit(
+        fn, (jax.ShapeDtypeStruct((n * 8, 16), jnp.float32),),
+        name="split-rs",
+        expect_collectives={"reduce_scatter": 1})
+    assert not rep.ok, rep.to_dict()
+    assert rep.collectives.get("reduce_scatter") == 2
+
+
+def test_sneaky_grad_allgather_breaks_the_zero2_pin():
+    """Synthetic regression: a reduce-scatter that then allgathers the
+    shard back (defeating ZeRO-2's whole point) trips the explicit
+    {all_gather: 0} pin even though reduce_scatter still counts 1."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ptype_tpu.compat import shard_map
+
+    mesh = Mesh(jax.devices(), ("data",))
+    n = jax.device_count()
+
+    def rs_then_gather(x):
+        s = jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                 tiled=True)
+        return jax.lax.all_gather(s, "data", tiled=True)
+
+    fn = shard_map(rs_then_gather, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    rep = progaudit.audit(
+        fn, (jax.ShapeDtypeStruct((n * 8,), jnp.float32),),
+        name="sneaky-gather",
+        expect_collectives={"reduce_scatter": 1, "all_gather": 0})
+    assert not rep.ok, rep.to_dict()
+    assert rep.collectives.get("all_gather") == 1
+
+
+def test_per_leaf_param_gathers_break_the_zero3_pin():
+    """Synthetic un-fusion for the just-in-time gather: one allgather
+    per leaf instead of one per flat bucket."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ptype_tpu.compat import shard_map
+
+    mesh = Mesh(jax.devices(), ("data",))
+    n = jax.device_count()
+
+    def per_leaf(a, b):
+        return (jax.lax.all_gather(a, "data", tiled=True),
+                jax.lax.all_gather(b, "data", tiled=True))
+
+    fn = shard_map(per_leaf, mesh=mesh,
+                   in_specs=(P("data"), P("data")),
+                   out_specs=(P(), P()), check_vma=False)
+    avals = (jax.ShapeDtypeStruct((n * 4,), jnp.float32),
+             jax.ShapeDtypeStruct((n * 2,), jnp.float32))
+    rep = progaudit.audit(fn, avals, name="per-leaf-gather",
+                          expect_collectives={"all_gather": 1})
+    assert not rep.ok and rep.collectives.get("all_gather") == 2, \
+        rep.to_dict()

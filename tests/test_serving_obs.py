@@ -316,6 +316,40 @@ def test_migration_stall_rule_requires_sustained_inflight():
         {"serve/a:1": {"series": {}}}))) == []
 
 
+def test_reshard_stall_rule_requires_sustained_inflight():
+    """Elastic training (ISSUE 17): the reshard-stall page mirrors
+    migration-stall — gauge held high across the window with the
+    completion counter flat is a wedged live reshard (training parked
+    on the survivor set)."""
+    from ptype_tpu.health import ReshardStallRule, default_rules
+
+    rule = ReshardStallRule(window_s=60.0)
+
+    def node(inflight_pts, done_pts):
+        return {"series": {"train.reshard_inflight": inflight_pts,
+                           "train.reshards": done_pts}}
+
+    held = [[t, 1.0] for t in (950.0, 970.0, 990.0)]
+    flat = [[950.0, 3.0], [990.0, 3.0]]
+    alerts = rule.evaluate(ClusterView(_snap(
+        {"train/a:1": node(held, flat)})))
+    assert len(alerts) == 1 and alerts[0].severity == "page"
+    assert "obs scale" in alerts[0].message
+    # A reshard completing inside the window: progress, not a wedge.
+    moving = [[950.0, 3.0], [990.0, 4.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"train/a:1": node(held, moving)}))) == []
+    # Gauge touched zero mid-window: the swap (or abort) landed.
+    drained = [[950.0, 1.0], [970.0, 0.0], [990.0, 1.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"train/a:1": node(drained, flat)}))) == []
+    # Non-elastic trainers (no gauge) never pay a false page.
+    assert rule.evaluate(ClusterView(_snap(
+        {"train/a:1": {"series": {}}}))) == []
+    # Structural: armed by default.
+    assert "reshard-stall" in {r.name for r in default_rules()}
+
+
 def test_default_rules_include_serving_set():
     # Structural serving rules are always armed; the TTFT page is an
     # SLO target only the operator can pick, so like P99Rule it is

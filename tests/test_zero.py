@@ -349,3 +349,180 @@ def test_top_renders_optimizer_column():
     out = render_top(snap)
     assert "opt" in out.splitlines()[1]
     assert "7.5" in out
+
+
+# ---------------------------------------------------- live resharding
+
+
+@pytest.fixture(scope="module")
+def mesh3():
+    return build_mesh({"data": 3}, devices=jax.devices()[:3])
+
+
+@pytest.mark.parametrize("n_from,n_to", [(8, 4), (4, 8), (8, 3)])
+def test_live_reshard_matches_checkpoint_roundtrip(
+        tmp_path, mesh8, mesh4, mesh3, n_from, n_to):
+    """ZeroState.reshard is the ZeroCheckpoint restore math applied in
+    memory: same plan, same shard placement, moments BIT-preserved —
+    parity is array_equal against the save→restore round trip,
+    including the non-divisor survivor set (8→3 re-pads every tail)."""
+    meshes = {8: mesh8, 4: mesh4, 3: mesh3}
+    live = _mk_state(meshes[n_from], n_from, count=7)
+    ZeroCheckpoint(str(tmp_path)).save(1, live)
+    ref = _mk_state(meshes[n_to], n_to, count=0)
+    for i in range(len(ref.plan.buckets)):
+        ref.mu[i] = jnp.zeros_like(ref.mu[i])
+        ref.nu[i] = jnp.zeros_like(ref.nu[i])
+    ZeroCheckpoint(str(tmp_path)).restore_into(ref)
+
+    old_manifest = live.plan.manifest()
+    live.reshard(meshes[n_to])
+    assert live.count == 7 and ref.count == 7
+    # Old and new flat spaces are the same plan (only pads moved).
+    check_plan_compatible(old_manifest, live.plan.manifest())
+    assert live.plan.manifest() == ref.plan.manifest()
+    for i, b in enumerate(live.plan.buckets):
+        assert b.elems % n_to == 0
+        assert live.mu[i].addressable_shards[0].data.size * n_to \
+            == b.elems
+        for name, acc, want in (("mu", live.mu, ref.mu),
+                                ("nu", live.nu, ref.nu)):
+            np.testing.assert_array_equal(
+                np.asarray(acc[i]), np.asarray(want[i]),
+                err_msg=f"bucket {i} {name} {n_from}->{n_to}")
+
+
+def test_live_reshard_carries_zero3_param_shards(mesh8, mesh4):
+    """With resident ZeRO-3 param flats, reshard moves them through the
+    same strip-pad/re-pad path and gather_params reassembles the exact
+    original leaves on the survivor mesh."""
+    leaves = _leaves()
+    plan = ShardPlan.for_leaves(leaves, 8)
+    zs = ZeroState.create(plan, mesh8, "data",
+                          default_optimizer_hparams(),
+                          [True, False, True])
+    zs.scatter_params(leaves)
+    assert zs.param_bytes_per_replica() > 0
+    zs.reshard(mesh4)
+    got = zs.gather_params()
+    assert len(got) == len(leaves)
+    for w, g in zip(leaves, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+    assert zs.param_bytes_per_replica() * 4 == sum(
+        b.elems * 4 for b in zs.plan.buckets)
+
+
+def test_mid_reshard_failure_leaves_old_plan_intact(mesh8, mesh4):
+    """The atomic-swap contract: a chaos drop mid-move raises
+    ClusterError and the state still answers for the OLD mesh — same
+    plan, same values, same placement — and a retry against the same
+    state succeeds and pairs the fault."""
+    from ptype_tpu import chaos
+    from ptype_tpu.chaos import FaultPlan, FaultSpec
+    from ptype_tpu.errors import ClusterError
+
+    zs = _mk_state(mesh8, 8, count=5)
+    before_plan = zs.plan
+    before_mu = [np.asarray(a) for a in zs.mu]
+    plan = chaos.arm(FaultPlan([
+        FaultSpec(site="train.reshard", action="drop",
+                  match="bucket00000", times=1),
+    ], name="reshard-drop"))
+    try:
+        with pytest.raises(ClusterError, match="retry"):
+            zs.reshard(mesh4)
+        # Old state fully intact: the swap never happened.
+        assert zs.plan is before_plan and zs.mesh is mesh8
+        assert zs.count == 5
+        for i, a in enumerate(zs.mu):
+            assert a.addressable_shards[0].data.size * 8 \
+                == before_plan.buckets[i].elems
+            np.testing.assert_array_equal(np.asarray(a), before_mu[i])
+        assert chaos.unrecovered() == {"train": 1}
+        # Retry (what ElasticZeroTrainer.recover does) succeeds and
+        # the success beacon pairs the outstanding fault.
+        zs.reshard(mesh4)
+        assert chaos.unrecovered() == {}, plan.trace()
+        assert int(zs.mesh.shape["data"]) == 4
+        for i, b in enumerate(zs.plan.buckets):
+            total = b.elems - b.pad
+            np.testing.assert_array_equal(
+                np.asarray(zs.mu[i])[:total], before_mu[i][:total])
+    finally:
+        chaos.disarm()
+
+
+def test_zero1_apply_bucket_full_matches_stage2(mesh8):
+    """ZeRO-1 (full grads, slice-both-in-apply) and ZeRO-2 (scattered
+    grads) are the same optimizer — identical new params from identical
+    reductions."""
+    n = 8
+    leaves = _leaves()
+    plan = ShardPlan.for_leaves(leaves, n)
+    mk = lambda: ZeroState.create(plan, mesh8, "data",  # noqa: E731
+                                  default_optimizer_hparams(),
+                                  [True, False, True])
+    zs1, zs2 = mk(), mk()
+    rng = np.random.default_rng(11)
+    grads = [jnp.asarray(rng.normal(size=x.shape), jnp.float32)
+             for x in leaves]
+    stacked = [jnp.broadcast_to(g[None], (n,) + g.shape)
+               for g in grads]
+    shards = list(C.bucketed_reduce_scatter_stream(
+        stacked, mesh8, "data", "mean"))
+    scale2 = zs2.clip_scale([zs2.partial_sqnorm(f) for _, f, _ in shards])
+    # Stage-1 global norm from the full (mean) grads: clip_scale just
+    # sums its partials, so per-leaf full sqnorms feed it directly.
+    scale1 = zs1.clip_scale(
+        [jnp.sum(jnp.square(g)) for g in grads])
+    p1 = {i: x for i, x in enumerate(leaves)}
+    p2 = dict(p1)
+    for bi, (b, flat, _) in enumerate(shards):
+        new2 = zs2.apply_bucket(bi, [p2[s.index] for s in b.slots],
+                                flat, scale2)
+        new1 = zs1.apply_bucket_full(
+            bi, [p1[s.index] for s in b.slots],
+            [grads[s.index] for s in b.slots], scale1)
+        for s, l1, l2 in zip(b.slots, new1, new2):
+            p1[s.index], p2[s.index] = l1, l2
+    for i in p1:
+        np.testing.assert_allclose(np.asarray(p1[i]), np.asarray(p2[i]),
+                                   rtol=2e-6, atol=1e-7)
+
+
+def test_zero3_apply_bucket3_matches_stage2(mesh8):
+    """ZeRO-3's elementwise shard-local apply produces the same flat
+    param shards as stage 2's unpack→apply→repack, and the new flats
+    gather back to stage-2's new leaves."""
+    n = 8
+    leaves = _leaves()
+    plan = ShardPlan.for_leaves(leaves, n)
+    mk = lambda: ZeroState.create(plan, mesh8, "data",  # noqa: E731
+                                  default_optimizer_hparams(),
+                                  [True, False, True])
+    zs3, zs2 = mk(), mk()
+    zs3.scatter_params(leaves)
+    rng = np.random.default_rng(12)
+    grads = [jnp.asarray(rng.normal(size=x.shape), jnp.float32)
+             for x in leaves]
+    stacked = [jnp.broadcast_to(g[None], (n,) + g.shape)
+               for g in grads]
+    shards = list(C.bucketed_reduce_scatter_stream(
+        stacked, mesh8, "data", "mean"))
+    scale = zs2.clip_scale([zs2.partial_sqnorm(f) for _, f, _ in shards])
+    p2 = {i: x for i, x in enumerate(leaves)}
+    for bi, (b, flat, _) in enumerate(shards):
+        zs3.apply_bucket3(bi, flat, scale)
+        for s, leaf in zip(b.slots, zs2.apply_bucket(
+                bi, [p2[s.index] for s in b.slots], flat, scale)):
+            p2[s.index] = leaf
+    got = zs3.gather_params()
+    for i in sorted(p2):
+        np.testing.assert_allclose(np.asarray(got[i]),
+                                   np.asarray(p2[i]),
+                                   rtol=2e-6, atol=1e-7,
+                                   err_msg=f"leaf {i}")
+    # Moments also track stage 2 exactly (same elementwise math).
+    for b3, b2 in zip(zs3.mu, zs2.mu):
+        np.testing.assert_allclose(np.asarray(b3), np.asarray(b2),
+                                   rtol=1e-6, atol=1e-8)

@@ -201,3 +201,124 @@ def test_measure_zero_probe(mesh8):
     assert r["zero_opt_mem_mb"] < r["repl_opt_mem_mb"]
     np.testing.assert_allclose(r["final_loss_zero"],
                                r["final_loss_repl"], rtol=1e-3)
+
+
+# ------------------------------------------------- the ladder (ISSUE 17)
+
+
+def test_zero_ladder_identical_loss_curve(mesh8):
+    """Stages 1/2/3 are the SAME algorithm at different residency —
+    loss curves pinned identical (rtol) against the replicated
+    baseline, while resident memory steps DOWN the ladder:
+    full grads at stage 1, 1/8 grads at 2/3, 1/8 params only at 3."""
+    steps = 4
+    trainers = {
+        "repl": StoreDPTrainer(TINY, TensorStore(mesh8),
+                               rng=jax.random.PRNGKey(5)),
+    }
+    for stage in (1, 2, 3):
+        trainers[stage] = StoreDPTrainer(
+            TINY, TensorStore(mesh8), rng=jax.random.PRNGKey(5),
+            zero=stage)
+    losses = {}
+    for name, tr in trainers.items():
+        it = _batches(seed=5)
+        losses[name] = [float(tr.step(next(it))["loss"])
+                        for _ in range(steps)]
+    for stage in (1, 2, 3):
+        np.testing.assert_allclose(losses[stage], losses["repl"],
+                                   rtol=1e-5, err_msg=f"stage {stage}")
+    # Param trajectories too — the ladder changed residency, not math.
+    ref = jax.tree_util.tree_leaves(trainers["repl"].params())
+    for stage in (1, 2, 3):
+        for x, y in zip(ref,
+                        jax.tree_util.tree_leaves(
+                            trainers[stage].params())):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-5, atol=1e-6,
+                                       err_msg=f"stage {stage}")
+    # Memory rungs: grads shrink 8x moving 1 -> 2 (scattered stream),
+    # and only stage 3 holds resident param shards (1/8 each).
+    g1 = trainers[1].last_grad_bytes
+    g2 = trainers[2].last_grad_bytes
+    g3 = trainers[3].last_grad_bytes
+    assert g1 >= 7.5 * g2, (g1, g2)
+    assert abs(g2 - g3) <= max(g2, g3) * 0.01, (g2, g3)
+    p3 = trainers[3].zero_state().param_bytes_per_replica()
+    assert p3 > 0
+    assert trainers[1].zero_state().param_bytes_per_replica() == 0
+    total_param_bytes = sum(
+        x.nbytes for x in ref)
+    assert total_param_bytes >= 7.5 * p3, (total_param_bytes, p3)
+    # Stage 3 keeps NO replicated leaves resident.
+    assert trainers[3]._param_leaves is None
+
+
+def test_zero3_checkpoint_roundtrip_carries_param_shards(
+        tmp_path, mesh8, mesh4):
+    """ZeRO-3 checkpoints persist the resident param flats (pbuckets)
+    alongside the moments; restore onto HALF the replicas reshards
+    params + moments together and training continues on the 8-replica
+    trajectory."""
+    it = _batches(seed=6)
+    tr8 = StoreDPTrainer(TINY, TensorStore(mesh8),
+                         rng=jax.random.PRNGKey(6), zero=3)
+    for _ in range(3):
+        tr8.step(next(it))
+    ZeroCheckpoint(str(tmp_path)).save(3, tr8.zero_state())
+
+    tr4 = StoreDPTrainer(TINY, TensorStore(mesh4),
+                         rng=jax.random.PRNGKey(77), zero=3)
+    assert ZeroCheckpoint(str(tmp_path)).restore_into(
+        tr4.zero_state()) == 3
+    # The restored param shards ARE tr8's params, resharded.
+    for x, y in zip(jax.tree_util.tree_leaves(tr8.params()),
+                    jax.tree_util.tree_leaves(tr4.params())):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # Re-home the store's flat commits to the restored shards before
+    # stepping (what a resume wrapper does after restore_into).
+    for bi, flat in enumerate(tr4.zero_state().pflat):
+        tr4.store.commit_sharded(f"params/bucket{bi:05d}", flat)
+    cont8, cont4 = _batches(seed=7), _batches(seed=7)
+    c8 = [tr8.step(next(cont8))["loss"] for _ in range(2)]
+    c4 = [tr4.step(next(cont4))["loss"] for _ in range(2)]
+    np.testing.assert_allclose(c8, c4, rtol=1e-4)
+
+
+def test_live_reshard_trainer_resumes_on_survivors(mesh8, mesh4):
+    """StoreDPTrainer.reshard mid-run (stage 2 and 3): training
+    continues on 4 survivors on the SAME trajectory as an
+    uninterrupted 8-replica run — and faster than the checkpoint
+    round trip it replaces (no disk, no restore)."""
+    for stage in (2, 3):
+        ref = StoreDPTrainer(TINY, TensorStore(mesh8),
+                             rng=jax.random.PRNGKey(8), zero=stage)
+        tr = StoreDPTrainer(TINY, TensorStore(mesh8),
+                            rng=jax.random.PRNGKey(8), zero=stage)
+        it_ref, it = _batches(seed=8), _batches(seed=8)
+        for _ in range(3):
+            ref.step(next(it_ref))
+            tr.step(next(it))
+        info = tr.reshard(mesh4)
+        assert info["old_n"] == 8 and info["new_n"] == 4
+        assert tr.n_workers == 4
+        for _ in range(3):
+            a = float(ref.step(next(it_ref))["loss"])
+            b = float(tr.step(next(it))["loss"])
+            np.testing.assert_allclose(a, b, rtol=1e-4,
+                                       err_msg=f"stage {stage}")
+        # Params stay in lockstep after the move.
+        for x, y in zip(jax.tree_util.tree_leaves(ref.params()),
+                        jax.tree_util.tree_leaves(tr.params())):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-5, atol=1e-6,
+                                       err_msg=f"stage {stage}")
+
+
+def test_zero_stage_knob_validation(mesh8):
+    with pytest.raises(ValueError, match="ladder stage"):
+        StoreDPTrainer(TINY, TensorStore(mesh8), zero=4)
+    with pytest.raises(ValueError, match="ladder stage"):
+        StoreDPTrainer(TINY, TensorStore(mesh8), zero="2")
+    with pytest.raises(ValueError, match="live resharding"):
+        StoreDPTrainer(TINY, TensorStore(mesh8)).reshard(mesh8)

@@ -1,4 +1,4 @@
-"""PT001–PT012 (plus PT021): the house rules.
+"""PT001–PT012 (plus PT021/PT022): the house rules.
 
 PT001–PT012 were migrated from tools/lint.py; each rule guards one
 architectural seam this repo earned the hard way (the full rationale
@@ -6,7 +6,9 @@ per rule lives in docs/LINTING.md). Migration is behavior-preserving:
 the golden-output test in tests/test_ptlint.py pins these against the
 old walker's findings on a fixture tree. PT021 (KV wire serialization
 outside the migration home, ISSUE 16) joins them here because it is
-the same single-home family as PT008/PT011.
+the same single-home family as PT008/PT011; PT022 (full-tree param
+allgather in ``train/``, ISSUE 17) extends that family to the ZeRO-3
+residency contract.
 """
 
 from __future__ import annotations
@@ -552,4 +554,59 @@ class _KVWireCheck(ast.NodeVisitor):
 def check_pt021(ctx: FileContext) -> list[Finding]:
     findings: list[Finding] = []
     _KVWireCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# ------------------------------------------------------------------ PT022
+
+
+class _ParamGatherCheck(ast.NodeVisitor):
+    """Flag full-tree param materialization inside ``train/``.
+
+    The ZeRO-3 residency contract (ISSUE 17) keeps params resident as
+    flat P(axis) shards; the ONLY place a full tree may be assembled
+    is ``parallel/zero.py`` (``ZeroState.gather_params`` riding
+    ``_bucket_gather_fn``).  Anything in ``train/`` that re-gathers —
+    a raw ``all_gather``, an ad-hoc ``.gather()`` on a scattered
+    handle, or ``pull(..., gather=True)`` against the store — forks
+    that contract and silently reinflates per-replica memory back to
+    the replicated footprint.  Delegating to the sanctioned API
+    (``self._zero.gather_params()``) is fine and is not flagged.
+    """
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+
+    def _flag(self, node: ast.Call, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT022",
+            f"{what} in train/ — full-tree param materialization has "
+            f"ONE home (parallel/zero.py: ZeroState.gather_params / "
+            f"_bucket_gather_fn); an ad-hoc gather here reinflates "
+            f"per-replica memory to the replicated footprint and "
+            f"dodges the zero3.param_gather progaudit pin"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = terminal_name(fn)
+        if name == "all_gather":
+            self._flag(node, "all_gather")
+        elif isinstance(fn, ast.Attribute) and fn.attr == "gather":
+            self._flag(node, ".gather()")
+        elif name == "pull":
+            for kw in node.keywords:
+                if (kw.arg == "gather"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    self._flag(node, "pull(gather=True)")
+                    break
+        self.generic_visit(node)
+
+
+@rule("PT022", "full-tree param allgather outside the ZeRO-3 home",
+      applies=lambda ctx: ctx.in_pkg and ctx.in_dir("train"))
+def check_pt022(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _ParamGatherCheck(ctx, findings).visit(ctx.tree)
     return findings
