@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench spec-bench disagg-bench scale-bench collectives-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench disagg-bench scale-bench collectives-bench hier-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -63,6 +63,17 @@ scale-bench:
 collectives-bench:
 	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
 		python bench.py --collectives
+
+# Hierarchical-collectives microbench on the 8-device emulated
+# asymmetric host mesh (docs/PERF.md "Hierarchical collectives"):
+# hierarchical vs flat bucketed-allreduce step time at exact-wire
+# parity for every (outer, inner) factorization of 8, the measured
+# slow-leg wire bytes (acceptance: <= 1/n_inner of the flat outer
+# footprint), and the per-leg bandwidth model's pricing of the
+# emulated ICI/DCN asymmetry (the ISSUE 18 numbers).
+hier-bench:
+	JAX_PLATFORMS=cpu XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8" \
+		python bench.py --hier
 
 # ZeRO-1 sharded-optimizer microbench on the 8-device virtual host
 # mesh (docs/PERF.md "Sharded optimizer update (ZeRO-1)"): per-replica

@@ -252,7 +252,26 @@ def worker_main() -> None:
 # ------------------------------------------------------------ orchestrator
 
 
+def _mesh_geometry() -> dict:
+    """Mesh geometry stamped on every tail record (ISSUE 18) so
+    numbers are comparable across runs: outer×inner + the emulated
+    bandwidth ratio when ``PTYPE_TOPOLOGY`` names a hierarchy, a flat
+    marker otherwise. Env-gated so the orchestrator's early
+    provisional emit never pays a jax import."""
+    if not os.environ.get("PTYPE_TOPOLOGY"):
+        return {"topology": "flat"}
+    try:
+        from ptype_tpu.parallel.topology import Topology
+
+        topo = Topology.from_env()
+        return topo.describe() if topo else {"topology": "flat"}
+    except Exception as e:  # noqa: BLE001
+        return {"topology": f"unparsed ({e})"}
+
+
 def _emit(rec: dict) -> None:
+    if "metric" in rec and "mesh_geometry" not in rec:
+        rec["mesh_geometry"] = _mesh_geometry()
     print(json.dumps(rec), flush=True)
 
 
@@ -760,6 +779,59 @@ def collectives_main() -> None:
             overlap["collective_share_drain_pct"],
         "collective_share_overlap_pct":
             overlap["collective_share_overlap_pct"],
+    })
+
+
+# ------------------------------------------------------------- hier bench
+
+
+def hier_main() -> None:
+    """``make hier-bench``: the ISSUE 18 hierarchical-collectives
+    numbers on the emulated asymmetric host mesh, in-process. Emits
+    one labeled JSON line per (outer, inner) factorization and a
+    combined tail record: hierarchical vs flat bucketed-allreduce
+    step time at exact-wire parity, the measured slow-leg wire bytes
+    (the acceptance: <= 1/n_inner of the flat outer footprint), and
+    the per-leg bandwidth model pricing both programs on the emulated
+    ICI/DCN asymmetry."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ptype_tpu.parallel.collectives import measure_hier_allreduce
+    from ptype_tpu.parallel.topology import Topology, factorizations
+
+    import jax
+
+    n = len(jax.devices())
+    probes = {}
+    for no, ni in factorizations(n):
+        if 1 in (no, ni):
+            continue  # degenerate legs: nothing to decompose
+        topo = Topology.emulated_host(no, ni)
+        p = measure_hier_allreduce(topo, mbytes=16, iters=4)
+        probes[f"{no}x{ni}"] = p
+        _emit({"probe": f"hier_allreduce_{no}x{ni}", **p})
+    if not probes:
+        _emit({"metric": "hierarchical allreduce", "value": None,
+               "unit": "% of flat outer-leg bytes on the slow leg",
+               "error": f"{n} devices admit no non-degenerate "
+                        "(outer, inner) factorization"})
+        raise SystemExit(2)
+    head = probes.get("2x4") or next(iter(probes.values()))
+    _emit({
+        "metric": "hierarchical allreduce: slow-leg wire bytes "
+                  f"({n}-device emulated asymmetric host mesh)",
+        "value": head["slow_leg_pct"],
+        "unit": "% of flat outer-leg bytes on the slow leg",
+        "mesh_geometry": head["geometry"],
+        "hier_step_ms": head["hier_step_ms"],
+        "flat_step_ms": head["flat_step_ms"],
+        "hier_slow_leg_bytes": head["hier_slow_leg_bytes"],
+        "flat_outer_bytes": head["flat_outer_bytes"],
+        "model_hier_ms": head["model_hier_ms"],
+        "model_flat_ms": head["model_flat_ms"],
+        "model_speedup": head["model_speedup"],
+        "slow_leg_within_bound": head["hier_slow_leg_bytes"] <= (
+            head["flat_outer_bytes"]
+            // head["geometry"]["n_inner"] + 1),
     })
 
 
@@ -1800,6 +1872,9 @@ def main() -> None:
         return
     if "--collectives" in sys.argv:
         collectives_main()
+        return
+    if "--hier" in sys.argv:
+        hier_main()
         return
     if "--zero" in sys.argv:
         zero_main()

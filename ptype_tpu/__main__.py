@@ -54,6 +54,14 @@ operations need. Commands:
                the autoscaling loop and its effect in one screen
                ($TOP_ITERS bounds refreshes; ^C exits).
                docs/OPERATIONS.md "Elastic serving" has the runbook.
+- ``obs topo`` — LIVE topology view (ISSUE 18): re-pull the cluster
+               telemetry every $TOP_INTERVAL and repaint per-domain
+               replica counts (the ``serve.domain`` gauge), per-leg
+               collective wire bytes (inner vs the slow outer leg vs
+               the flat baseline), and the KV-migration locality
+               split (local-domain vs cross-domain) — the
+               cross-domain-pressure runbook row reads this after
+               ``obs serve`` ($TOP_ITERS bounds refreshes; ^C exits).
 - ``obs profile`` — cluster-wide device profiling: simultaneous
                jax.profiler XPlane capture on every registered node
                via the built-in ptype.Profile endpoint
@@ -180,6 +188,7 @@ def _eval() -> None:
     from ptype_tpu.checkpoint import Checkpointer
     from ptype_tpu.models import transformer as tfm
     from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.topology import DATA_AXIS
     from ptype_tpu.train.data import TokenFileDataset, synthetic_batches
     from ptype_tpu.train.trainer import Trainer, default_optimizer
 
@@ -196,7 +205,7 @@ def _eval() -> None:
         raise SystemExit(2)
 
     cfg = tfm.preset(os.environ.get("PRESET", "tiny"))
-    mesh = build_mesh({"data": jax.device_count()})
+    mesh = build_mesh({DATA_AXIS: jax.device_count()})
     steps = int(os.environ.get("EVAL_STEPS", "10"))
     batch = int(os.environ.get("BATCH", str(8 * mesh.devices.size)))
     seq = int(os.environ.get("SEQ", "1024"))
@@ -406,6 +415,17 @@ def _obs() -> None:
                           iters=int(os.environ.get("TOP_ITERS", "0")),
                           interval_s=float(
                               os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "topo":
+            from ptype_tpu.health import run_topo
+
+            try:
+                run_topo(CoordRegistry(coord),
+                         iters=int(os.environ.get("TOP_ITERS", "0")),
+                         interval_s=float(
+                             os.environ.get("TOP_INTERVAL", "2")))
             except KeyboardInterrupt:
                 pass
             return

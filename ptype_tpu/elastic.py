@@ -44,6 +44,7 @@ import jax
 
 from ptype_tpu import chaos, logs
 from ptype_tpu.errors import ClusterError
+from ptype_tpu.parallel.topology import DATA_AXIS
 
 log = logs.get_logger("elastic")
 
@@ -166,7 +167,7 @@ class ElasticTrainer:
     """GSPMD trainer + failure detector + checkpoint-reshard-resume."""
 
     def __init__(self, cfg, registry, service_name: str, ckpt_dir: str,
-                 mesh_axis: str = "data", optimizer=None,
+                 mesh_axis: str = DATA_AXIS, optimizer=None,
                  rng: jax.Array | None = None):
         from ptype_tpu.checkpoint import Checkpointer
         from ptype_tpu.train.trainer import default_optimizer
@@ -274,7 +275,7 @@ class ElasticZeroTrainer:
     """
 
     def __init__(self, cfg, registry, service_name: str,
-                 mesh_axis: str = "data", zero=2,
+                 mesh_axis: str = DATA_AXIS, zero=2,
                  rng: jax.Array | None = None, wire=None,
                  zero_hparams=None):
         from ptype_tpu.parallel.mesh import build_mesh

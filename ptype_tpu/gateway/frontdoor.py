@@ -109,6 +109,13 @@ class GatewayConfig:
     #: decode-class scale hint (prefill scales on queue/TTFT, decode
     #: on KV headroom and inter-token tail).
     slo_tpot_p99_ms: float | None = None
+    #: This gateway's own topology domain (ISSUE 18,
+    #: parallel/topology.py): the locality preference carried into
+    #: every routing pick — replicas advertising the same domain win
+    #: over out-of-domain scores, affinity hashes within the local
+    #: stable set, and the per-class scale hints ask the reconciler
+    #: to fill this domain first. None = topology-blind routing.
+    domain: int | None = None
 
 
 def _count_generated(result, stop_token: int) -> int:
@@ -159,6 +166,8 @@ class InferenceGateway:
         #: holders, content-verified; steers the decode pick so shared
         #: prefixes migrate once and dedup after.
         self.directory = PrefixDirectory(self.cfg.directory_blocks)
+        self._mreg = (metrics_registry if metrics_registry is not None
+                      else metrics_mod.metrics)
         self._closed = False
 
     # ----------------------------------------------------------- capacity
@@ -263,7 +272,8 @@ class InferenceGateway:
             if remaining <= 0:
                 break
             with trace.span("gateway.route") as rsp:
-                r = self.pool.pick(affinity_key, exclude=tried)
+                r = self.pool.pick(affinity_key, exclude=tried,
+                                   prefer_domain=self.cfg.domain)
                 rsp.set_attr("replica", r.key if r is not None else None)
             if r is None:
                 # Fleet momentarily empty (mass eviction / churn):
@@ -484,7 +494,8 @@ class InferenceGateway:
                     gen["top_k"], gen["top_p"], gen["stop_token"])
         mig_args = gen_args
         # ---- stage 1: prefill-class pick + Prefill
-        pre = self.pool.pick(affinity_key, serve_class="prefill")
+        pre = self.pool.pick(affinity_key, serve_class="prefill",
+                             prefer_domain=self.cfg.domain)
         if pre is None or pre.conn is None or not pre.conn.healthy:
             return self._dispatch(self.cfg.generate_method, gen_args,
                                   deadline, affinity_key, counter)
@@ -527,6 +538,16 @@ class InferenceGateway:
             self._release_export(pre, export_id)
             return self._disagg_fallback(pre, gen_args, deadline,
                                          counter, t0)
+        # Locality ledger (ISSUE 18): every migration attempt counts
+        # as intra- or cross-domain — the ``obs topo`` view and the
+        # gateway drill's pressure assertion read these. Only when
+        # both sides advertise a domain: a topology-blind fleet has
+        # nothing meaningful to count.
+        pre_dom, dec_dom = pre.domain(), dec.domain()
+        if pre_dom is not None and dec_dom is not None:
+            self._mreg.counter(
+                "serve.migrate.local_domain" if dec_dom == pre_dom
+                else "serve.migrate.cross_domain").add(1)
         ticket = None
         truncate = False
         try:
@@ -621,13 +642,26 @@ class InferenceGateway:
         first (blocks NOT shipped), load second. Eviction counters
         are folded in before the directory is trusted — a replica
         whose pool churned drops its entries here, not after a
-        mis-route."""
+        mis-route.
+
+        Locality (ISSUE 18): the migration wire rides the fast
+        intra-domain leg only when the decode pick shares the prefill
+        replica's topology domain — so when ANY in-domain candidate
+        exists, out-of-domain ones (even directory holders) are
+        dropped: re-shipping blocks inside the domain beats a
+        cross-domain hit on the slow leg. A domain-blind fleet (no
+        advertised domains) is unaffected."""
         cands = [r for r in self.pool.healthy_class("decode")
                  if r.key != pre.key
                  and r.conn is not None and r.conn.healthy
                  and r.lifecycle() != "draining"]
         if not cands:
             return None
+        pre_dom = pre.domain()
+        if pre_dom is not None:
+            local = [r for r in cands if r.domain() == pre_dom]
+            if local:
+                cands = local
         for r in cands:
             self.directory.note_evictions(r.key, r.kv_evictions())
         best, best_ov = None, -1
@@ -697,6 +731,20 @@ class InferenceGateway:
         inflight = sum(r.inflight for r in reps)
         signals = {"serve_class": serve_class, "n_replicas": n,
                    "queue_depth": queue, "inflight": inflight}
+        # The domain dimension (ISSUE 18): per-domain replica counts
+        # for this class, plus where the NEXT replica should land —
+        # the reconciler passes ``spawn_domain`` to its launcher so
+        # scale-ups fill the local domain before spilling across the
+        # slow leg. Only when topology is in play (a configured
+        # gateway domain or any advertising replica).
+        doms: dict[str, int] = {}
+        for r in reps:
+            d = r.domain()
+            if d is not None:
+                doms[str(d)] = doms.get(str(d), 0) + 1
+        if doms or self.cfg.domain is not None:
+            signals["domains"] = doms
+            signals["spawn_domain"] = self._spawn_domain(doms)
         if serve_class == "prefill":
             ttft = self.slo.h_ttft.percentile(99)
             signals["ttft_p99_ms"] = round(ttft, 2)
@@ -735,6 +783,20 @@ class InferenceGateway:
                 return ScaleHint(-1, "decode pool idle", signals)
             return ScaleHint(0, "steady", signals)
         return ScaleHint(0, f"unknown class {serve_class!r}", signals)
+
+    def _spawn_domain(self, doms: dict[str, int]) -> int | None:
+        """Where the next replica of a class should land: the
+        gateway's own domain while it is no fuller than the emptiest
+        populated domain ("fill the local domain first"), else the
+        least-populated advertised domain (lowest ordinal on ties —
+        deterministic, so repeated hints don't oscillate)."""
+        local = self.cfg.domain
+        if not doms:
+            return local
+        least = min(doms.values())
+        if local is not None and doms.get(str(local), 0) <= least:
+            return int(local)
+        return min((int(k) for k, v in doms.items() if v == least))
 
     def disagg_hints(self) -> dict:
         """Both per-class hints at once (``GatewayActor.Info`` /

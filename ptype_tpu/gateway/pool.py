@@ -132,6 +132,23 @@ class Replica:
             v = self.reported.get("serve_class")
             return None if v is None else str(v)
 
+    def domain(self) -> int | None:
+        """Topology domain this replica lives in (the fast-ICI island,
+        parallel/topology.py). The registry advertisement
+        (``node.metadata["domain"]``, stamped by the launcher) wins;
+        else the probe-reported value; None when neither side is
+        topology-aware — the whole fleet then shares one implicit
+        domain and every locality preference is a no-op."""
+        meta = getattr(self.node, "metadata", None) or {}
+        v = meta.get("domain")
+        if v is None:
+            with self.lock:
+                v = self.reported.get("domain")
+        try:
+            return None if v is None else int(v)
+        except (TypeError, ValueError):
+            return None
+
     def kv_evictions(self) -> int | None:
         """Replica-reported cumulative LRU eviction count
         (``kv_evictions`` in ``BlockPool.stats``): the prefix
@@ -153,6 +170,7 @@ class Replica:
             return None
 
     def snapshot(self) -> dict:
+        dom = self.domain()  # resolved before taking the lock
         with self.lock:
             snap = {"key": self.key, "up": self.up,
                     "inflight": self.inflight, "calls": self.calls,
@@ -193,6 +211,10 @@ class Replica:
             if "spec_accept_rate" in self.reported:
                 snap["spec_accept_rate"] = float(
                     self.reported["spec_accept_rate"] or 0.0)
+            # Topology domain (ISSUE 18): the ``obs topo`` view's
+            # per-domain replica counts — only when advertised.
+            if dom is not None:
+                snap["domain"] = dom
             return snap
 
 
@@ -443,7 +465,8 @@ class ReplicaPool:
 
     def pick(self, affinity_key: str | None = None,
              exclude=(),
-             serve_class: str | None = None) -> Replica | None:
+             serve_class: str | None = None,
+             prefer_domain: int | None = None) -> Replica | None:
         """Route one request: affinity first (when sane), else least
         loaded. None when the fleet has no healthy replica.
 
@@ -455,7 +478,16 @@ class ReplicaPool:
         ``serve_class`` (ISSUE 16) narrows to one serving class —
         softly, via :meth:`healthy_class`: the two-stage router's
         prefill/decode picks, degrading to the whole fleet when no
-        replica reports the class."""
+        replica reports the class.
+
+        ``prefer_domain`` (ISSUE 18) is the locality preference: a
+        replica in that topology domain beats any out-of-domain score
+        (its KV/prefix traffic stays on the fast intra-domain leg),
+        but never a replica that can't serve — draining and
+        KV-exhausted still sort last, and a domain with no healthy
+        member degrades to the whole fleet. Affinity hashing is
+        likewise restricted to the in-domain stable set when one
+        exists, so a key's pinned replica is local when it can be."""
         candidates = (self.healthy() if serve_class is None
                       else self.healthy_class(serve_class))
         if not candidates:
@@ -471,10 +503,18 @@ class ReplicaPool:
         # report neither signal are unaffected.
         candidates.sort(key=lambda r: (r.lifecycle() == "draining",
                                        r.kv_free_blocks() == 0,
+                                       prefer_domain is not None
+                                       and r.domain() is not None
+                                       and r.domain() != prefer_domain,
                                        r.score(), r.key))
         chosen = candidates[0]
         if affinity_key is not None and len(candidates) > 1:
             stable = sorted(candidates, key=lambda r: r.key)
+            if prefer_domain is not None:
+                local = [r for r in stable
+                         if r.domain() == prefer_domain]
+                if local:
+                    stable = local
             pinned = stable[rpc_mod.fnv32a(affinity_key) % len(stable)]
             # Affinity yields to load: a warm prefix cache is worth a
             # bounded cost multiple, not a wedged replica. It also

@@ -37,8 +37,9 @@ from ptype_tpu.health.series import (Sampler, SeriesRing, SeriesStore,
 from ptype_tpu.health.serving import (RequestRecord, ServingLedger,
                                       measure_seam_cost_us)
 from ptype_tpu.health.top import (render_jit, render_scale,
-                                  render_serve, render_top, run_jit,
-                                  run_scale, run_serve, run_top)
+                                  render_serve, render_top,
+                                  render_topo, run_jit, run_scale,
+                                  run_serve, run_top, run_topo)
 
 __all__ = [
     "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
@@ -55,4 +56,5 @@ __all__ = [
     "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
     "render_scale", "run_scale", "render_jit", "run_jit",
+    "render_topo", "run_topo",
 ]

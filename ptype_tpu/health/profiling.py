@@ -645,11 +645,12 @@ def measure_profile_overhead(steps: int = 12, preset: str = "tiny",
     from ptype_tpu.health import goodput as goodput_mod
     from ptype_tpu.models import transformer as tfm
     from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.topology import DATA_AXIS
     from ptype_tpu.parallel.tensorstore import TensorStore
     from ptype_tpu.train.data import synthetic_batches
     from ptype_tpu.train.store_dp import StoreDPTrainer
 
-    mesh = build_mesh({"data": jax.device_count()})
+    mesh = build_mesh({DATA_AXIS: jax.device_count()})
     cfg = tfm.preset(preset)
     trainer = StoreDPTrainer(cfg, TensorStore(mesh))
     stream = synthetic_batches(cfg.vocab_size, batch, seq)

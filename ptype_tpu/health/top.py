@@ -291,6 +291,108 @@ def render_scale(snapshot: dict, alerts=(),
     return "\n".join(lines)
 
 
+def render_topo(snapshot: dict, alerts=(),
+                max_nodes: int = 32) -> str:
+    """``obs topo``: the topology one-pager (ISSUE 18). Top:
+    per-domain replica counts — every node exporting the
+    ``serve.domain`` gauge (stamped by ReplicaHost from its
+    placement) grouped by domain ordinal, with lifecycle and
+    queue/live occupancy folded per domain. Middle: per-leg
+    collective wire traffic from the ``collectives.leg_bytes.*``
+    counters (fast inner leg vs slow outer leg vs the flat-baseline
+    footprint) on every node that launched hierarchical buckets.
+    Bottom: the gateway's KV-migration locality split
+    (``serve.migrate.local_domain`` vs ``.cross_domain``) — the
+    cross-domain-pressure runbook row lands here after ``obs
+    serve``."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+
+    def cnt(t, name):
+        return t.get("metrics", {}).get("counters", {}).get(name)
+
+    def num(v, fmt="{:.0f}", dash="-"):
+        return fmt.format(v) if v is not None else dash
+
+    domains: dict = {}
+    for key, t in sorted(nodes.items()):
+        d = _gauge(t, "serve.domain")
+        if d is None:
+            continue
+        domains.setdefault(int(d), []).append((key, t))
+    lines = [
+        f"ptype topology @ {snapshot.get('ts')} — "
+        f"{sum(len(v) for v in domains.values())} placed replicas "
+        f"in {len(domains)} domains ({len(nodes)} nodes, "
+        f"{len(errors)} unreachable)",
+        f"{'domain':<7} {'replicas':>9} {'active':>7} {'drng':>5} "
+        f"{'q':>4} {'live':>5}",
+    ]
+    for d in sorted(domains):
+        rows = domains[d]
+        states = [_lifecycle_name(_gauge(t, "serve.lifecycle"))
+                  for _, t in rows]
+        q = sum(_gauge(t, "serve.queue_depth") or 0 for _, t in rows)
+        live = sum(_gauge(t, "serve.active_slots") or 0
+                   for _, t in rows)
+        names = " ".join(k[:24] for k, _ in rows[:4])
+        lines.append(
+            f"{d:<7} {len(rows):>9} "
+            f"{states.count('active'):>7} "
+            f"{states.count('draining'):>5} {q:>4.0f} {live:>5.0f}  "
+            f"{names}")
+    if not domains:
+        lines.append("  (no node exports serve.domain — flat fleet, "
+                     "or replicas predate the topology story)")
+
+    lines.append("")
+    lines.append(f"{'node':<28} {'launches':>9} {'innerB':>9} "
+                 f"{'outerB':>9} {'flatB':>9} {'slow%':>6}")
+    any_legs = False
+    for key in sorted(nodes)[:max_nodes]:
+        t = nodes[key]
+        launches = cnt(t, "collectives.hier_launches")
+        if not launches:
+            continue
+        any_legs = True
+        inner = cnt(t, "collectives.leg_bytes.inner") or 0
+        outer = cnt(t, "collectives.leg_bytes.outer") or 0
+        flat = cnt(t, "collectives.leg_bytes.flat_outer") or 0
+        pct = 100.0 * outer / flat if flat else None
+        lines.append(
+            f"{key[:28]:<28} {launches:>9.0f} "
+            f"{_fmt_bytes(inner):>9} {_fmt_bytes(outer):>9} "
+            f"{_fmt_bytes(flat):>9} {num(pct, '{:.1f}'):>6}")
+    if not any_legs:
+        lines.append("  (no hierarchical collective launches — flat "
+                     "axis everywhere)")
+
+    lines.append("")
+    loc = sum(cnt(t, "serve.migrate.local_domain") or 0
+              for t in nodes.values())
+    x = sum(cnt(t, "serve.migrate.cross_domain") or 0
+            for t in nodes.values())
+    tot = loc + x
+    tail = (f" ({100.0 * x / tot:.1f}% crossing the slow leg)"
+            if tot else "")
+    lines.append(f"KV migrations: {loc:.0f} local-domain, "
+                 f"{x:.0f} cross-domain{tail}")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
 def render_jit(snapshot: dict, alerts=(), max_nodes: int = 32,
                max_fns: int = 12) -> str:
     """``obs jit``: the dispatch-discipline one-pager (ISSUE 15) —
@@ -384,6 +486,20 @@ def run_scale(registry, iters: int = 0, interval_s: float = 2.0,
                    engine=engine, services=services,
                    include_local=include_local, out=out, clear=clear,
                    render=render_scale)
+
+
+def run_topo(registry, iters: int = 0, interval_s: float = 2.0,
+             engine: AlertEngine | None = None,
+             services: list[str] | None = None,
+             include_local: bool = False, out=None,
+             clear: bool = True) -> AlertEngine:
+    """The ``obs topo`` loop: :func:`run_top`'s poll contract with
+    the topology rendering (domain placement, per-leg wire traffic,
+    migration locality)."""
+    return run_top(registry, iters=iters, interval_s=interval_s,
+                   engine=engine, services=services,
+                   include_local=include_local, out=out, clear=clear,
+                   render=render_topo)
 
 
 def run_serve(registry, iters: int = 0, interval_s: float = 2.0,

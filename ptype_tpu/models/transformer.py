@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ptype_tpu.parallel.topology import DATA_AXIS
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -665,7 +667,8 @@ def batch_spec(axis_sizes: dict[str, int], seq_axis: bool = False) -> P:
     """Token batch sharding: batch dim over every data-like axis present
     (data + fsdp both act as data for activations); optionally the seq
     dim over ``seq`` (ring attention)."""
-    batch_axes = tuple(a for a in ("data", "fsdp") if a in axis_sizes)
+    batch_axes = tuple(a for a in (DATA_AXIS, "fsdp")
+                       if a in axis_sizes)
     first = batch_axes if batch_axes else None
     second = "seq" if (seq_axis and "seq" in axis_sizes) else None
     return P(first, second)

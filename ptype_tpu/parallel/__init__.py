@@ -9,8 +9,17 @@ PP / SP / EP as first-class components.
 """
 
 from ptype_tpu.parallel.mesh import (  # noqa: F401
+    axis_n,
     build_mesh,
     local_mesh,
     mesh_from_registry,
     named_sharding,
+)
+from ptype_tpu.parallel.topology import (  # noqa: F401
+    DATA_AXIS,
+    HIER_AXIS,
+    INNER_AXIS,
+    OUTER_AXIS,
+    LegWire,
+    Topology,
 )

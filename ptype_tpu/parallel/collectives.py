@@ -23,6 +23,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu.compat import axis_size, shard_map
+from ptype_tpu.parallel.mesh import axis_n
+from ptype_tpu.parallel.topology import (INNER_AXIS, OUTER_AXIS,
+                                         Topology)
 
 _REDUCERS = ("sum", "mean", "max", "min")
 
@@ -61,7 +64,7 @@ def all_reduce(stacked: jax.Array, mesh: Mesh, axis: str = "data",
     """
     if op not in _REDUCERS:
         raise ValueError(f"all_reduce: op must be one of {_REDUCERS}")
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     if stacked.shape[0] != n:
         raise ValueError(
             f"all_reduce: leading dim {stacked.shape[0]} != axis size {n}"
@@ -126,7 +129,7 @@ def reduce_scatter(stacked: jax.Array, mesh: Mesh, axis: str = "data",
         raise ValueError(
             f"reduce_scatter: op must be 'sum' or 'mean', got {op!r}"
         )
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     if stacked.ndim < 2 or stacked.shape[1] % n != 0:
         raise ValueError(
             f"reduce_scatter: payload dim 0 ({stacked.shape[1:]}) must "
@@ -176,7 +179,7 @@ def _all_to_all_fn(mesh: Mesh, axis: str, ndim: int):
 def all_to_all(stacked: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     """Transpose shard ownership: device i's chunk j goes to device j —
     the EP/Ulysses exchange. ``(n, n*chunk, *rest)`` sharded on dim 0."""
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     if stacked.ndim < 2 or stacked.shape[1] % n != 0:
         raise ValueError(
             f"all_to_all: payload dim 0 must divide by axis size {n}"
@@ -331,7 +334,7 @@ def quantized_reduce_scatter(stacked: jax.Array, mesh: Mesh,
     reduction for consumers that are themselves sharded, ZeRO/FSDP
     style). Same shape contract and error bound as the allreduce's
     first phase (one round-to-nearest quantization)."""
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     if not quantized_all_reduce_eligible(stacked.shape, n, op):
         raise ValueError(
             f"quantized_reduce_scatter: need op in sum/mean (got "
@@ -371,7 +374,7 @@ def quantized_all_reduce(stacked: jax.Array, mesh: Mesh,
     ``stacked``: ``(axis_size, *rest)`` with ``rest[0] % axis_size
     == 0``; returns ``rest``, replicated.
     """
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     if not quantized_all_reduce_eligible(stacked.shape, n, op):
         raise ValueError(
             f"quantized_all_reduce: need op in sum/mean (got {op!r}), "
@@ -695,6 +698,324 @@ def _bucket_reduce_scatter_fn(mesh: Mesh, axis: str, op: str,
                              out_specs=out_specs, check_vma=False))
 
 
+# --------------------------------------- hierarchical (2-D) programs
+#
+# Real fleets are hierarchical: fast ICI inside a pod (the topology's
+# ``inner`` axis), slow DCN between pods (``outer``). The flat ring
+# over a 2-D layout crosses domains on ~every hop, so it prices the
+# WHOLE payload at the slow leg. The hierarchical decomposition
+# (PAPERS.md arXiv 1909.09756) reduce-scatters inside the fast domain,
+# exchanges only 1/n_inner of the bytes across the slow leg, and
+# allgathers back out — with the int8+EF wire resolved PER LEG
+# (EQuARX: quantize the slow hop harder). Error feedback follows the
+# flat paths' ownership discipline, per leg:
+#
+# - the INNER residual is the producer's own phase-1 quantization
+#   error across its whole contribution (plus, on the allreduce, its
+#   share of the gather-leg error at its own chunk offset — divided by
+#   n_outer since every domain's copy of that chunk folds the same
+#   deterministic error);
+# - the OUTER residual is the error of quantizing the inner-RS chunk
+#   this device carries into the cross-domain exchange — it re-owns
+#   the same chunk next step, so adding it back pre-quantize cancels
+#   it in the next reduction. It is a per-bucket FLAT vector (chunk
+#   boundaries cut across leaf slots), keyed per bucket by callers.
+
+
+def _hier_allreduce_body(flat, ni: int, no: int, op: str,
+                         wire_in, wire_out, qb_in, qb_out,
+                         res_in, res_out):
+    """Three legs on one device's flat contribution ``flat`` (length
+    ``E``, ``E % (ni*no) == 0``): inner reduce-scatter → outer
+    exchange of the ``E/ni`` chunk → inner allgather. Size-1 legs are
+    skipped (identity), so every (outer, inner) factorization lowers
+    through the same body. Returns ``(out (E,), new_res_in (E,) |
+    None, new_res_out (E/ni,) | None)``; all error terms live in sum
+    space (mean divides at the very end, like the flat bodies)."""
+    E = flat.shape[0]
+    c1 = E // ni
+    quant = wire_in == "int8" or wire_out == "int8"
+    xf = flat.astype(jnp.float32) if quant else flat
+    if res_in is not None:
+        xf = xf + res_in.astype(jnp.float32)
+    # -- leg 1: reduce-scatter inside the fast inner domain.
+    new_res_in = None
+    if ni == 1:
+        red = xf
+    elif wire_in == "int8":
+        red, err1 = _int8_phase1(xf, INNER_AXIS, "sum", qb_in)
+        if res_in is not None:
+            new_res_in = err1.reshape(xf.shape)
+    else:
+        w = xf.astype(jnp.bfloat16) if wire_in == "bf16" else xf
+        red = lax.psum_scatter(w, INNER_AXIS, scatter_dimension=0,
+                               tiled=True)
+        if wire_in == "bf16":
+            red = red.astype(xf.dtype)
+    # -- leg 2: exchange only this 1/ni chunk across the slow leg.
+    new_res_out = None
+    if no > 1:
+        if wire_out == "int8":
+            red, new_res_out = _int8_all_reduce_body(
+                red, OUTER_AXIS, "sum", qb_out, res_out)
+        else:
+            w = red.astype(jnp.bfloat16) if wire_out == "bf16" else red
+            red = lax.psum(w, OUTER_AXIS)
+            if wire_out == "bf16":
+                red = red.astype(xf.dtype)
+    # -- leg 3: allgather the reduced chunk back out, fast leg again.
+    if ni == 1:
+        out = red
+    elif wire_in == "int8":
+        q2, s2 = _q_int8_blockwise(red[None], qb_in)
+        err3 = red - _dq_int8_blockwise(q2, s2, c1)[0]
+        qg = lax.all_gather(q2[0], INNER_AXIS)
+        sg = lax.all_gather(s2[0], INNER_AXIS)
+        out = _dq_int8_blockwise(qg, sg, c1).reshape(xf.shape)
+        if new_res_in is not None:
+            # Every domain holds an identical copy of this chunk and
+            # folds the same deterministic gather error — divide by
+            # n_outer so the next step's sum corrects it exactly once.
+            idx = lax.axis_index(INNER_AXIS)
+            mine = lax.dynamic_slice(new_res_in, (idx * c1,), (c1,)) \
+                + err3 / no
+            new_res_in = lax.dynamic_update_slice(new_res_in, mine,
+                                                  (idx * c1,))
+    else:
+        w = red.astype(jnp.bfloat16) if wire_in == "bf16" else red
+        out = lax.all_gather(w, INNER_AXIS, tiled=True)
+        if wire_in == "bf16":
+            out = out.astype(xf.dtype)
+    if op == "mean":
+        out = out / (ni * no)
+    return out, new_res_in, new_res_out
+
+
+def _hier_reduce_scatter_body(flat, ni: int, no: int, op: str,
+                              wire_in, wire_out, qb_in, qb_out,
+                              res_in, res_out):
+    """The scatter half of :func:`_hier_allreduce_body` (no gather
+    leg): inner reduce-scatter, then outer reduce-scatter of the
+    ``E/ni`` chunk. Chunk ordering matches the flat composite-axis
+    reduce-scatter exactly, so ZeRO's flat shards ride unchanged.
+    Returns ``(shard (E/(ni*no),), new_res_in, new_res_out)``."""
+    quant = wire_in == "int8" or wire_out == "int8"
+    xf = flat.astype(jnp.float32) if quant else flat
+    if res_in is not None:
+        xf = xf + res_in.astype(jnp.float32)
+    new_res_in = None
+    if ni == 1:
+        red = xf
+    elif wire_in == "int8":
+        red, err1 = _int8_phase1(xf, INNER_AXIS, "sum", qb_in)
+        if res_in is not None:
+            new_res_in = err1.reshape(xf.shape)
+    else:
+        w = xf.astype(jnp.bfloat16) if wire_in == "bf16" else xf
+        red = lax.psum_scatter(w, INNER_AXIS, scatter_dimension=0,
+                               tiled=True)
+        if wire_in == "bf16":
+            red = red.astype(xf.dtype)
+    new_res_out = None
+    if no > 1:
+        if wire_out == "int8":
+            rf = red.astype(jnp.float32)
+            if res_out is not None:
+                rf = rf + res_out.astype(jnp.float32)
+            shard, err_o = _int8_phase1(rf, OUTER_AXIS, "sum", qb_out)
+            if res_out is not None:
+                new_res_out = err_o.reshape(rf.shape)
+        else:
+            w = red.astype(jnp.bfloat16) if wire_out == "bf16" else red
+            shard = lax.psum_scatter(w, OUTER_AXIS,
+                                     scatter_dimension=0, tiled=True)
+            if wire_out == "bf16":
+                shard = shard.astype(xf.dtype)
+    else:
+        shard = red
+    if op == "mean":
+        shard = shard / (ni * no)
+    return shard, new_res_in, new_res_out
+
+
+@functools.lru_cache(maxsize=512)
+def _hier_bucket_all_reduce_fn(mesh: Mesh, op: str, shapes: tuple,
+                               dtype: str, pad: int,
+                               wire_in, wire_out, restore: bool,
+                               qb_in, qb_out,
+                               ef_in: bool = False,
+                               ef_out: bool = False):
+    """Hierarchical counterpart of :func:`_bucket_all_reduce_fn`: ONE
+    fused program per bucket over the 2-D mesh — inner reduce-scatter,
+    outer exchange, inner allgather, with per-leg wire formats and
+    per-leg error-feedback operands. Operand order: ``*leaves``
+    (stacked over the composite axis), then stacked inner residuals
+    when ``ef_in``, then the flat outer residual (global ``(n * E/ni,)``
+    f32, sharded over the composite axis) when ``ef_out``. Outputs
+    mirror: reduced leaves, new inner residuals, new outer residual."""
+    ax = (INNER_AXIS, OUTER_AXIS)
+    ni = int(mesh.shape[INNER_AXIS])
+    no = int(mesh.shape[OUTER_AXIS])
+    stacked = tuple(P(ax, *(None,) * len(s)) for s in shapes)
+    in_specs = stacked
+    out_specs = tuple(P(*(None,) * len(s)) for s in shapes)
+    if ef_in:
+        in_specs = in_specs + stacked
+        out_specs = out_specs + stacked
+    if ef_out:
+        in_specs = in_specs + (P(ax),)
+        out_specs = out_specs + (P(ax),)
+    offs = _slot_offsets(shapes)
+    k = len(shapes)
+
+    def f(*locals_):
+        flat = _pack_flat(locals_[:k], pad)
+        pos = k
+        res_in = None
+        if ef_in:
+            res_in = _pack_flat(locals_[pos:pos + k], pad)
+            pos += k
+        res_out = locals_[pos] if ef_out else None
+        out, nri, nro = _hier_allreduce_body(
+            flat, ni, no, op, wire_in, wire_out, qb_in, qb_out,
+            res_in, res_out)
+        if restore:
+            out = out.astype(jnp.dtype(dtype))
+        outs = _unpack(out, offs)
+        if ef_in:
+            # ef_in is armed only with the int8 inner leg (the stream
+            # layer's contract) — a missing residual would silently
+            # wipe carried error; fail loudly at trace time.
+            assert nri is not None, "ef_in requires the int8 inner leg"
+            outs = outs + tuple(
+                r[None] for r in _unpack(
+                    nri.astype(jnp.dtype(dtype)), offs))
+        if ef_out:
+            assert nro is not None, "ef_out requires the int8 outer leg"
+            outs = outs + (nro.astype(jnp.float32),)
+        return outs
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
+
+
+@functools.lru_cache(maxsize=512)
+def _hier_bucket_reduce_scatter_fn(mesh: Mesh, op: str, shapes: tuple,
+                                   dtype: str, pad: int,
+                                   wire_in, wire_out, restore: bool,
+                                   qb_in, qb_out,
+                                   ef_in: bool = False,
+                                   ef_out: bool = False):
+    """Hierarchical counterpart of :func:`_bucket_reduce_scatter_fn`:
+    inner reduce-scatter then outer reduce-scatter of the chunk —
+    each device ends with the SAME flat ``elems/n`` shard the flat
+    composite-axis scatter would give it (ZeRO consumes it
+    unchanged). Same operand/result order as the hier allreduce,
+    with the scattered flat shard first."""
+    ax = (INNER_AXIS, OUTER_AXIS)
+    ni = int(mesh.shape[INNER_AXIS])
+    no = int(mesh.shape[OUTER_AXIS])
+    stacked = tuple(P(ax, *(None,) * len(s)) for s in shapes)
+    in_specs = stacked
+    out_specs: tuple = (P(ax),)
+    if ef_in:
+        in_specs = in_specs + stacked
+        out_specs = out_specs + stacked
+    if ef_out:
+        in_specs = in_specs + (P(ax),)
+        out_specs = out_specs + (P(ax),)
+    offs = _slot_offsets(shapes)
+    k = len(shapes)
+
+    def f(*locals_):
+        flat = _pack_flat(locals_[:k], pad)
+        pos = k
+        res_in = None
+        if ef_in:
+            res_in = _pack_flat(locals_[pos:pos + k], pad)
+            pos += k
+        res_out = locals_[pos] if ef_out else None
+        shard, nri, nro = _hier_reduce_scatter_body(
+            flat, ni, no, op, wire_in, wire_out, qb_in, qb_out,
+            res_in, res_out)
+        if restore:
+            shard = shard.astype(jnp.dtype(dtype))
+        outs = (shard,)
+        if ef_in:
+            assert nri is not None, "ef_in requires the int8 inner leg"
+            outs = outs + tuple(
+                r[None] for r in _unpack(
+                    nri.astype(jnp.dtype(dtype)), offs))
+        if ef_out:
+            assert nro is not None, "ef_out requires the int8 outer leg"
+            outs = outs + (nro.astype(jnp.float32),)
+        return outs if len(outs) > 1 else outs[0]
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=(out_specs if ef_in or ef_out
+                                        else out_specs[0]),
+                             check_vma=False))
+
+
+def _wire_scale(wire, q_block, itemsize: int) -> float:
+    """Bytes-on-the-wire multiplier of a leg's format vs the bucket's
+    native dtype (f32 scale overhead included for int8)."""
+    if wire == "bf16":
+        return 2.0 / itemsize
+    if wire == "int8":
+        qb = q_block if q_block else DEFAULT_QUANT_BLOCK
+        return (1.0 + 4.0 / qb) / itemsize
+    return 1.0
+
+
+def _resolve_leg_wires(topo: Topology, bucket: Bucket, op: str,
+                       compress, int8_min_bytes, q_block):
+    """Per-leg wire resolution for one bucket: the topology's leg
+    policy overrides the caller's flat setting, then the bucket-level
+    eligibility gate (:func:`_bucket_wire`) applies per leg, and
+    size-1 legs are forced exact (their collectives are skipped)."""
+    c_in, qb_in = topo.resolve_leg(INNER_AXIS, compress, q_block)
+    c_out, qb_out = topo.resolve_leg(OUTER_AXIS, compress, q_block)
+    wire_in = _bucket_wire(bucket, op, c_in, int8_min_bytes)
+    wire_out = _bucket_wire(bucket, op, c_out, int8_min_bytes)
+    if int(topo.n_inner) == 1:
+        wire_in = None
+    if int(topo.n_outer) == 1:
+        wire_out = None
+    restore = c_in is not None or c_out is not None
+    return wire_in, wire_out, qb_in, qb_out, restore
+
+
+def _count_leg_bytes(topo: Topology, bucket: Bucket, kind: str,
+                     wire_in, wire_out, qb_in, qb_out) -> None:
+    """Analytic per-leg wire-byte accounting for one hierarchical
+    bucket launch — the metrics family ``obs topo`` and the bench
+    read. Bytes are per device, scaled by each leg's wire format."""
+    from ptype_tpu.metrics import metrics
+
+    itemsize = jnp.dtype(bucket.dtype).itemsize
+    legs = topo.leg_bytes(bucket.elems * itemsize, kind)
+    inner = legs["inner"] * _wire_scale(wire_in, qb_in, itemsize)
+    outer = legs["outer"] * _wire_scale(wire_out, qb_out, itemsize)
+    metrics.counter("collectives.leg_bytes.inner").add(int(inner))
+    metrics.counter("collectives.leg_bytes.outer").add(int(outer))
+    metrics.counter("collectives.leg_bytes.flat_outer").add(
+        int(legs["flat_outer"]))
+    metrics.counter("collectives.hier_launches").add(1)
+
+
+def _seed_outer_residual(outer_residuals, bi: int, want: tuple,
+                         mesh: Mesh):
+    """Pop bucket ``bi``'s flat outer-leg residual from the caller's
+    dict (zeros when absent or shape-stale — a replan changed the
+    bucket) and place it sharded over the composite axis."""
+    ax = (INNER_AXIS, OUTER_AXIS)
+    r = outer_residuals.get(bi)
+    if r is None or tuple(r.shape) != want:
+        r = jnp.zeros(want, jnp.float32)
+    return jax.device_put(r, NamedSharding(mesh, P(ax)))
+
+
 def bucketed_reduce_scatter_stream(leaves, mesh: Mesh,
                                    axis: str = "data", op: str = "sum",
                                    *,
@@ -705,7 +1026,9 @@ def bucketed_reduce_scatter_stream(leaves, mesh: Mesh,
                                    INT8_MIN_BUCKET_BYTES,
                                    q_block: int | None =
                                    DEFAULT_QUANT_BLOCK,
-                                   residuals: list | None = None):
+                                   residuals: list | None = None,
+                                   topology: Topology | None = None,
+                                   outer_residuals: dict | None = None):
     """Reduce-scatter counterpart of :func:`bucketed_all_reduce_stream`
     — the gradient leg of the ZeRO-style sharded weight update
     (parallel/zero.py): one fused reduce-scatter per bucket, yielding
@@ -718,6 +1041,14 @@ def bucketed_reduce_scatter_stream(leaves, mesh: Mesh,
     ``residuals``: per-leaf stacked error-feedback residuals aligned
     with ``leaves`` (None entries seed zeros); they engage only on
     buckets whose wire resolves to int8, like the allreduce stream.
+
+    ``topology``: a hierarchical :class:`Topology` routes every bucket
+    through the 2-leg decomposition (``axis`` must be the composite
+    ``("inner", "outer")`` tuple on the topology's mesh); the shard
+    layout is IDENTICAL to the flat path's, so consumers don't change.
+    ``outer_residuals``: mutable per-bucket dict of outer-leg EF flats
+    — read for the seed, updated in place after each dispatch (leaf
+    slots can't carry them: chunk boundaries cut across slots).
     """
     if op not in ("sum", "mean"):
         raise ValueError(
@@ -727,10 +1058,48 @@ def bucketed_reduce_scatter_stream(leaves, mesh: Mesh,
         raise ValueError(
             f"bucketed_reduce_scatter: unknown compression {compress!r}")
     leaves = [jnp.asarray(x) for x in leaves]
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     buckets = plan_buckets(leaves, n, bucket_bytes)
     placed = _place_stacked(leaves, mesh, axis)
-    for b in buckets:
+    for bi, b in enumerate(buckets):
+        if topology is not None:
+            wire_in, wire_out, qb_in, qb_out, restore = \
+                _resolve_leg_wires(topology, b, op, compress,
+                                   int8_min_bytes, q_block)
+            ef_in = wire_in == "int8" and residuals is not None
+            ef_out = (wire_out == "int8"
+                      and outer_residuals is not None)
+            fn = _hier_bucket_reduce_scatter_fn(
+                mesh, op, tuple(s.shape for s in b.slots), b.dtype,
+                b.pad, wire_in, wire_out, restore, qb_in, qb_out,
+                ef_in, ef_out)
+            args = [placed[s.index] for s in b.slots]
+            if ef_in:
+                args += _place_stacked(
+                    [residuals[s.index]
+                     if residuals[s.index] is not None
+                     and tuple(residuals[s.index].shape)
+                     == tuple(leaves[s.index].shape)
+                     else jnp.zeros_like(leaves[s.index])
+                     for s in b.slots], mesh, axis)
+            if ef_out:
+                args.append(_seed_outer_residual(
+                    outer_residuals, bi,
+                    (b.elems * int(topology.n_outer),), mesh))
+            outs = fn(*args)
+            _count_launch()
+            _count_leg_bytes(topology, b, "reduce_scatter",
+                             wire_in, wire_out, qb_in, qb_out)
+            if ef_out:
+                outer_residuals[bi] = outs[-1]
+                outs = outs[:-1]
+            if ef_in:
+                yield b, outs[0], list(outs[1:])
+            elif ef_out:
+                yield b, outs[0], None
+            else:
+                yield b, outs, None
+            continue
         wire = _bucket_wire(b, op, compress, int8_min_bytes)
         ef = wire == "int8" and residuals is not None
         fn = _bucket_reduce_scatter_fn(
@@ -773,7 +1142,9 @@ def bucketed_all_reduce_stream(leaves, mesh: Mesh, axis: str = "data",
                                compress: str | None = None,
                                int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
                                q_block: int | None = DEFAULT_QUANT_BLOCK,
-                               residuals: list | None = None):
+                               residuals: list | None = None,
+                               topology: Topology | None = None,
+                               outer_residuals: dict | None = None):
     """Generator core of :func:`bucketed_all_reduce`: dispatches one
     fused collective per bucket and yields
     ``(bucket, reduced_by_slot, new_residuals_by_slot | None)`` right
@@ -786,6 +1157,15 @@ def bucketed_all_reduce_stream(leaves, mesh: Mesh, axis: str = "data",
     with ``leaves`` (entries may be None → zeros). Residuals engage
     only on buckets whose wire resolves to int8; other buckets yield
     ``None`` and the caller keeps its residuals untouched.
+
+    ``topology``: a hierarchical :class:`Topology` routes sum/mean
+    buckets through the 3-leg decomposition (inner reduce-scatter,
+    outer exchange of ``1/n_inner`` of the bytes, inner allgather) —
+    ``axis`` must be the composite ``("inner", "outer")`` tuple on the
+    topology's mesh; max/min buckets fall back to the flat program
+    over the same composite axis (same numerics, no decomposition).
+    ``outer_residuals``: mutable per-bucket dict of outer-leg EF
+    flats, read for the seed and updated in place per dispatch.
     """
     if op not in _REDUCERS:
         raise ValueError(f"bucketed_all_reduce: op must be one of "
@@ -794,10 +1174,45 @@ def bucketed_all_reduce_stream(leaves, mesh: Mesh, axis: str = "data",
         raise ValueError(
             f"bucketed_all_reduce: unknown compression {compress!r}")
     leaves = [jnp.asarray(x) for x in leaves]
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     buckets = plan_buckets(leaves, n, bucket_bytes)
     placed = _place_stacked(leaves, mesh, axis)
-    for b in buckets:
+    for bi, b in enumerate(buckets):
+        if topology is not None and op in ("sum", "mean"):
+            wire_in, wire_out, qb_in, qb_out, restore = \
+                _resolve_leg_wires(topology, b, op, compress,
+                                   int8_min_bytes, q_block)
+            ef_in = wire_in == "int8" and residuals is not None
+            ef_out = (wire_out == "int8"
+                      and outer_residuals is not None)
+            fn = _hier_bucket_all_reduce_fn(
+                mesh, op, tuple(s.shape for s in b.slots), b.dtype,
+                b.pad, wire_in, wire_out, restore, qb_in, qb_out,
+                ef_in, ef_out)
+            args = [placed[s.index] for s in b.slots]
+            if ef_in:
+                args += _place_stacked(
+                    [residuals[s.index]
+                     if residuals[s.index] is not None
+                     and tuple(residuals[s.index].shape)
+                     == tuple(leaves[s.index].shape)
+                     else jnp.zeros_like(leaves[s.index])
+                     for s in b.slots], mesh, axis)
+            if ef_out:
+                args.append(_seed_outer_residual(
+                    outer_residuals, bi,
+                    (b.elems * int(topology.n_outer),), mesh))
+            outs = fn(*args)
+            _count_launch()
+            _count_leg_bytes(topology, b, "allreduce",
+                             wire_in, wire_out, qb_in, qb_out)
+            if ef_out:
+                outer_residuals[bi] = outs[-1]
+                outs = outs[:-1]
+            L = len(b.slots)
+            yield b, list(outs[:L]), (list(outs[L:]) if ef_in
+                                      else None)
+            continue
         wire = _bucket_wire(b, op, compress, int8_min_bytes)
         ef = wire == "int8" and residuals is not None
         fn = _bucket_all_reduce_fn(
@@ -824,7 +1239,9 @@ def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
                         compress: str | None = None,
                         int8_min_bytes: int = INT8_MIN_BUCKET_BYTES,
                         q_block: int | None = DEFAULT_QUANT_BLOCK,
-                        residuals: list | None = None):
+                        residuals: list | None = None,
+                        topology: Topology | None = None,
+                        outer_residuals: dict | None = None):
     """Allreduce a flat list of stacked leaves through dtype buckets.
 
     Numerically identical to per-leaf :func:`all_reduce` on the exact
@@ -844,7 +1261,8 @@ def bucketed_all_reduce(leaves, mesh: Mesh, axis: str = "data",
     for b, reduced, res in bucketed_all_reduce_stream(
             leaves, mesh, axis, op, bucket_bytes=bucket_bytes,
             compress=compress, int8_min_bytes=int8_min_bytes,
-            q_block=q_block, residuals=residuals):
+            q_block=q_block, residuals=residuals, topology=topology,
+            outer_residuals=outer_residuals):
         for i, (s, r) in enumerate(zip(b.slots, reduced)):
             out[s.index] = r
             if res is not None:
@@ -927,7 +1345,7 @@ def tree_reduce_scatter(stacked_tree, mesh: Mesh, axis: str = "data",
             f"tree_reduce_scatter: unknown compression {compress!r}")
     leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
     leaves = [jnp.asarray(x) for x in leaves]
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     buckets = plan_buckets(leaves, n, bucket_bytes)
     placed = _place_stacked(leaves, mesh, axis)
     shards = []
@@ -1021,7 +1439,7 @@ def measure_allreduce_gbps(mesh: Mesh, axis: str = "data",
     BASELINE.md "Store push/pull collective bandwidth" metric."""
     import time
 
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     elems = mbytes * 1024 * 1024 // 4
     # Pre-place the input in the collective's layout so the timed loop
     # measures only the compiled allreduce, not a per-iteration reshard.
@@ -1055,7 +1473,7 @@ def measure_wire_gbps(mesh: Mesh, axis: str = "data", mbytes: int = 32,
     is the analytic wire footprint of each int8 format vs fp32."""
     import time
 
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     elems = mbytes * 1024 * 1024 // 4
     leaf = jax.device_put(
         jnp.ones((n, elems), jnp.float32) * 0.5,
@@ -1089,4 +1507,79 @@ def measure_wire_gbps(mesh: Mesh, axis: str = "data", mbytes: int = 32,
         "int8_chunk_wire_pct": wire_pct(None),
         "int8_block_gbps": {str(b): timed("int8", b) for b in blocks},
         "int8_block_wire_pct": {str(b): wire_pct(b) for b in blocks},
+    }
+
+
+def measure_hier_allreduce(topology: Topology | None = None,
+                           mbytes: int = 16, iters: int = 5) -> dict:
+    """Hierarchical vs flat bucketed allreduce over the SAME composite
+    mesh — the ``make hier-bench`` probe (ISSUE 18).
+
+    The flat baseline is the one-launch bucketed program over the
+    composite ``("inner", "outer")`` axis; the hierarchical program is
+    the 3-leg decomposition (inner reduce-scatter, outer exchange of
+    ``1/n_inner`` of the bytes, inner allgather), both at the exact
+    wire. On the virtual host mesh every hop is host memory, so the
+    measured step times price launch overhead only; the wire
+    acceptance is the slow-leg byte counter (``hier_slow_leg_bytes``
+    <= ``flat_outer_bytes / n_inner``) and the topology's per-leg
+    bandwidth model prices the same two programs on the emulated
+    ICI/DCN asymmetry (``model_*`` fields)."""
+    import time
+
+    from ptype_tpu.metrics import metrics
+
+    if topology is None:
+        n = len(jax.devices())
+        no = 2 if n % 2 == 0 and n >= 4 else 1
+        topology = Topology.emulated_host(no, max(n // no, 1))
+    topo = topology
+    n = topo.n
+    elems = mbytes * 1024 * 1024 // 4
+    payload = elems * 4
+    mesh, ax = topo.mesh(), topo.flat_axis
+    leaf = jax.device_put(jnp.ones((n, elems), jnp.float32) * 0.5,
+                          NamedSharding(mesh, P(ax, None)))
+
+    def timed(run):
+        run()[0].block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run()
+        out[0].block_until_ready()
+        return round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+    flat_ms = timed(
+        lambda: bucketed_all_reduce([leaf], mesh, ax, "sum"))
+
+    def snap():
+        c = metrics.snapshot()["counters"]
+        keys = ("leg_bytes.inner", "leg_bytes.outer",
+                "leg_bytes.flat_outer", "hier_launches")
+        return {k: c.get(f"collectives.{k}", 0) for k in keys}
+
+    base = snap()
+    hier_ms = timed(
+        lambda: bucketed_all_reduce([leaf], mesh, ax, "sum",
+                                    topology=topo))
+    d = {k: v - base[k] for k, v in snap().items()}
+    launches = max(int(d["hier_launches"]), 1)
+    slow = d["leg_bytes.outer"] / launches
+    flat_outer = d["leg_bytes.flat_outer"] / launches
+    model_flat = topo.flat_allreduce_ms(payload)
+    model_hier = topo.hier_allreduce_ms(payload)
+    return {
+        "geometry": topo.describe(),
+        "payload_mb": mbytes,
+        "flat_step_ms": flat_ms,
+        "hier_step_ms": hier_ms,
+        "hier_slow_leg_bytes": int(slow),
+        "hier_inner_leg_bytes": int(d["leg_bytes.inner"] / launches),
+        "flat_outer_bytes": int(flat_outer),
+        "slow_leg_pct": (round(100.0 * slow / flat_outer, 2)
+                         if flat_outer else None),
+        "model_flat_ms": round(model_flat, 3),
+        "model_hier_ms": round(model_hier, 3),
+        "model_speedup": (round(model_flat / model_hier, 2)
+                          if model_hier else None),
     }

@@ -125,5 +125,16 @@ def axis_size(mesh: Mesh, name: str) -> int:
     return int(mesh.shape[name]) if name in mesh.axis_names else 1
 
 
+def axis_n(mesh: Mesh, axis) -> int:
+    """Total extent of ``axis``, which may be a single axis name OR a
+    tuple of names (a composite axis, e.g. the topology plane's
+    ``("inner", "outer")``). ``mesh.shape`` is a dict keyed by single
+    names, so tuple axes need the product — every ``int(mesh.shape[axis])``
+    site that can see a hierarchical mesh goes through here."""
+    if isinstance(axis, tuple):
+        return int(math.prod(int(mesh.shape[a]) for a in axis))
+    return int(mesh.shape[axis])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())

@@ -46,6 +46,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ptype_tpu import chaos, logs
 from ptype_tpu.errors import ClusterError, CoordinationError, NoKeyError
 from ptype_tpu.parallel import collectives
+from ptype_tpu.parallel.mesh import axis_n
+from ptype_tpu.parallel.topology import Topology
 from ptype_tpu.store import KVStore
 
 log = logs.get_logger("tensorstore")
@@ -148,7 +150,8 @@ class TensorStore:
     def __init__(self, mesh: Mesh, axis: str = "data",
                  kv: KVStore | None = None, namespace: str = "params",
                  compress: str | None = None,
-                 wire: collectives.WireConfig | None = None):
+                 wire: collectives.WireConfig | None = None,
+                 topology: Topology | None = None):
         if (wire is not None and compress is not None
                 and compress != wire.compress):
             raise ValueError(
@@ -156,6 +159,14 @@ class TensorStore:
                 f"wire.compress={wire.compress!r} — pass one")
         self.wire = (wire if wire is not None
                      else collectives.WireConfig(compress=compress))
+        #: Hierarchical topology: every tree push rides the per-leg
+        #: decomposition (collectives._hier_bucket_*) over the
+        #: composite ("inner", "outer") axis. The default axis follows
+        #: the topology so call sites (ZeRO trainers, store-DP) stay
+        #: unchanged.
+        self.topology = topology
+        if topology is not None and axis == "data":
+            axis = topology.flat_axis
         self.mesh = mesh
         self.axis = axis
         self.namespace = namespace
@@ -169,6 +180,15 @@ class TensorStore:
         #: int8 wire — each pushing process carries its own local
         #: quantization error into its next contribution.
         self._residuals: dict[str, jax.Array] = {}
+        #: Per-push-site OUTER-leg residuals for the hierarchical
+        #: int8 wire: site → {bucket index → flat f32 sharded
+        #: ``P(flat_axis)``}. Outer-leg quantization error lives at
+        #: bucket granularity (the cross-domain chunk boundaries cut
+        #: across leaf slots, so a per-leaf keying cannot represent
+        #: it); the collectives stream mutates the popped dict in
+        #: place and this store carries it across steps under the
+        #: same pop/store-back ownership as the per-leaf residuals.
+        self._outer_residuals: dict[str, dict[int, jax.Array]] = {}
         self._seq = 0
         #: prefix → highest write stamp under it (every "/"-ancestor
         #: of each written key) — tree_seq in O(1) instead of an
@@ -307,16 +327,19 @@ class TensorStore:
             _store_fault("store.push", key)
             items = [(key, stacked)]
             res = self._group_residuals(items)
+            ores = self._pop_outer(key)
             try:
                 outs = collectives.bucketed_all_reduce(
                     [stacked], self.mesh, self.axis, op, residuals=res,
-                    **self._wire_kwargs(None))
+                    outer_residuals=ores, **self._wire_kwargs(None))
             except BaseException:
                 self._restore_residuals(items, res)
+                self._restore_outer(key, ores)
                 raise
             if res is not None:
                 outs, new_res = outs
                 self._store_residuals(items, new_res)
+            self._store_outer(key, ores)
             reduced = outs[0]
         return self._commit_reduced(key, reduced)
 
@@ -328,7 +351,7 @@ class TensorStore:
         _store_fault("store.push", key)
         b = Binding(P(self.axis), op or self.binding(key).reduce_op)
         stacked = jnp.asarray(stacked)
-        n = int(self.mesh.shape[self.axis])
+        n = axis_n(self.mesh, self.axis)
         if (self.compress == "int8"
                 and collectives.quantized_all_reduce_eligible(
                     stacked.shape, n, b.reduce_op)):
@@ -393,6 +416,7 @@ class TensorStore:
                     self._stamp_locked(key)
         with self._lock:
             self._residuals.clear()
+            self._outer_residuals.clear()
         # mesh/axis are rebind-on-reshard like __init__'s bare writes:
         # the trainer quiesces pushes across a reshard (the step that
         # raised never ran), so no concurrent reader sees the old mesh.
@@ -461,17 +485,22 @@ class TensorStore:
             _store_fault("store.push", prefix)
             for group_op, items in groups.items():
                 res = self._group_residuals(items)
+                site = f"{prefix}|{group_op}"
+                ores = self._pop_outer(site)
                 try:
                     outs = collectives.bucketed_all_reduce(
                         [leaf for _, leaf in items], self.mesh,
                         self.axis, group_op, residuals=res,
+                        outer_residuals=ores,
                         **self._wire_kwargs(bucket_bytes))
                 except BaseException:
                     self._restore_residuals(items, res)
+                    self._restore_outer(site, ores)
                     raise
                 if res is not None:
                     outs, new_res = outs
                     self._store_residuals(items, new_res)
+                self._store_outer(site, ores)
                 for (key, _), out in zip(items, outs):
                     reduced[key] = out
         # Commit the unpacked views: reshard keys with non-replicated
@@ -505,12 +534,15 @@ class TensorStore:
         return groups
 
     def _wire_kwargs(self, bucket_bytes: int | None) -> dict:
-        return {
+        kw = {
             "bucket_bytes": bucket_bytes or self.wire.bucket_bytes,
             "compress": self.compress,
             "int8_min_bytes": self.wire.int8_min_bytes,
             "q_block": self.wire.q_block,
         }
+        if self.topology is not None:
+            kw["topology"] = self.topology
+        return kw
 
     def _commit_reduced(self, key: str, out: jax.Array) -> jax.Array:
         """Reshard to the key's binding (if any) and commit — the
@@ -529,10 +561,23 @@ class TensorStore:
         lock means a concurrent pusher of the same key folds zeros
         instead of double-applying the same accumulated error (each
         concurrent push then writes back its own fresh residual)."""
-        if not self.wire.feedback_armed:
+        if not self._feedback_armed():
             return None
         with self._lock:
             return [self._residuals.pop(key, None) for key, _ in items]
+
+    def _feedback_armed(self) -> bool:
+        """Per-leaf EF is armed when the flat wire is int8+EF, OR when
+        a topology's INNER leg resolves to int8 while the flat policy
+        is exact (a LegWire override) — the inner leg owns the
+        per-leaf residual in the hierarchical decomposition."""
+        if self.wire.feedback_armed:
+            return True
+        t = self.topology
+        if t is None or not self.wire.error_feedback:
+            return False
+        cw, _ = t.resolve_leg("inner", self.compress, self.wire.q_block)
+        return cw == "int8"
 
     def _store_residuals(self, items, new_res: list) -> None:
         with self._lock:
@@ -551,6 +596,44 @@ class TensorStore:
             for (key, _), r in zip(items, popped):
                 if r is not None:
                     self._residuals.setdefault(key, r)
+
+    def _outer_armed(self) -> bool:
+        """Whether the topology's OUTER (cross-domain) leg carries an
+        int8 wire with error feedback — the only case the per-bucket
+        outer residual dict is worth threading through a push."""
+        t = self.topology
+        if t is None or not self.wire.error_feedback:
+            return False
+        cw, _ = t.resolve_leg("outer", self.compress, self.wire.q_block)
+        return cw == "int8"
+
+    def _pop_outer(self, site: str) -> dict | None:
+        """Take ownership of a push site's outer-leg residual dict
+        (popped under the lock, same two-phase discipline as
+        :meth:`_group_residuals`): the collectives stream mutates it
+        in place per bucket; store it back when the push completes."""
+        if not self._outer_armed():
+            return None
+        with self._lock:
+            return self._outer_residuals.pop(site, {})
+
+    def _store_outer(self, site: str, ores: dict | None) -> None:
+        """Write back a consumed outer residual dict; our entries are
+        freshest for every bucket this push actually ran, so they
+        clobber (mirror of :meth:`_store_residuals`)."""
+        if ores:
+            with self._lock:
+                self._outer_residuals.setdefault(site, {}).update(ores)
+
+    def _restore_outer(self, site: str, ores: dict | None) -> None:
+        """Failure path: put popped-but-possibly-unconsumed entries
+        back without clobbering a concurrent pusher's fresher ones
+        (mirror of :meth:`_restore_residuals`)."""
+        if ores:
+            with self._lock:
+                cur = self._outer_residuals.setdefault(site, {})
+                for bi, r in ores.items():
+                    cur.setdefault(bi, r)
 
     def push_tree_iter(self, prefix: str, stacked_tree,
                        op: str | None = None, *,
@@ -576,6 +659,9 @@ class TensorStore:
         first = True
         for group_op, items in groups.items():
             res = self._group_residuals(items)
+            site = f"{prefix}|{group_op}"
+            ores = self._pop_outer(site)
+            done = False
             # The pop in _group_residuals took ownership of every
             # carried residual in the group — track the ones no int8
             # bucket has consumed yet, and RESTORE them when the
@@ -588,6 +674,7 @@ class TensorStore:
                 it = collectives.bucketed_all_reduce_stream(
                     [leaf for _, leaf in items], self.mesh,
                     self.axis, group_op, residuals=res,
+                    outer_residuals=ores,
                     **self._wire_kwargs(bucket_bytes))
                 while True:
                     with annotate(f"store.push_tree/{prefix}"):
@@ -613,7 +700,13 @@ class TensorStore:
                                         self._residuals[key] = new_res[i]
                         handle = BucketPush(prefix, keys, vals)
                     yield handle
+                done = True
             finally:
+                # Outer-leg residuals: the stream updated the popped
+                # dict in place for every bucket it ran; clobber-store
+                # on a full drain, setdefault-restore on abandonment.
+                (self._store_outer if done
+                 else self._restore_outer)(site, ores)
                 if pending:
                     with self._lock:
                         for i, r in pending.items():
@@ -656,12 +749,16 @@ class TensorStore:
         bucket_no = 0
         for group_op, items in groups.items():
             res = self._group_residuals(items)
+            site = f"{prefix}|{group_op}"
+            ores = self._pop_outer(site)
+            done = False
             pending = ({i: r for i, r in enumerate(res)
                         if r is not None} if res is not None else {})
             try:
                 it = collectives.bucketed_reduce_scatter_stream(
                     [leaf for _, leaf in items], self.mesh,
                     self.axis, group_op, residuals=res,
+                    outer_residuals=ores,
                     **self._wire_kwargs(bucket_bytes))
                 while True:
                     with annotate(f"store.push_tree/{prefix}"):
@@ -692,7 +789,13 @@ class TensorStore:
                                            leaf_keys, flat)
                         bucket_no += 1
                     yield handle
+                done = True
             finally:
+                # Outer-leg residuals: clobber-store on a full drain,
+                # setdefault-restore on abandonment (see
+                # push_tree_iter).
+                (self._store_outer if done
+                 else self._restore_outer)(site, ores)
                 if pending:
                     with self._lock:
                         for i, r in pending.items():
@@ -837,11 +940,12 @@ def _path_part(p) -> str:
 # ---------------------------------------------------------------- benching
 
 
-def measure_push_tree(mesh: Mesh, axis: str = "data",
+def measure_push_tree(mesh: Mesh, axis="data",
                       preset: str = "tiny", iters: int = 3,
                       compress: str | None = None,
                       bucket_bytes: int | None = None,
-                      wire: collectives.WireConfig | None = None) -> dict:
+                      wire: collectives.WireConfig | None = None,
+                      topology: Topology | None = None) -> dict:
     """Wall-clock a full param-tree gradient push, bucketed vs
     per-leaf — the BENCH ``store_push_tree_ms`` metric.
 
@@ -856,13 +960,14 @@ def measure_push_tree(mesh: Mesh, axis: str = "data",
     cfg = tfm.preset(preset)
     params = jax.jit(lambda r: tfm.init_params(r, cfg))(
         jax.random.PRNGKey(0))
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     stacked = jax.tree_util.tree_map(
         lambda p: jax.device_put(
             jnp.broadcast_to(p[None], (n, *p.shape)),
             NamedSharding(mesh, P(axis, *(None,) * p.ndim))),
         params)
-    store = TensorStore(mesh, axis, compress=compress, wire=wire)
+    store = TensorStore(mesh, axis, compress=compress, wire=wire,
+                        topology=topology)
     leaves = jax.tree_util.tree_leaves(params)
     nbytes = sum(v.size * v.dtype.itemsize for v in leaves)
 

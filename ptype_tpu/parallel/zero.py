@@ -70,6 +70,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ptype_tpu import chaos
 from ptype_tpu.compat import shard_map
 from ptype_tpu.errors import CheckpointError, ClusterError
+from ptype_tpu.parallel.mesh import axis_n
 from ptype_tpu.parallel.collectives import (Bucket, DEFAULT_BUCKET_BYTES,
                                             _slot_offsets, _unpack,
                                             plan_buckets)
@@ -209,7 +210,7 @@ def _shard_apply_fn(mesh: Mesh, axis: str, shapes: tuple, dtype: str,
     read from the shared :class:`OptHParams`.
     """
     sched = hp.schedule()
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     in_specs = tuple(P(*(None,) * len(s)) for s in shapes) + (
         P(axis), P(axis), P(axis), P(axis), P(), P())
     out_specs = tuple(P(*(None,) * len(s)) for s in shapes) + (
@@ -257,7 +258,7 @@ def _shard_apply_full_fn(mesh: Mesh, axis: str, shapes: tuple,
     ``scale``. Returns ``(*new_param_leaves, new_mu, new_nu)``.
     """
     sched = hp.schedule()
-    n = int(mesh.shape[axis])
+    n = axis_n(mesh, axis)
     rep = tuple(P(*(None,) * len(s)) for s in shapes)
     in_specs = rep + rep + (P(axis), P(axis), P(axis), P(), P())
     out_specs = rep + (P(axis), P(axis))
@@ -528,7 +529,7 @@ class ZeroState:
         full-batch count."""
         from ptype_tpu.health.profiling import compiled_cost
 
-        n = int(self.mesh.shape[self.axis])
+        n = axis_n(self.mesh, self.axis)
         flops = nbytes = 0.0
         for b in self.plan.buckets:
             shapes = tuple(s.shape for s in b.slots)
@@ -587,7 +588,7 @@ class ZeroState:
         the same state.
         """
         axis = axis or self.axis
-        new_n = int(mesh.shape[axis])
+        new_n = axis_n(mesh, axis)
         new_plan = self.plan.with_n(new_n)
         sh = NamedSharding(mesh, P(axis))
         groups = [("mu", self.mu), ("nu", self.nu),

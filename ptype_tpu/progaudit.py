@@ -253,7 +253,9 @@ DEFAULT_PROGRAMS = (
     "train.grads", "zero.shard_apply", "zero1.shard_apply",
     "zero2.grad_reduce_scatter", "zero3.param_gather",
     "zero3.shard_apply", "collectives.bucket_allreduce",
-    "collectives.bucket_reduce_scatter", "serve.decode_step",
+    "collectives.bucket_reduce_scatter",
+    "collectives.hier_allreduce",
+    "collectives.hier_reduce_scatter", "serve.decode_step",
     "serve.spec_window", "serve.kv_pack", "serve.kv_unpack",
 )
 
@@ -324,17 +326,18 @@ def _build_zero_apply():
 
         from ptype_tpu.parallel import zero as zero_mod
         from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.topology import DATA_AXIS
         from ptype_tpu.train.trainer import default_optimizer_hparams
 
         n = jax.device_count()
-        mesh = build_mesh({"data": n})
+        mesh = build_mesh({DATA_AXIS: n})
         shapes = ((4, 4), (8,))
         total = sum(1 if not s else int(__import__("math").prod(s))
                     for s in shapes)
         pad = (-total) % n
         elems = total + pad
         fn = zero_mod._shard_apply_fn(
-            mesh, "data", shapes, "float32", pad,
+            mesh, DATA_AXIS, shapes, "float32", pad,
             default_optimizer_hparams())
         f32 = jnp.float32
         avals = ([jax.ShapeDtypeStruct(s, f32) for s in shapes]
@@ -356,16 +359,17 @@ def _build_zero1_apply():
 
         from ptype_tpu.parallel import zero as zero_mod
         from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.topology import DATA_AXIS
         from ptype_tpu.train.trainer import default_optimizer_hparams
 
         n = jax.device_count()
-        mesh = build_mesh({"data": n})
+        mesh = build_mesh({DATA_AXIS: n})
         shapes = ((4, 4), (8,))
         total = 24
         pad = (-total) % n
         elems = total + pad
         fn = zero_mod._shard_apply_full_fn(
-            mesh, "data", shapes, "float32", pad,
+            mesh, DATA_AXIS, shapes, "float32", pad,
             default_optimizer_hparams())
         f32 = jnp.float32
         avals = ([jax.ShapeDtypeStruct(s, f32) for s in shapes] * 2
@@ -386,15 +390,16 @@ def _build_zero2_grad_rs():
 
         from ptype_tpu.parallel import collectives as coll
         from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.topology import DATA_AXIS
 
         n = jax.device_count()
-        mesh = build_mesh({"data": n})
+        mesh = build_mesh({DATA_AXIS: n})
         shapes = ((4, 4), (8,))
         pad = (-24) % n
         avals = [jax.ShapeDtypeStruct((n, *s), jnp.float32)
                  for s in shapes]
         fn = coll._bucket_reduce_scatter_fn(
-            mesh, "data", "mean", shapes, "float32", pad, None,
+            mesh, DATA_AXIS, "mean", shapes, "float32", pad, None,
             False, q_block=None)
         # ZeRO-2's whole point: grads arrive shard-resident from ONE
         # reduce_scatter per bucket and are NEVER allgathered — a
@@ -413,13 +418,14 @@ def _build_zero3_gather():
 
         from ptype_tpu.parallel import zero as zero_mod
         from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.topology import DATA_AXIS
 
         n = jax.device_count()
-        mesh = build_mesh({"data": n})
+        mesh = build_mesh({DATA_AXIS: n})
         shapes = ((4, 4), (8,))
         total = 24
         pad = (-total) % n
-        fn = zero_mod._bucket_gather_fn(mesh, "data", shapes,
+        fn = zero_mod._bucket_gather_fn(mesh, DATA_AXIS, shapes,
                                         "float32", pad)
         aval = jax.ShapeDtypeStruct((total + pad,), jnp.float32)
         # The just-in-time param materialization: ONE all_gather per
@@ -463,27 +469,72 @@ def _build_bucket_collective(kind: str):
 
         from ptype_tpu.parallel import collectives as coll
         from ptype_tpu.parallel.mesh import build_mesh
+        from ptype_tpu.parallel.topology import DATA_AXIS
 
         n = jax.device_count()
-        mesh = build_mesh({"data": n})
+        mesh = build_mesh({DATA_AXIS: n})
         shapes = ((4, 4), (8,))
         pad = (-24) % n
         avals = [jax.ShapeDtypeStruct((n, *s), jnp.float32)
                  for s in shapes]
         if kind == "allreduce":
             fn = coll._bucket_all_reduce_fn(
-                mesh, "data", "mean", shapes, "float32", pad, None,
+                mesh, DATA_AXIS, "mean", shapes, "float32", pad, None,
                 False, q_block=None)
             expect = {"psum": 1}
             name = "collectives.bucket_allreduce"
         else:
             fn = coll._bucket_reduce_scatter_fn(
-                mesh, "data", "sum", shapes, "float32", pad, None,
+                mesh, DATA_AXIS, "sum", shapes, "float32", pad, None,
                 False, q_block=None)
             expect = {"reduce_scatter": 1}
             name = "collectives.bucket_reduce_scatter"
         # N leaves, ONE launch: the bucket contract PR 1 measured
         # 2-3x from; per-leaf regressions show up as count N.
+        return audit(fn, avals, name=name, expect_collectives=expect)
+
+    return builder
+
+
+def _build_hier_collective(kind: str):
+    def builder() -> AuditReport:
+        import jax.numpy as jnp
+
+        from ptype_tpu.parallel import collectives as coll
+        from ptype_tpu.parallel.topology import Topology
+
+        n = jax.device_count()
+        no = 2 if n % 2 == 0 and n >= 4 else 1
+        topo = Topology(n_outer=no, n_inner=n // no)
+        mesh = topo.mesh()
+        shapes = ((4, 4), (8,))
+        pad = (-24) % n
+        avals = [jax.ShapeDtypeStruct((n, *s), jnp.float32)
+                 for s in shapes]
+        if kind == "allreduce":
+            fn = coll._hier_bucket_all_reduce_fn(
+                mesh, "mean", shapes, "float32", pad,
+                None, None, False, None, None)
+            # The per-LEG launch pins (ISSUE 18): inner
+            # reduce-scatter, ONE outer exchange (psum over the
+            # slow leg — the only cross-domain launch), inner
+            # allgather. An extra psum means a leg regressed to a
+            # flat composite-axis collective and the slow-leg wire
+            # win is gone while every parity test stays green.
+            expect = ({"reduce_scatter": 1, "psum": 1,
+                       "all_gather": 1} if topo.hierarchical
+                      else None)
+            name = "collectives.hier_allreduce"
+        else:
+            fn = coll._hier_bucket_reduce_scatter_fn(
+                mesh, "sum", shapes, "float32", pad,
+                None, None, False, None, None)
+            # Two reduce-scatters (psum_scatter lowers to the
+            # reduce_scatter primitive): inner then outer chunk.
+            # No gather leg — ZeRO consumes the flat shard as-is.
+            expect = ({"reduce_scatter": 2} if topo.hierarchical
+                      else None)
+            name = "collectives.hier_reduce_scatter"
         return audit(fn, avals, name=name, expect_collectives=expect)
 
     return builder
@@ -653,6 +704,10 @@ def register_default_programs(preset: str = "tiny", batch: int = 4,
              _build_bucket_collective("allreduce"))
     register("collectives.bucket_reduce_scatter",
              _build_bucket_collective("reduce_scatter"))
+    register("collectives.hier_allreduce",
+             _build_hier_collective("allreduce"))
+    register("collectives.hier_reduce_scatter",
+             _build_hier_collective("reduce_scatter"))
     register("serve.decode_step",
              _build_decode_step(preset, n_slots=2, n_blocks=12,
                                 block_tokens=16))

@@ -34,6 +34,7 @@ planes that already exist.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from dataclasses import dataclass
@@ -153,6 +154,11 @@ class Reconciler:
         #: at death time, where no replacement exists yet.
         self._replace_credits = 0
         self._alert_votes: list[_AlertVote] = []
+        #: Topology placement preference from the hint stream's
+        #: ``spawn_domain`` signal (ISSUE 18): passed to
+        #: ``launcher.spawn(domain=...)`` when the launcher takes it,
+        #: so scale-ups fill the gateway's local domain first.
+        self._spawn_domain: int | None = None
         self.desired: int | None = None
         self._seq = 0
         self._closed = threading.Event()
@@ -363,9 +369,50 @@ class Reconciler:
                                 "err": repr(e)})
                 hint = None
             if hint is not None:
+                self._note_spawn_domain(hint)
                 d = self.policy.observe(hint, actual, now)
                 decision = decision or d
         return decision
+
+    def _note_spawn_domain(self, hint) -> None:
+        """Fold the hint's placement signal (``signals["spawn_
+        domain"]``, the gateway's fill-local-first choice). Sticky:
+        a hint without the signal keeps the last preference rather
+        than resetting placement to topology-blind mid-scale."""
+        sig = getattr(hint, "signals", None)
+        if not isinstance(sig, dict):
+            return
+        dom = sig.get("spawn_domain")
+        if dom is None:
+            return
+        try:
+            dom = int(dom)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self._spawn_domain = dom
+        self._reg.gauge("scale.spawn_domain").set(float(dom))
+
+    def _spawn_kwargs(self) -> dict:
+        """Launcher spawn kwargs: always warm-held; plus the domain
+        placement preference when one is known AND the launcher's
+        spawn accepts it (launchers are duck-typed — a pre-topology
+        launcher must keep working unchanged)."""
+        kw: dict = {"warm_hold": True}
+        with self._lock:
+            dom = self._spawn_domain
+        if dom is None:
+            return kw
+        try:
+            params = inspect.signature(
+                self.launcher.spawn).parameters
+        except (TypeError, ValueError):
+            return kw
+        if "domain" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()):
+            kw["domain"] = dom
+        return kw
 
     def _apply_decision(self, decision: ScaleDecision,
                         actual: int) -> None:
@@ -482,7 +529,8 @@ class Reconciler:
                     # would double-count as foreign + pending and
                     # could trigger a spurious drain). Activation is
                     # the reconciler's move, after the handle lands.
-                    h = self.launcher.spawn(name, warm_hold=True)
+                    h = self.launcher.spawn(name,
+                                            **self._spawn_kwargs())
                 self._reg.counter("scale.spawns").add(1)
                 with self._lock:
                     self._handles[name] = h

@@ -162,12 +162,17 @@ class ReplicaHost:
                  host: str = "127.0.0.1", port: int = 0,
                  generator_name: str = "Generator",
                  process_id: int = 0, warm_hold: bool = False,
-                 metrics_registry=None):
+                 metrics_registry=None, domain: int | None = None):
         self._registry = registry
         self.service = service
         self.node_name = node_name
         self.generator_name = generator_name
         self.process_id = int(process_id)
+        #: Topology domain (the fast-ICI island this replica lives
+        #: in, parallel/topology.py): advertised in the registration
+        #: metadata so the gateway's locality-aware routing and the
+        #: ``obs topo`` view see placement without a probe.
+        self.domain = None if domain is None else int(domain)
         self._reg_handle = None
         self._reg_lock = lockcheck.lock("reconciler.replica.reg")
         self._exit = threading.Event()
@@ -176,6 +181,10 @@ class ReplicaHost:
         self._escalated = False
         self._mreg = (metrics_registry if metrics_registry is not None
                       else metrics_mod.metrics)
+        if self.domain is not None:
+            # Telemetry mirror of the registration metadata: the
+            # ``obs topo`` view groups replicas by this gauge.
+            self._mreg.gauge("serve.domain").set(float(self.domain))
         self._set_lifecycle("spawning")
         self.actor = actor_factory()
         self.server = serve_actor(self.actor, generator_name,
@@ -238,10 +247,12 @@ class ReplicaHost:
         with self._reg_lock:
             if self._reg_handle is not None:
                 return
+            meta = {"lifecycle": "active"}
+            if self.domain is not None:
+                meta["domain"] = self.domain
             self._reg_handle = self._registry.register(
                 self.service, self.node_name, self.host, self.port,
-                process_id=self.process_id,
-                metadata={"lifecycle": "active"})
+                process_id=self.process_id, metadata=meta)
         self._set_lifecycle("active")
         log.info("replica activated",
                  kv={"service": self.service, "node": self.node_name,
@@ -517,24 +528,28 @@ class LocalLauncher:
     def __init__(self, registry: Registry, actor_factory,
                  warmup=None, service: str = "llm",
                  generator_name: str = "Generator",
-                 metrics_registry=None):
+                 metrics_registry=None, domain: int | None = None):
         self._registry = registry
         self._actor_factory = actor_factory
         self._warmup = warmup
         self._service = service
         self._generator_name = generator_name
         self._metrics_registry = metrics_registry
+        #: Default topology domain for spawned replicas; a per-spawn
+        #: ``domain=`` (the reconciler's placement hint) overrides it.
+        self._domain = domain
         self.hosts: list[ReplicaHost] = []
         self._lock = lockcheck.lock("reconciler.launcher")
 
-    def spawn(self, name: str,
-              warm_hold: bool = False) -> LocalReplicaHandle:
+    def spawn(self, name: str, warm_hold: bool = False,
+              domain: int | None = None) -> LocalReplicaHandle:
         _spawn_fault(name)
         host = ReplicaHost(
             self._registry, self._service, name,
             self._actor_factory, warmup=self._warmup,
             generator_name=self._generator_name, warm_hold=warm_hold,
-            metrics_registry=self._metrics_registry)
+            metrics_registry=self._metrics_registry,
+            domain=domain if domain is not None else self._domain)
         with self._lock:
             self.hosts.append(host)
         chaos.note_ok("scale.spawn", name)
@@ -583,7 +598,8 @@ class ProcessLauncher:
                  factory: str = "",
                  spawn_timeout_s: float = 60.0,
                  env: dict | None = None,
-                 serve_class: str = "unified"):
+                 serve_class: str = "unified",
+                 domain: int | None = None):
         self.coordinator_address = coordinator_address
         self.service = service
         self.kind = kind
@@ -592,12 +608,15 @@ class ProcessLauncher:
         #: other actor riding the same lifecycle).
         self.factory = factory
         self.serve_class = serve_class
+        #: Default topology domain stamped on spawned workers
+        #: (``PTYPE_REPLICA_DOMAIN``); per-spawn ``domain=`` wins.
+        self.domain = domain
         self.spawn_timeout_s = float(spawn_timeout_s)
         self._env = dict(env or {})
         self.procs: list[subprocess.Popen] = []
 
-    def spawn(self, name: str,
-              warm_hold: bool = False) -> ProcessReplicaHandle:
+    def spawn(self, name: str, warm_hold: bool = False,
+              domain: int | None = None) -> ProcessReplicaHandle:
         # Reap + prune exited children first: a long-lived reconciler
         # cycles many workers, and the list must not grow (nor hold
         # zombies) one entry per drained/killed replica forever.
@@ -617,6 +636,9 @@ class ProcessLauncher:
                "PTYPE_REPLICA_WARM": "1" if warm_hold else "0",
                "PTYPE_REPLICA_SERVE_CLASS": self.serve_class,
                "PTYPE_REPLICA_READY_FILE": ready}
+        dom = domain if domain is not None else self.domain
+        if dom is not None:
+            env["PTYPE_REPLICA_DOMAIN"] = str(int(dom))
         proc = subprocess.Popen(
             [sys.executable, "-m", "ptype_tpu.reconciler.worker"],
             env=env)

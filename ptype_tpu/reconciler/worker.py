@@ -19,6 +19,10 @@ zero code changes):
                            (an optional ``warmup`` attribute on the
                            function is the warm-up hook)
 ``PTYPE_REPLICA_SERVE_CLASS`` ``unified`` | ``prefill`` | ``decode``
+
+``PTYPE_REPLICA_DOMAIN``   topology domain ordinal (optional) —
+                           advertised in the registration metadata for
+                           the gateway's locality-aware routing
                            — the disaggregated-serving role stamped
                            on a ``paged`` engine (ISSUE 16); the
                            gateway's two-stage router reads it back
@@ -109,6 +113,8 @@ def main() -> None:
     preset = os.environ.get("PTYPE_REPLICA_PRESET", "tiny")
     warm_hold = os.environ.get("PTYPE_REPLICA_WARM") == "1"
     ready_file = os.environ.get("PTYPE_REPLICA_READY_FILE")
+    dom_raw = os.environ.get("PTYPE_REPLICA_DOMAIN", "")
+    domain = int(dom_raw) if dom_raw else None
 
     from ptype_tpu.coord.remote import RemoteCoord
     from ptype_tpu.reconciler.replica import ReplicaHost
@@ -118,7 +124,8 @@ def main() -> None:
     registry = CoordRegistry(coord)
     factory, warmup = _actor_factory(kind, preset)
     host = ReplicaHost(registry, service, node, factory,
-                       warmup=warmup, warm_hold=warm_hold)
+                       warmup=warmup, warm_hold=warm_hold,
+                       domain=domain)
 
     def _term(*_):
         host.request_exit()

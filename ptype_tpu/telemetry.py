@@ -375,6 +375,7 @@ def measure_trace_overhead(steps: int = 16, preset: str = "tiny",
     from ptype_tpu import trace
     from ptype_tpu.models import transformer as tfm
     from ptype_tpu.parallel.mesh import build_mesh
+    from ptype_tpu.parallel.topology import DATA_AXIS
     from ptype_tpu.parallel.tensorstore import TensorStore
     from ptype_tpu.train.data import synthetic_batches
     from ptype_tpu.train.store_dp import StoreDPTrainer
@@ -383,7 +384,7 @@ def measure_trace_overhead(steps: int = 16, preset: str = "tiny",
     # enable/disable around its loops and must hand back the ORIGINAL
     # recorder (ring, service name, dump config), not a fresh one.
     orig_rec, orig_dump = trace.recorder(), trace._dump_dir
-    mesh = build_mesh({"data": jax.device_count()})
+    mesh = build_mesh({DATA_AXIS: jax.device_count()})
     cfg = tfm.preset(preset)
     trainer = StoreDPTrainer(cfg, TensorStore(mesh))
     stream = synthetic_batches(cfg.vocab_size, batch, seq)

@@ -78,7 +78,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu import jitwatch
 from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.mesh import axis_n
 from ptype_tpu.parallel.tensorstore import TensorStore, _path_part
+from ptype_tpu.parallel.topology import DATA_AXIS
 from ptype_tpu.parallel.zero import ShardPlan, ZeroState
 from ptype_tpu.train.trainer import (_decay_mask, default_optimizer,
                                      default_optimizer_hparams,
@@ -148,7 +150,7 @@ class StoreDPTrainer:
         self.store = store
         self.mesh: Mesh = store.mesh
         self.axis = store.axis
-        self.n_workers = int(self.mesh.shape[self.axis])
+        self.n_workers = axis_n(self.mesh, self.axis)
         self.overlap = overlap
         self.zero = zero_stage > 0
         self.zero_stage = zero_stage
@@ -551,7 +553,7 @@ class StoreDPTrainer:
                 "(replicated modes restart from a checkpoint instead)")
         axis = axis or self.axis
         old_n = self.n_workers
-        new_n = int(mesh.shape[axis])
+        new_n = axis_n(mesh, axis)
         t0 = _t.perf_counter()
         metrics.gauge("train.reshard_inflight").set(1.0)
         with annotate("train.reshard"):
@@ -886,7 +888,7 @@ def measure_zero(mesh: Mesh, preset: str = "tiny", steps: int = 6,
         "repl_step_ms": round(repl_dt * 1e3, 2),
         "final_loss_zero": round(float(zero_loss), 5),
         "final_loss_repl": round(float(repl_loss), 5),
-        "n_replicas": int(mesh.shape["data"]),
+        "n_replicas": axis_n(mesh, DATA_AXIS),
         "steps": steps,
         "compress": compress,
     }
@@ -907,7 +909,7 @@ def measure_zero_ladder(mesh: Mesh, preset: str = "tiny",
 
     cfg = tfm.preset(preset)
     seq = min(cfg.max_seq, 128)
-    n = int(mesh.shape["data"])
+    n = axis_n(mesh, DATA_AXIS)
     rows = {}
     for stage in (0, 1, 2, 3):
         trainer = StoreDPTrainer(cfg, TensorStore(mesh),
@@ -967,8 +969,8 @@ def measure_reshard(preset: str = "tiny", steps: int = 3,
 
     cfg = tfm.preset(preset)
     seq = min(cfg.max_seq, 128)
-    mesh8 = build_mesh({"data": 8})
-    mesh4 = build_mesh({"data": 4}, devices=jax.devices()[:4])
+    mesh8 = build_mesh({DATA_AXIS: 8})
+    mesh4 = build_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4])
 
     def trained():
         tr = StoreDPTrainer(cfg, TensorStore(mesh8),

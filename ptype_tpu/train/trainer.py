@@ -19,6 +19,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ptype_tpu.models import transformer as tfm
+from ptype_tpu.parallel.topology import DATA_AXIS
 
 
 @dataclass
@@ -222,8 +223,9 @@ def _state_shardings(mesh: Mesh, cfg: tfm.TransformerConfig,
     params_shape = jax.eval_shape(lambda: tfm.init_params(
         jax.random.PRNGKey(0), cfg))
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
-    upd_axis = ("data" if (shard_update and "data" in axis_sizes
-                           and axis_sizes["data"] > 1) else None)
+    upd_axis = (DATA_AXIS
+                if (shard_update and DATA_AXIS in axis_sizes
+                    and axis_sizes[DATA_AXIS] > 1) else None)
     if shard_update and upd_axis is None:
         from ptype_tpu import logs
 

@@ -1001,3 +1001,80 @@ def test_train_package_is_pt022_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt022 = [f for f in findings if "PT022" in f]
     assert not pt022, pt022
+
+
+# --------------------------------------------------------------- PT023
+
+
+PT023_FLAT_AXIS = (
+    "from jax import lax\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "def f(x, mesh, store, axis_sizes):\n"
+    "    a = lax.psum(x, 'data')\n"
+    "    b = P('data')\n"
+    "    store.push('k', x, axis='data')\n"
+    "    n = mesh.shape['data']\n"
+    "    m = axis_sizes['data']\n"
+    "    return a, b, n, m\n")
+
+
+def test_pt023_flags_flat_axis_literals_in_package(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/sneak23.py",
+                      PT023_FLAT_AXIS)
+    assert sum("PT023" in f for f in findings) == 5, findings
+
+
+def test_pt023_flags_mesh_keys_and_defaults(tmp_path):
+    src = ("from ptype_tpu.parallel.mesh import build_mesh\n"
+           "def up(n, mesh_axis='data'):\n"
+           "    return build_mesh({'data': n})\n")
+    findings = _check(tmp_path, "ptype_tpu/train/geom23.py", src)
+    assert sum("PT023" in f for f in findings) == 2, findings
+
+
+def test_pt023_silent_in_parallel_home_and_outside_package(tmp_path):
+    # parallel/ is the literal's one home (topology.DATA_AXIS lives
+    # there); tests/examples/tools spell it freely.
+    for rel in ("ptype_tpu/parallel/topology.py",
+                "ptype_tpu/parallel/collectives.py",
+                "tests/t23.py", "examples/demo23.py"):
+        findings = _check(tmp_path, rel, PT023_FLAT_AXIS)
+        assert not any("PT023" in f for f in findings), (rel, findings)
+
+
+def test_pt023_ignores_non_axis_data_strings(tmp_path):
+    # "data" as a payload key, profiler category, or message field is
+    # not an axis name — only axis positions are flagged.
+    src = ("def f(item, out, blob):\n"
+           "    wal = item['data']\n"
+           "    out['data'] = blob\n"
+           "    return {'kind': 'x', 'data': blob}\n")
+    findings = _check(tmp_path, "ptype_tpu/coord/ok23.py", src)
+    assert not any("PT023" in f for f in findings), findings
+
+
+def test_pt023_honors_noqa(tmp_path):
+    src = ("from jax import lax\n"
+           "def probe(x):\n"
+           "    return lax.psum(x, 'data')"
+           "  # noqa: parity probe\n")
+    findings = _check(tmp_path, "ptype_tpu/train/sup23.py", src)
+    assert not any("PT023" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt023_clean():
+    """Axis-name discipline (ISSUE 18): no hard-coded flat "data"
+    axis literals outside parallel/ — every module reads DATA_AXIS /
+    topology.flat_axis / the owning object's .axis so programs ride
+    the hierarchical mesh unchanged."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt023 = [f for f in findings if "PT023" in f]
+    assert not pt023, pt023

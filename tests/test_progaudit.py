@@ -147,6 +147,24 @@ def test_real_hot_programs_audit_clean():
     assert win.collectives == {} and dec.collectives == {}
 
 
+def test_hier_programs_pin_per_leg_launches():
+    """ISSUE 18: the hierarchical programs' per-LEG launch pins. The
+    allreduce lowers to exactly one launch per leg — inner
+    reduce-scatter, ONE cross-domain psum (the only slow-leg launch),
+    inner allgather; the scatter half is two reduce_scatter prims
+    (psum_scatter lowers to reduce_scatter) and no gather. Any extra
+    launch means a leg regressed to a flat collective and the
+    1/N_inner slow-leg wire bound is gone."""
+    progaudit.register_default_programs()
+    ar = progaudit.audit_registered("collectives.hier_allreduce")
+    ar.raise_if_failed()
+    assert ar.collectives == {"reduce_scatter": 1, "psum": 1,
+                              "all_gather": 1}
+    rs = progaudit.audit_registered("collectives.hier_reduce_scatter")
+    rs.raise_if_failed()
+    assert rs.collectives == {"reduce_scatter": 2}
+
+
 # ----------------------------------------------- ZeRO ladder programs
 
 

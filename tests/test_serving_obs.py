@@ -806,3 +806,89 @@ def test_run_serve_loop_renders_and_returns_engine(coord):
                        interval_s=0.0, out=out.append, clear=False)
     assert out and "ptype serving @" in out[0]
     assert isinstance(engine, AlertEngine)
+
+
+# ------------------------------------------------------ obs topo view
+
+
+def test_render_topo_domains_legs_and_migration_split():
+    """The topology one-pager (ISSUE 18): replicas group by the
+    ``serve.domain`` gauge, hierarchical-launch nodes show per-leg
+    wire bytes with the slow-leg share, and the gateway's migration
+    counters fold into the local/cross locality split."""
+    from ptype_tpu.health import render_topo
+
+    snap = {"ts": 5.0, "nodes": {
+        "llm/a:1": {"metrics": {
+            "gauges": {"serve.domain": 0.0, "serve.lifecycle": 3.0,
+                       "serve.queue_depth": 2.0,
+                       "serve.active_slots": 1.0}, "counters": {}}},
+        "llm/b:2": {"metrics": {
+            "gauges": {"serve.domain": 0.0, "serve.lifecycle": 3.0},
+            "counters": {}}},
+        "llm/c:3": {"metrics": {
+            "gauges": {"serve.domain": 1.0, "serve.lifecycle": 4.0},
+            "counters": {}}},
+        "train/w0": {"metrics": {"gauges": {}, "counters": {
+            "collectives.hier_launches": 6.0,
+            "collectives.leg_bytes.inner": 24e6,
+            "collectives.leg_bytes.outer": 4e6,
+            "collectives.leg_bytes.flat_outer": 28e6}}},
+        "local": {"metrics": {"gauges": {}, "counters": {
+            "serve.migrate.local_domain": 9.0,
+            "serve.migrate.cross_domain": 1.0}}},
+    }, "errors": {"llm/dead:9": "refused"}}
+    view = render_topo(snap)
+    assert "3 placed replicas in 2 domains" in view
+    d0 = next(ln for ln in view.splitlines() if ln.startswith("0 "))
+    assert " 2 " in d0          # two replicas, both active, in d0
+    d1 = next(ln for ln in view.splitlines() if ln.startswith("1 "))
+    assert "llm/c:3"[:24] in d1
+    assert "train/w0" in view and "14.3" in view   # slow-leg share
+    assert "9 local-domain, 1 cross-domain" in view
+    assert "10.0% crossing the slow leg" in view
+    assert "UNREACHABLE" in view
+
+
+def test_render_topo_flat_fleet_renders_placeholders():
+    from ptype_tpu.health import render_topo
+
+    view = render_topo({"ts": 0.0, "nodes": {}, "errors": {}})
+    assert "no node exports serve.domain" in view
+    assert "no hierarchical collective launches" in view
+    assert "0 local-domain, 0 cross-domain" in view
+    assert "no alerts" in view
+
+
+def test_run_topo_loop_renders_and_returns_engine(coord):
+    from ptype_tpu.health import run_topo
+    from ptype_tpu.registry import CoordRegistry
+
+    out: list[str] = []
+    engine = run_topo(CoordRegistry(coord, lease_ttl=5.0), iters=1,
+                      interval_s=0.0, out=out.append, clear=False)
+    assert out and "ptype topology @" in out[0]
+    assert isinstance(engine, AlertEngine)
+
+
+def test_replica_host_exports_domain_gauge(coord):
+    """ReplicaHost stamps its placement on the ``serve.domain``
+    gauge (the telemetry mirror of the registration metadata the
+    gateway routes on) so ``obs topo`` sees domains without a
+    probe."""
+    from ptype_tpu import metrics as metrics_mod
+    from ptype_tpu.reconciler.replica import ReplicaHost
+    from ptype_tpu.registry import CoordRegistry
+
+    class _Idle:
+        def Info(self):
+            return {}
+
+    reg = metrics_mod.MetricsRegistry()
+    host = ReplicaHost(CoordRegistry(coord, lease_ttl=5.0), "llm-dom",
+                       "r0", _Idle, warm_hold=True,
+                       metrics_registry=reg, domain=2)
+    try:
+        assert reg.gauge("serve.domain").value == 2.0
+    finally:
+        host.close()

@@ -1,0 +1,696 @@
+"""Topology plane (ISSUE 18): the 2-D (outer, inner) hierarchy.
+
+Covers the descriptor itself (env/JSON config, geometry, the analytic
+per-leg cost/byte model), hierarchical collectives at EVERY (outer,
+inner) factorization of 8 against the flat composite-axis baseline
+(exact-wire parity at rtol 1e-5), per-LEG int8+EF wires (error
+feedback beating the naive quantizer, leg separation — slow-leg-only
+int8 engages only the outer residual), the TensorStore riding the
+hierarchical path (push/push_tree/scatter parity, outer-residual
+ownership across pushes, reshard hygiene), ZeRO-2/3 training curves
+bit-identical through the hierarchical wire, and the serving side:
+domain-aware routing (affinity + decode picks stay in the prefill's
+domain when a local holder exists, cross-domain only when none),
+per-domain scale signals, and the reconciler's spawn placement.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ptype_tpu.parallel import collectives as coll
+from ptype_tpu.parallel.mesh import axis_n, build_mesh
+from ptype_tpu.parallel.tensorstore import TensorStore
+from ptype_tpu.parallel.topology import (DATA_AXIS, HIER_AXIS,
+                                         INNER_AXIS, OUTER_AXIS,
+                                         LegWire, Topology,
+                                         factorizations, topology_for)
+
+N = 8  # conftest forces an 8-device host platform
+
+RNG = np.random.default_rng(18)
+
+
+def _leaves():
+    return [jnp.asarray(RNG.standard_normal((N, 4, 16)),
+                        jnp.float32),
+            jnp.asarray(RNG.standard_normal((N, 200)), jnp.float32),
+            jnp.asarray(RNG.integers(0, 5, (N, 3)), jnp.int32)]
+
+
+def _place(mesh, ax, tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            jnp.asarray(x),
+            NamedSharding(mesh, P(ax, *(None,) * (x.ndim - 1)))),
+        tree)
+
+
+# ------------------------------------------------------- the descriptor
+
+
+def test_factorizations_of_8():
+    assert factorizations(8) == [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+def test_mesh_geometry_contiguous_domains():
+    """Device d sits at (d % n_inner, d // n_inner): domains are
+    contiguous ordinal blocks, and the composite axis spans all 8."""
+    topo = Topology(n_outer=2, n_inner=4)
+    mesh = topo.mesh()
+    assert mesh.shape == {INNER_AXIS: 4, OUTER_AXIS: 2}
+    assert axis_n(mesh, HIER_AXIS) == 8
+    assert topo.flat_axis == HIER_AXIS
+    assert topo.domains() == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert topo.domain_of_device(3) == 0
+    assert topo.domain_of_device(4) == 1
+    devs = np.vectorize(lambda d: d.id)(np.asarray(mesh.devices))
+    assert devs.shape == (4, 2)
+    assert list(devs[:, 0]) == [0, 1, 2, 3]
+    assert list(devs[:, 1]) == [4, 5, 6, 7]
+
+
+def test_from_env_shorthand_json_and_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("PTYPE_TOPOLOGY", "2x4")
+    t = Topology.from_env()
+    assert (t.n_outer, t.n_inner) == (2, 4)
+
+    monkeypatch.setenv(
+        "PTYPE_TOPOLOGY",
+        '{"n_outer": 4, "n_inner": 2, "outer_gbps": 12.5}')
+    t = Topology.from_env()
+    assert (t.n_outer, t.n_inner, t.outer_gbps) == (4, 2, 12.5)
+
+    import json
+
+    p = tmp_path / "topo.json"
+    p.write_text(json.dumps(Topology(n_outer=2, n_inner=2).to_json()))
+    monkeypatch.setenv("PTYPE_TOPOLOGY", f"@{p}")
+    t = Topology.from_env()
+    assert (t.n_outer, t.n_inner) == (2, 2)
+
+    monkeypatch.delenv("PTYPE_TOPOLOGY")
+    assert Topology.from_env() is None
+
+
+def test_json_roundtrip_carries_leg_wires():
+    t = Topology(n_outer=2, n_inner=4, outer_gbps=6.25,
+                 outer_wire=LegWire(compress="int8", q_block=32))
+    t2 = Topology.from_json(t.to_json())
+    assert t2 == t
+    assert t2.outer_wire.compress == "int8"
+    assert t2.resolve_leg(OUTER_AXIS, None, 128) == ("int8", 32)
+    # Inner leg has no explicit policy: the caller's wire inherits.
+    assert t2.resolve_leg(INNER_AXIS, "int8", 128) == ("int8", 128)
+    assert t2.resolve_leg(INNER_AXIS, None, 128) == (None, 128)
+
+
+def test_cost_model_prefers_hier_on_asymmetric_fabric():
+    """On an 8x-asymmetric fabric the hierarchical allreduce's slow
+    leg moves 1/n_inner of the bytes, so the modeled step beats flat;
+    leg_bytes pins the wire arithmetic the bench reports."""
+    topo = Topology.emulated_host(2, 4)
+    payload = 64 << 20
+    assert topo.hier_allreduce_ms(payload) < topo.flat_allreduce_ms(
+        payload)
+    legs = topo.leg_bytes(payload)
+    assert legs["outer"] <= legs["flat_outer"] / topo.n_inner + 1
+    rs = topo.leg_bytes(payload, kind="reduce_scatter")
+    assert rs["outer"] == pytest.approx(legs["outer"] / 2)
+    assert topo.ratio == pytest.approx(8.0)
+
+
+def test_topology_for_recovers_descriptor_from_mesh():
+    topo = Topology(n_outer=2, n_inner=4)
+    mesh = topo.mesh()
+    t = topology_for(mesh)
+    assert t is not None and (t.n_outer, t.n_inner) == (2, 4)
+    assert topology_for(build_mesh({DATA_AXIS: N})) is None
+
+
+# ------------------------------------- hierarchical collectives: parity
+
+
+@pytest.mark.parametrize("no,ni", factorizations(8))
+def test_hier_allreduce_exact_parity_every_factorization(no, ni):
+    """The acceptance bar: exact-wire hierarchical allreduce matches
+    the flat composite-axis baseline at rtol <= 1e-5 for EVERY
+    (outer, inner) factorization of 8 — including both degenerate
+    legs (1x8, 8x1), which must lower through the same entry point."""
+    topo = Topology.emulated_host(no, ni)
+    mesh, ax = topo.mesh(), topo.flat_axis
+    leaves = _leaves()
+    flat = coll.bucketed_all_reduce(leaves, mesh, ax, "mean")
+    hier = coll.bucketed_all_reduce(leaves, mesh, ax, "mean",
+                                    topology=topo)
+    for f, h in zip(flat, hier):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(h),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("no,ni", factorizations(8))
+def test_hier_reduce_scatter_shard_parity(no, ni):
+    """The scatter half hands every device the SAME flat elems/n
+    shard the flat composite-axis scatter would — the invariant that
+    lets ZeRO-2/3 ride the hierarchy unchanged."""
+    topo = Topology.emulated_host(no, ni)
+    mesh, ax = topo.mesh(), topo.flat_axis
+    leaves = _leaves()[:2]
+    fl = list(coll.bucketed_reduce_scatter_stream(leaves, mesh, ax,
+                                                  "sum"))
+    hi = list(coll.bucketed_reduce_scatter_stream(
+        leaves, mesh, ax, "sum", topology=topo))
+    assert len(fl) == len(hi) >= 1
+    for (_, sf, _), (_, sh, _) in zip(fl, hi):
+        np.testing.assert_allclose(np.asarray(sf), np.asarray(sh),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_hier_max_op_falls_back_to_flat_composite():
+    """Non-ring-decomposable ops (max/min) keep the flat composite
+    lowering — same numbers, no hierarchical split."""
+    topo = Topology.emulated_host(2, 4)
+    mesh, ax = topo.mesh(), topo.flat_axis
+    leaves = _leaves()[:1]
+    flat = coll.bucketed_all_reduce(leaves, mesh, ax, "max")
+    hier = coll.bucketed_all_reduce(leaves, mesh, ax, "max",
+                                    topology=topo)
+    np.testing.assert_array_equal(np.asarray(flat[0]),
+                                  np.asarray(hier[0]))
+
+
+# ----------------------------------------------- per-leg int8+EF wires
+
+
+def _ef_bias(topo, g, exact, ef: bool, steps: int = 24) -> float:
+    mesh, ax = topo.mesh(), topo.flat_axis
+    res = [None]
+    outer: dict = {}
+    acc = np.zeros_like(exact)
+    for _ in range(steps):
+        if ef:
+            out, res = coll.bucketed_all_reduce(
+                [g], mesh, ax, "mean", compress="int8",
+                int8_min_bytes=0, q_block=32, residuals=res,
+                topology=topo, outer_residuals=outer)
+        else:
+            out = coll.bucketed_all_reduce(
+                [g], mesh, ax, "mean", compress="int8",
+                int8_min_bytes=0, q_block=32, topology=topo)
+        acc += np.asarray(out[0])
+    return float(np.max(np.abs(acc / steps - exact)))
+
+
+def test_per_leg_error_feedback_beats_naive_int8():
+    """Repeated int8 pushes of the SAME gradient: per-leg EF carries
+    each leg's quantization error into the next step, so the
+    accumulated bias collapses; the naive wire's bias is systematic.
+    3x is the floor — measured margin is >10x."""
+    topo = Topology.emulated_host(2, 4)
+    g = jnp.asarray(RNG.standard_normal((N, 512)), jnp.float32)
+    exact = np.asarray(coll.bucketed_all_reduce(
+        [g], topo.mesh(), topo.flat_axis, "mean")[0])
+    naive = _ef_bias(topo, g, exact, ef=False)
+    ef = _ef_bias(topo, g, exact, ef=True)
+    assert ef * 3 < naive, (ef, naive)
+
+
+def test_slow_leg_only_int8_engages_only_outer_residual():
+    """The canonical asymmetric config — inner leg exact, outer leg
+    int8 (LegWire on the topology, no caller-level compress): the
+    inner residual stays disarmed, the outer residual appears keyed
+    per bucket, and the result is close-but-not-exact."""
+    topo = Topology(n_outer=2, n_inner=4,
+                    outer_wire=LegWire(compress="int8", q_block=32))
+    mesh, ax = topo.mesh(), topo.flat_axis
+    g = jnp.asarray(RNG.standard_normal((N, 512)), jnp.float32)
+    exact = np.asarray(coll.bucketed_all_reduce(
+        [g], mesh, ax, "mean")[0])
+    res = [None]
+    outer: dict = {}
+    out, res = coll.bucketed_all_reduce(
+        [g], mesh, ax, "mean", int8_min_bytes=0, residuals=res,
+        topology=topo, outer_residuals=outer)
+    err = float(np.max(np.abs(np.asarray(out[0]) - exact)))
+    assert 0 < err < 0.05
+    assert res[0] is None          # inner leg exact -> no residual
+    assert list(outer) == [0]      # outer residual keyed by bucket
+
+
+def test_leg_byte_counters_pin_slow_leg_wire_win():
+    """The wire-byte acceptance: the outer (slow-leg) counter after a
+    hierarchical push is <= 1/n_inner of what the flat baseline would
+    have moved — straight from the metrics families the bench and
+    ``obs topo`` read."""
+    from ptype_tpu.metrics import metrics
+
+    topo = Topology.emulated_host(2, 4)
+    mesh, ax = topo.mesh(), topo.flat_axis
+    base = {k: v for k, v in metrics.snapshot()["counters"].items()}
+    leaves = _leaves()[:2]
+    coll.bucketed_all_reduce(leaves, mesh, ax, "mean", topology=topo)
+    snap = metrics.snapshot()["counters"]
+
+    def delta(name):
+        return snap.get(name, 0) - base.get(name, 0)
+
+    inner = delta("collectives.leg_bytes.inner")
+    outer = delta("collectives.leg_bytes.outer")
+    flat_outer = delta("collectives.leg_bytes.flat_outer")
+    assert inner > 0 and outer > 0 and flat_outer > 0
+    assert outer <= flat_outer / topo.n_inner + 1
+    assert delta("collectives.hier_launches") >= 1
+
+
+# ------------------------------------------------- TensorStore riding
+
+
+def _tree():
+    return {"w": RNG.standard_normal((N, 64, 32)).astype(np.float32),
+            "b": RNG.standard_normal((N, 128)).astype(np.float32)}
+
+
+def test_store_exact_push_tree_parity_flat_vs_hier():
+    topo = Topology.emulated_host(2, 4)
+    mesh = topo.mesh()
+    flat_mesh = build_mesh({DATA_AXIS: N})
+    s_flat = TensorStore(flat_mesh, DATA_AXIS)
+    s_hier = TensorStore(mesh, topology=topo)
+    assert s_hier.axis == HIER_AXIS  # "data" resolves to the tuple
+    tree = _tree()
+    out_f = s_flat.push_tree("g", _place(flat_mesh, DATA_AXIS, tree))
+    out_h = s_hier.push_tree("g", _place(mesh, HIER_AXIS, tree))
+    for k in out_f:
+        np.testing.assert_allclose(np.asarray(out_f[k]),
+                                   np.asarray(out_h[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_store_scatter_iter_parity_flat_vs_hier():
+    topo = Topology.emulated_host(2, 4)
+    mesh = topo.mesh()
+    flat_mesh = build_mesh({DATA_AXIS: N})
+    s_flat = TensorStore(flat_mesh, DATA_AXIS)
+    s_hier = TensorStore(mesh, topology=topo)
+    tree = _tree()
+    for h in s_hier.push_tree_scatter_iter(
+            "gs", _place(mesh, HIER_AXIS, tree)):
+        h.wait()
+    for h in s_flat.push_tree_scatter_iter(
+            "gs", _place(flat_mesh, DATA_AXIS, tree)):
+        h.wait()
+    keys = [k for k in s_hier.keys() if k.startswith("gs/")]
+    assert keys
+    for k in keys:
+        np.testing.assert_allclose(
+            np.asarray(s_hier.pull(k, gather=True)),
+            np.asarray(s_flat.pull(k, gather=True)),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_store_outer_residuals_persist_and_reshard_clears():
+    """The store owns the outer-leg residual the way it owns the
+    per-leaf inner ones (PR 6 two-phase contract): keyed by push
+    site, carried across pushes, wiped by reshard."""
+    topo = Topology.emulated_host(2, 4)
+    wire = coll.WireConfig(compress="int8", int8_min_bytes=0,
+                           q_block=32)
+    store = TensorStore(topo.mesh(), wire=wire, topology=topo)
+    tree = _tree()
+    tru = {k: v.mean(axis=0) for k, v in tree.items()}
+    steps = 12
+    acc = {k: np.zeros_like(v) for k, v in tru.items()}
+    for _ in range(steps):
+        out = store.push_tree("q", _place(store.mesh, store.axis,
+                                          tree))
+        for k in out:
+            acc[k.split("/")[-1]] += np.asarray(out[k])
+    assert store._outer_residuals, "outer residual must persist"
+    assert store._residuals, "inner residual must persist"
+    bias_ef = max(np.abs(acc[k] / steps - tru[k]).max() for k in acc)
+
+    wire_n = coll.WireConfig(compress="int8", int8_min_bytes=0,
+                             q_block=32, error_feedback=False)
+    s_naive = TensorStore(topo.mesh(), wire=wire_n, topology=topo)
+    acc_n = {k: np.zeros_like(v) for k, v in tru.items()}
+    for _ in range(steps):
+        out = s_naive.push_tree("q", _place(s_naive.mesh,
+                                            s_naive.axis, tree))
+        for k in out:
+            acc_n[k.split("/")[-1]] += np.asarray(out[k])
+    bias_naive = max(np.abs(acc_n[k] / steps - tru[k]).max()
+                     for k in acc_n)
+    assert bias_ef * 3 < bias_naive, (bias_ef, bias_naive)
+
+    store.reshard(store.mesh)
+    assert not store._outer_residuals and not store._residuals
+
+
+# ------------------------------------------------ ZeRO rides unchanged
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_training_curves_identical_flat_vs_hier(stage):
+    """THE training acceptance: ZeRO-2/3 loss curves through the
+    hierarchical exact wire are identical to the flat baseline — the
+    shard stream hands back byte-identical flat shards, so the
+    optimizer cannot tell the topologies apart."""
+    from ptype_tpu.models import transformer as tfm
+    from ptype_tpu.train.data import synthetic_batches
+    from ptype_tpu.train.store_dp import StoreDPTrainer
+
+    cfg = tfm.preset("tiny")
+    topo = Topology.emulated_host(2, 4)
+    losses = {}
+    for mode in ("flat", "hier"):
+        store = (TensorStore(build_mesh({DATA_AXIS: N}))
+                 if mode == "flat"
+                 else TensorStore(topo.mesh(), topology=topo))
+        tr = StoreDPTrainer(cfg, store, rng=jax.random.PRNGKey(0),
+                            zero=stage)
+        stream = synthetic_batches(cfg.vocab_size, 8, 32, seed=5)
+        losses[mode] = [float(tr.step(next(stream))["loss"])
+                        for _ in range(3)]
+    np.testing.assert_allclose(losses["flat"], losses["hier"],
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------- serving: domain locality
+
+
+class _FakeGen:
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def Generate(self, prompt, max_new_tokens=8, *args):
+        with self._lock:
+            self.calls += 1
+        rows = np.asarray(prompt).shape[0]
+        return np.full((rows, int(max_new_tokens)), 7, np.int32)
+
+    def Info(self):
+        return {"in_flight": 0, "queue_depth": 0,
+                "serve_class": "prefill"}
+
+
+def _domain_fleet(domains):
+    """N fake replicas, replica i advertising domains[i] in its
+    registry metadata (the launcher's stamp)."""
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.registry import CoordRegistry
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    actors, servers, regs = [], [], []
+    for i, dom in enumerate(domains):
+        a = _FakeGen(f"r{i}")
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        regs.append(registry.register(
+            "llm", f"r{i}", "127.0.0.1", s.port,
+            metadata={"domain": dom}))
+        actors.append(a)
+        servers.append(s)
+
+    def close():
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        state.close()
+
+    return registry, actors, close
+
+
+def _wait_healthy(gw, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if gw.pool.n_healthy() >= n:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_gateway_routes_and_affinity_stay_in_local_domain():
+    """2 emulated domains, gateway pinned to domain 0: every pick —
+    least-loaded AND prefix-affinity — lands on a domain-0 replica
+    while domain-1 replicas idle; the pool snapshot and the per-class
+    hint carry the domain dimension."""
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.metrics import MetricsRegistry
+
+    registry, actors, close = _domain_fleet([0, 0, 1, 1])
+    cfg = GatewayConfig(probe_interval_s=0.1, probe_timeout_s=2.0,
+                        default_deadline_s=30.0, domain=0)
+    gw = InferenceGateway(registry, "llm", cfg,
+                          metrics_registry=MetricsRegistry())
+    try:
+        assert _wait_healthy(gw, 4)
+        for _ in range(12):
+            assert gw.pool.pick(None, prefer_domain=0).domain() == 0
+        for key in ("alpha", "beta", "gamma"):
+            assert gw.pool.pick(key, prefer_domain=1).domain() == 1
+        prompt = np.zeros((1, 4), np.int32)
+        for _ in range(6):
+            out = np.asarray(gw.generate(prompt, max_new_tokens=4))
+            assert out.shape == (1, 4)
+        assert actors[0].calls + actors[1].calls >= 6
+        assert actors[2].calls + actors[3].calls == 0
+        snaps = gw.pool.status()["replicas"]
+        assert sorted(s["domain"] for s in snaps) == [0, 0, 1, 1]
+        hint = gw.class_hint("prefill")
+        assert hint.signals["domains"] == {"0": 2, "1": 2}
+        # Balanced fleet -> fill the gateway's own domain first.
+        assert hint.signals["spawn_domain"] == 0
+    finally:
+        gw.close()
+        close()
+
+
+def test_spawn_domain_signal_targets_emptiest_domain():
+    """When the local domain is already over-provisioned the signal
+    spills to the least-populated domain (lowest ordinal on ties)."""
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.metrics import MetricsRegistry
+
+    registry, actors, close = _domain_fleet([0, 0, 0, 1])
+    cfg = GatewayConfig(probe_interval_s=0.1, probe_timeout_s=2.0,
+                        default_deadline_s=30.0, domain=0)
+    gw = InferenceGateway(registry, "llm", cfg,
+                          metrics_registry=MetricsRegistry())
+    try:
+        assert _wait_healthy(gw, 4)
+        hint = gw.class_hint("prefill")
+        assert hint.signals["domains"] == {"0": 3, "1": 1}
+        assert hint.signals["spawn_domain"] == 1
+    finally:
+        gw.close()
+        close()
+
+
+def test_reconciler_passes_spawn_domain_to_launcher():
+    """The placement leg: the reconciler folds the hint's
+    ``spawn_domain`` signal and forwards it to launchers whose spawn
+    accepts a domain; legacy duck-typed launchers keep working."""
+    from ptype_tpu.gateway.slo import ScaleHint
+    from ptype_tpu.reconciler.core import Reconciler
+
+    class _Hint:
+        signals = {"spawn_domain": 1, "domains": {"0": 2, "1": 0}}
+
+    rec = object.__new__(Reconciler)
+    rec._spawn_domain = None
+    rec._lock = threading.Lock()
+
+    from ptype_tpu.metrics import MetricsRegistry
+    rec._reg = MetricsRegistry()
+    rec._note_spawn_domain(_Hint())
+    assert rec._spawn_domain == 1
+    # Sticky: a hint without the signal keeps the last placement.
+    rec._note_spawn_domain(ScaleHint(0, "steady", {}))
+    assert rec._spawn_domain == 1
+
+    class _ModernLauncher:
+        def spawn(self, name, warm_hold=False, domain=None):
+            pass
+
+    class _LegacyLauncher:
+        def spawn(self, name, warm_hold=False):
+            pass
+
+    rec.launcher = _ModernLauncher()
+    assert rec._spawn_kwargs() == {"warm_hold": True, "domain": 1}
+    rec.launcher = _LegacyLauncher()
+    assert rec._spawn_kwargs() == {"warm_hold": True}
+
+
+def test_local_launcher_stamps_domain_metadata():
+    """LocalLauncher(domain=...) advertises the domain on every
+    replica it spawns — the metadata the pool's locality routing and
+    ``obs topo`` read back."""
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.reconciler.replica import LocalLauncher
+    from ptype_tpu.registry import CoordRegistry
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    lch = LocalLauncher(registry, lambda: _FakeGen("x"),
+                        metrics_registry=MetricsRegistry(), domain=1)
+    h = lch.spawn("rep0")
+    try:
+        h.activate()
+        deadline = time.monotonic() + 5.0
+        node = None
+        while time.monotonic() < deadline:
+            nodes = registry.services().get("llm", [])
+            if nodes:
+                node = nodes[0]
+                break
+            time.sleep(0.05)
+        assert node is not None
+        assert node.metadata.get("domain") == 1
+        # A per-spawn placement hint overrides the launcher default.
+        h2 = lch.spawn("rep1", domain=0)
+        assert h2._host.domain == 0
+    finally:
+        lch.close()
+        state.close()
+
+
+# ------------------------------ serving: KV migration stays in-domain
+
+
+@pytest.fixture(scope="module")
+def params():
+    from ptype_tpu.models import transformer as tfm
+
+    cfg = tfm.preset("tiny", dtype=jnp.float32)
+    return cfg, jax.jit(lambda r: tfm.init_params(r, cfg))(
+        jax.random.PRNGKey(0))
+
+
+def _disagg_fleet(params, placement, gw_domain):
+    """Real paged engines at ``placement`` = [(name, serve_class,
+    domain), ...], fronted by a domain-pinned disaggregated gateway.
+    Returns (gw, mreg, actors, close)."""
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.metrics import MetricsRegistry
+    from ptype_tpu.registry import CoordRegistry
+    from ptype_tpu.serve_engine import PagedGeneratorActor
+
+    cfg, p = params
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    actors, servers, regs = [], [], []
+    for name, cls, dom in placement:
+        a = PagedGeneratorActor(cfg, params=p, n_slots=2,
+                                block_tokens=16, prefill_chunk=32,
+                                serve_class=cls,
+                                metrics_registry=MetricsRegistry())
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        regs.append(registry.register("llm-topo", name, "127.0.0.1",
+                                      s.port,
+                                      metadata={"domain": dom}))
+        actors.append(a)
+        servers.append(s)
+    mreg = MetricsRegistry()
+    gcfg = GatewayConfig(probe_interval_s=0.1, probe_timeout_s=2.0,
+                         default_deadline_s=60.0, disagg=True,
+                         kv_wire="exact", domain=gw_domain)
+    gw = InferenceGateway(registry, "llm-topo", gcfg,
+                          metrics_registry=mreg)
+
+    def close():
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        for a in actors:
+            a.close()
+        state.close()
+
+    return gw, mreg, actors, close
+
+
+def _wait_classes(gw, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        classes = {r.serve_class() for r in gw.pool.healthy()}
+        if {"prefill", "decode"} <= classes:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _topo_prompt(n, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(1, 100, n), jnp.int32)[None]
+
+
+def test_kv_migration_stays_in_domain_when_local_holder_exists(params):
+    """THE serving drill: prefill and one decode replica share domain
+    0, a second decode replica sits across the slow leg in domain 1.
+    Every migration lands on the domain-0 decode (cross-domain count
+    stays at ZERO — measurably below the no-local-holder spill, which
+    pays one cross-domain migration per request), tokens match solo
+    decode bit-for-bit, and no request is lost."""
+    gw, mreg, actors, close = _disagg_fleet(
+        params,
+        [("pre0", "prefill", 0), ("dec0", "decode", 0),
+         ("dec1", "decode", 1)], gw_domain=0)
+    try:
+        assert _wait_classes(gw)
+        pre, dec_local, dec_far = actors
+        for i in range(2):
+            prompt = _topo_prompt(40, seed=100 + i)
+            ref = np.asarray(pre.Generate(prompt, 6))
+            out = np.asarray(gw.generate(prompt, max_new_tokens=6))
+            np.testing.assert_array_equal(out, ref)
+        assert dec_local.Info()["migrations"] == 2
+        assert dec_far.Info()["migrations"] == 0
+        c = mreg.snapshot()["counters"]
+        assert c.get("serve.migrate.local_domain", 0) == 2
+        assert c.get("serve.migrate.cross_domain", 0) == 0
+        assert c.get("gateway.shed", 0) == 0
+    finally:
+        close()
+
+
+def test_kv_migration_crosses_domain_only_without_local_holder(params):
+    """The sanctioned spill: with NO decode replica in the prefill's
+    domain the request still completes (zero lost) and the
+    cross-domain counter records the slow-leg migration."""
+    gw, mreg, actors, close = _disagg_fleet(
+        params,
+        [("pre0", "prefill", 0), ("dec1", "decode", 1)], gw_domain=0)
+    try:
+        assert _wait_classes(gw)
+        pre, dec_far = actors
+        prompt = _topo_prompt(40, seed=200)
+        ref = np.asarray(pre.Generate(prompt, 6))
+        out = np.asarray(gw.generate(prompt, max_new_tokens=6))
+        np.testing.assert_array_equal(out, ref)
+        assert dec_far.Info()["migrations"] == 1
+        c = mreg.snapshot()["counters"]
+        assert c.get("serve.migrate.cross_domain", 0) == 1
+        assert c.get("serve.migrate.local_domain", 0) == 0
+    finally:
+        close()

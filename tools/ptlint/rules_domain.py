@@ -1,4 +1,4 @@
-"""PT001–PT012 (plus PT021/PT022): the house rules.
+"""PT001–PT012 (plus PT021–PT023): the house rules.
 
 PT001–PT012 were migrated from tools/lint.py; each rule guards one
 architectural seam this repo earned the hard way (the full rationale
@@ -8,7 +8,9 @@ old walker's findings on a fixture tree. PT021 (KV wire serialization
 outside the migration home, ISSUE 16) joins them here because it is
 the same single-home family as PT008/PT011; PT022 (full-tree param
 allgather in ``train/``, ISSUE 17) extends that family to the ZeRO-3
-residency contract.
+residency contract; PT023 (hard-coded flat ``"data"`` axis names
+outside ``parallel/``, ISSUE 18) extends it to the topology plane's
+axis-name discipline.
 """
 
 from __future__ import annotations
@@ -609,4 +611,109 @@ class _ParamGatherCheck(ast.NodeVisitor):
 def check_pt022(ctx: FileContext) -> list[Finding]:
     findings: list[Finding] = []
     _ParamGatherCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# ------------------------------------------------------------------ PT023
+
+#: Callables whose positional axis-name argument makes a ``"data"``
+#: literal a flat-axis collective construction.
+_AXIS_CALLABLES = frozenset({
+    "psum", "pmean", "psum_scatter", "all_gather", "all_to_all",
+    "ppermute", "axis_index", "axis_size", "axis_n",
+    "PartitionSpec", "P",
+})
+
+#: Keyword names that carry an axis name anywhere in the package.
+_AXIS_KWARGS = frozenset({"axis", "mesh_axis", "axis_name"})
+
+#: Callables whose dict-literal argument is mesh geometry.
+_MESH_BUILDERS = frozenset({"build_mesh", "local_mesh"})
+
+
+def _is_data(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value == "data"
+
+
+class _FlatAxisLiteralCheck(ast.NodeVisitor):
+    """Hard-coded ``"data"`` axis names outside ``parallel/``.
+
+    The topology plane (ISSUE 18) made the data axis a VALUE, not a
+    name: on a hierarchical mesh the flat ``"data"`` axis becomes the
+    composite ``("inner", "outer")`` tuple, and every module that
+    spells the literal instead of reading ``DATA_AXIS`` /
+    ``topology.flat_axis`` / the store's ``.axis`` silently builds a
+    1-D program that cannot ride the hierarchical decomposition —
+    shardings stop matching, collectives launch over an axis the mesh
+    no longer has. ``parallel/`` is the literal's one home
+    (``topology.DATA_AXIS`` is defined there); everywhere else the
+    axis name must flow from the topology descriptor or the object
+    that owns the mesh. Catches the kwarg form (``axis="data"``),
+    positional axis names handed to collective/sharding callables
+    (``psum(x, "data")``, ``P("data")``), mesh-geometry dict keys
+    (``build_mesh({"data": n})``), axis-name parameter defaults, and
+    axis-keyed subscripts (``mesh.shape["data"]``,
+    ``axis_sizes["data"]``).
+    """
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+
+    def _flag(self, node, how: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT023",
+            f"hard-coded \"data\" axis name ({how}) outside "
+            f"parallel/ — on a hierarchical mesh the flat axis is "
+            f"the composite (\"inner\", \"outer\") tuple; spell it "
+            f"as topology.DATA_AXIS / topology.flat_axis / the "
+            f"owning object's .axis so the program rides the "
+            f"topology plane instead of pinning a 1-D mesh"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = terminal_name(node.func)
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARGS and _is_data(kw.value):
+                self._flag(kw.value, f"{kw.arg}= keyword")
+        if name in _AXIS_CALLABLES:
+            for a in node.args:
+                if _is_data(a):
+                    self._flag(a, f"positional axis to {name}()")
+        if name in _MESH_BUILDERS:
+            for a in node.args:
+                if isinstance(a, ast.Dict):
+                    for k in a.keys:
+                        if _is_data(k):
+                            self._flag(k, f"mesh axis key in {name}()")
+        self.generic_visit(node)
+
+    def _defaults(self, node) -> None:
+        args = node.args
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):],
+                        args.defaults):
+            if a.arg in _AXIS_KWARGS and _is_data(d):
+                self._flag(d, f"default for {a.arg}=")
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and a.arg in _AXIS_KWARGS and _is_data(d):
+                self._flag(d, f"default for {a.arg}=")
+        self.generic_visit(node)
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _defaults
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if _is_data(node.slice):
+            base = node.value
+            attr = base.attr if isinstance(base, ast.Attribute) else (
+                base.id if isinstance(base, ast.Name) else "")
+            if attr == "shape" or "axis" in attr:
+                self._flag(node, f"{attr}[\"data\"] subscript")
+        self.generic_visit(node)
+
+
+@rule("PT023", "hard-coded flat \"data\" axis name outside parallel/",
+      applies=lambda ctx: ctx.in_pkg and not ctx.in_dir("parallel"))
+def check_pt023(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _FlatAxisLiteralCheck(ctx, findings).visit(ctx.tree)
     return findings
