@@ -4,7 +4,7 @@
 # `test-all` adds the XLA-compile-heavy ML tests and the multiprocess/
 # failover/scale drills (the `slow` marker, tests/conftest.py).
 
-.PHONY: test test-all bench serve-bench spec-bench disagg-bench scale-bench collectives-bench hier-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
+.PHONY: test test-all bench serve-bench spec-bench disagg-bench scale-bench traffic-bench collectives-bench hier-bench zero-bench profile-bench jitwatch-bench lint native tpu-smoke tpu-validate chaos obs-demo health-demo serve-obs-demo
 
 test:
 	python -m pytest tests/ -x -q -m "not slow"
@@ -53,6 +53,19 @@ disagg-bench:
 # ISSUE 13 acceptance numbers.
 scale-bench:
 	JAX_PLATFORMS=cpu python bench.py --scale
+
+# Open-loop traffic observatory (docs/OBSERVABILITY.md "Traffic
+# plane", docs/OPERATIONS.md "Capacity planning"): one seeded trace
+# replayed open-loop at >= 5 offered rates through the gateway +
+# reconciler fleet — the JSON tail carries the capacity frontier with
+# its located knee (traffic_knee_rps / traffic_goodput_at_knee_pct /
+# traffic_ttft_p99_ms_open_loop), the diurnal-spike drill (the
+# reconciler-armed fleet must hold the TTFT p99 SLO through the
+# replayed spike the static fleet fails), scale-up-latency vs burst
+# steepness, and the shed-rate-vs-burn-budget curve — the ISSUE 19
+# acceptance numbers. Replay any run with PTYPE_TRAFFIC_SEED=<seed>.
+traffic-bench:
+	JAX_PLATFORMS=cpu python bench.py --traffic
 
 # Gradient-wire microbench on the 8-device virtual host mesh
 # (docs/PERF.md "Quantized + overlapped collectives"): bucketed

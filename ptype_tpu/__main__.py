@@ -62,6 +62,14 @@ operations need. Commands:
                split (local-domain vs cross-domain) — the
                cross-domain-pressure runbook row reads this after
                ``obs serve`` ($TOP_ITERS bounds refreshes; ^C exits).
+- ``obs traffic`` — LIVE traffic-plane view (ISSUE 19): re-pull the
+               cluster telemetry every $TOP_INTERVAL and repaint each
+               open-loop load driver's offered/achieved rates,
+               SLO-attributed goodput, shed/overrun/chaos-drop split,
+               open-loop TTFT p99, and the last measured capacity
+               knee with live headroom against it ($TOP_ITERS bounds
+               refreshes; ^C exits). docs/OPERATIONS.md "Capacity
+               planning" has the runbook.
 - ``obs profile`` — cluster-wide device profiling: simultaneous
                jax.profiler XPlane capture on every registered node
                via the built-in ptype.Profile endpoint
@@ -415,6 +423,18 @@ def _obs() -> None:
                           iters=int(os.environ.get("TOP_ITERS", "0")),
                           interval_s=float(
                               os.environ.get("TOP_INTERVAL", "2")))
+            except KeyboardInterrupt:
+                pass
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "traffic":
+            from ptype_tpu.health import run_traffic
+
+            try:
+                run_traffic(CoordRegistry(coord),
+                            iters=int(os.environ.get(
+                                "TOP_ITERS", "0")),
+                            interval_s=float(
+                                os.environ.get("TOP_INTERVAL", "2")))
             except KeyboardInterrupt:
                 pass
             return

@@ -58,6 +58,15 @@ site                    actions
                         the ``reshard-stall`` health rule)
                         (parallel/zero.py ``ZeroState.reshard``; keyed
                         by ``bucketNNNNN``)
+``loadgen.issue``       ``drop`` (swallow one scheduled arrival — the
+                        trace records a ``dropped`` outcome and
+                        goodput accounts it) / ``delay`` (stall the
+                        issue — a wedged driver host; surfaces as
+                        ``loadgen.overrun`` + issue lag, never as a
+                        silent closed-loop wait). Keyed by arrival
+                        ``seq``; answered requests pair the recovery,
+                        so traffic replay composes with the chaos
+                        soak (loadgen/driver.py)
 ======================  =====================================================
 
 Zero-cost contract: every seam calls ``chaos.hit(site, key)``, which is

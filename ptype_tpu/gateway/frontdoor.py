@@ -146,7 +146,8 @@ class InferenceGateway:
         self.slo = SLOTracker(service, registry=metrics_registry,
                               window_s=self.cfg.stats_window_s,
                               slo_p99_ms=self.cfg.slo_p99_ms,
-                              slo_ttft_p99_ms=self.cfg.slo_ttft_p99_ms)
+                              slo_ttft_p99_ms=self.cfg.slo_ttft_p99_ms,
+                              slo_tpot_p99_ms=self.cfg.slo_tpot_p99_ms)
         self.pool = ReplicaPool(
             registry, service,
             info_method=self.cfg.info_method,
@@ -514,6 +515,9 @@ class InferenceGateway:
                      kv={"replica": pre.key, "err": repr(e)[:200]})
             return self._dispatch(self.cfg.generate_method, gen_args,
                                   deadline, affinity_key, counter)
+        # Prefill returned the first token: the disagg path knows its
+        # real per-request TTFT (goodput attribution, ISSUE 19).
+        ttft_ms = (time.perf_counter() - t0) * 1000.0
         export_id = rep["export_id"]
         first = int(rep["first_token"])
         bt = int(rep["block_tokens"])
@@ -528,7 +532,7 @@ class InferenceGateway:
             out = np.zeros((1, max_new), np.int32)
             out[0, 0] = first
             self.slo.answered((time.perf_counter() - t0) * 1000.0,
-                              counter(out))
+                              counter(out), ttft_ms=ttft_ms)
             return out
         # ---- stage 2: decode-class pick, steered by the directory
         dec = self._pick_decode(pre, hashes, contents)
@@ -630,8 +634,11 @@ class InferenceGateway:
         emitted = [int(t) for t in tokens][:max_new]
         out[0, :len(emitted)] = emitted
         self.directory.publish(dec.key, zip(hashes, contents))
-        self.slo.answered((time.perf_counter() - t0) * 1000.0,
-                          counter(out))
+        e2e_ms = (time.perf_counter() - t0) * 1000.0
+        n_out = counter(out)
+        self.slo.answered(e2e_ms, n_out, ttft_ms=ttft_ms,
+                          tpot_ms=((e2e_ms - ttft_ms) / (n_out - 1)
+                                   if n_out > 1 else None))
         chaos.note_ok("serve.migrate", dec.key)
         chaos.note_ok("gateway.call", dec.key)
         return out

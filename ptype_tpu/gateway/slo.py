@@ -26,7 +26,23 @@ Metric names (under the process-global registry by default):
 ``gateway.<svc>.queue_depth``           admission queue depth (gauge)
 ``gateway.<svc>.healthy_replicas``      routable fleet size (gauge)
 ``gateway.<svc>.scale_hint``            last computed hint delta (gauge)
+``gateway.<svc>.slo_good_requests``     answered AND met the TTFT and
+                                        TPOT SLOs (counter)
+``gateway.<svc>.slo_violations``        everything else that arrived:
+                                        sheds, errors, and answers
+                                        over SLO (counter)
 ======================================  ================================
+
+Goodput is first-class (ISSUE 19): the good/violation pair moves per
+request, so the capacity frontier and the burn-rate math read a
+*series*, never post-hoc percentile arithmetic. A request is good
+only if TTFT **and** TPOT met their SLOs; when the dispatch path
+cannot report a per-request TTFT (the interleaved path is not
+streaming), the e2e latency stands in as a conservative upper bound —
+TTFT ≤ e2e, so the fallback can only under-count goodput. The
+disaggregated path reports its real TTFT (prefill completion is the
+first token). With no SLOs configured every answer counts good, so
+the counters stay meaningful as plain answered/failed accounting.
 """
 
 from __future__ import annotations
@@ -60,11 +76,13 @@ class SLOTracker:
                  registry: metrics_mod.MetricsRegistry | None = None,
                  window_s: float = 30.0,
                  slo_p99_ms: float | None = None,
-                 slo_ttft_p99_ms: float | None = None):
+                 slo_ttft_p99_ms: float | None = None,
+                 slo_tpot_p99_ms: float | None = None):
         self.service = service
         self.window_s = float(window_s)
         self.slo_p99_ms = slo_p99_ms
         self.slo_ttft_p99_ms = slo_ttft_p99_ms
+        self.slo_tpot_p99_ms = slo_tpot_p99_ms
         reg = registry if registry is not None else metrics_mod.metrics
         self._reg = reg
         p = f"gateway.{service}"
@@ -77,6 +95,8 @@ class SLOTracker:
         self.g_queue = reg.gauge(f"{p}.queue_depth")
         self.g_replicas = reg.gauge(f"{p}.healthy_replicas")
         self.g_hint = reg.gauge(f"{p}.scale_hint")
+        self.c_good = reg.counter(f"{p}.slo_good_requests")
+        self.c_violations = reg.counter(f"{p}.slo_violations")
         self._lock = lockcheck.lock("gateway.slo")
         #: (t, latency_ms, tokens) for answered requests in the window.
         self._ok: list[tuple[float, float, int]] = []
@@ -89,9 +109,15 @@ class SLOTracker:
     def arrived(self) -> None:
         self.c_requests.add(1)
 
-    def answered(self, latency_ms: float, tokens: int = 0) -> None:
+    def answered(self, latency_ms: float, tokens: int = 0,
+                 ttft_ms: float | None = None,
+                 tpot_ms: float | None = None) -> None:
         self.c_answered.add(1)
         self.h_latency.observe(latency_ms)
+        if self._good(latency_ms, ttft_ms, tpot_ms):
+            self.c_good.add(1)
+        else:
+            self.c_violations.add(1)
         now = time.monotonic()
         with self._lock:
             self._ok.append((now, latency_ms, int(tokens)))
@@ -99,8 +125,27 @@ class SLOTracker:
             self._ewma_ms = (latency_ms if self._ewma_ms == 0.0
                              else 0.2 * latency_ms + 0.8 * self._ewma_ms)
 
+    def _good(self, latency_ms: float, ttft_ms: float | None,
+              tpot_ms: float | None) -> bool:
+        """SLO attribution for ONE answered request (module docstring:
+        missing TTFT falls back to e2e, the conservative bound; a
+        TPOT SLO with no sample counts as met — a single-token answer
+        has no inter-token gap to judge)."""
+        if self.slo_ttft_p99_ms is not None:
+            ttft = ttft_ms if ttft_ms is not None else latency_ms
+            if ttft > self.slo_ttft_p99_ms:
+                return False
+        if (self.slo_tpot_p99_ms is not None and tpot_ms is not None
+                and tpot_ms > self.slo_tpot_p99_ms):
+            return False
+        if (self.slo_ttft_p99_ms is None and self.slo_p99_ms is not None
+                and latency_ms > self.slo_p99_ms):
+            return False
+        return True
+
     def shed(self) -> None:
         self.c_shed.add(1)
+        self.c_violations.add(1)
         now = time.monotonic()
         with self._lock:
             self._sheds.append(now)
@@ -116,6 +161,7 @@ class SLOTracker:
 
     def errored(self) -> None:
         self.c_errors.add(1)
+        self.c_violations.add(1)
 
     def _trim(self, now: float) -> None:
         cut = now - self.window_s
@@ -160,12 +206,25 @@ class SLOTracker:
             toks = sum(t for _, _, t in self._ok)
             return toks / span if span > 0 else 0.0
 
+    def goodput(self) -> dict:
+        """Lifetime SLO-attributed goodput: the good/violation split
+        and the good fraction of everything that arrived and was
+        resolved (answered + shed + errored)."""
+        good = self.c_good.value
+        bad = self.c_violations.value
+        total = good + bad
+        return {"slo_good_requests": int(good),
+                "slo_violations": int(bad),
+                "goodput_pct": (100.0 * good / total if total
+                                else 100.0)}
+
     def percentiles(self) -> dict:
         return {"p50_ms": self.h_latency.percentile(50),
                 "p95_ms": self.h_latency.percentile(95),
                 "p99_ms": self.h_latency.percentile(99),
                 "ttft_p50_ms": self.h_ttft.percentile(50),
-                "ttft_p99_ms": self.h_ttft.percentile(99)}
+                "ttft_p99_ms": self.h_ttft.percentile(99),
+                **self.goodput()}
 
     # --------------------------------------------------------- scale hint
 

@@ -22,6 +22,7 @@ from ptype_tpu.health.profiling import (AlertCapture, ProfileError,
                                         measure_compiled_cost,
                                         summarize)
 from ptype_tpu.health.rules import (Alert, AlertEngine, BurnRateRule,
+                                    CapacityHeadroomRule,
                                     ClusterView, CoordFlapRule,
                                     KvPressureRule, LossRule,
                                     MemoryGrowthRule, MfuGapRule,
@@ -38,8 +39,9 @@ from ptype_tpu.health.serving import (RequestRecord, ServingLedger,
                                       measure_seam_cost_us)
 from ptype_tpu.health.top import (render_jit, render_scale,
                                   render_serve, render_top,
-                                  render_topo, run_jit, run_scale,
-                                  run_serve, run_top, run_topo)
+                                  render_topo, render_traffic,
+                                  run_jit, run_scale, run_serve,
+                                  run_top, run_topo, run_traffic)
 
 __all__ = [
     "SeriesRing", "SeriesStore", "Sampler", "telemetry_endpoint",
@@ -53,8 +55,9 @@ __all__ = [
     "CoordFlapRule", "MemoryGrowthRule", "MfuGapRule", "TtftRule",
     "KvPressureRule", "PrefixHitCollapseRule", "ServeStallRule",
     "RecompileStormRule", "MigrationStallRule", "ReshardStallRule",
+    "CapacityHeadroomRule",
     "default_rules",
     "render_top", "run_top", "render_serve", "run_serve",
     "render_scale", "run_scale", "render_jit", "run_jit",
-    "render_topo", "run_topo",
+    "render_topo", "run_topo", "render_traffic", "run_traffic",
 ]

@@ -835,6 +835,58 @@ class ReshardStallRule(Rule):
         return out
 
 
+class CapacityHeadroomRule(Rule):
+    """Offered load sustained above the last-measured capacity knee:
+    a node publishing traffic-plane series (the open-loop driver's
+    ``loadgen.offered`` counter) is being asked for more than the
+    frontier sweep measured the fleet good for (the ``loadgen
+    .knee_rps`` gauge :func:`~ptype_tpu.loadgen.frontier.publish_knee`
+    stamps). This is the *leading* capacity signal — it warns while
+    goodput still holds, before the SLO burns and ``slo-burn-rate``
+    pages. Structural: both series exist only where a frontier has
+    been measured and traffic is being offered, so untraffic'd fleets
+    never see it. Runbook: docs/OPERATIONS.md "Capacity planning"."""
+
+    name = "capacity-headroom"
+    severity = "warn"
+
+    def __init__(self, window_s: float = 30.0,
+                 headroom_frac: float = 0.9,
+                 min_offered: float = 8.0):
+        self.window_s = float(window_s)
+        #: Warn at this fraction of the knee — at 1.0 the warning and
+        #: the goodput collapse arrive together, which is too late.
+        self.headroom_frac = float(headroom_frac)
+        self.min_offered = float(min_offered)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        for node in view.node_keys():
+            knee = view.last(node, "loadgen.knee_rps")
+            if knee is None or knee[1] <= 0:
+                continue  # no frontier measured on this node
+            pts = view.series(node, "loadgen.offered")
+            if not pts:
+                continue
+            offered = counter_delta(pts, self.window_s, view.now)
+            if offered < self.min_offered:
+                continue  # a handful of requests is not "sustained"
+            span = min(self.window_s,
+                       max(1e-9, pts[-1][0] - pts[0][0]))
+            rate = offered / span
+            bar = self.headroom_frac * knee[1]
+            if rate >= bar:
+                out.append(self._alert(
+                    node,
+                    f"offered load ~{rate:.0f} rps sustained at "
+                    f">={self.headroom_frac:.0%} of the measured "
+                    f"capacity knee ({knee[1]:.0f} rps) — grow the "
+                    f"fleet or re-sweep the frontier before the SLO "
+                    f"burns",
+                    value=rate, threshold=bar))
+        return out
+
+
 def default_rules(service: str = "llm",
                   slo_p99_ms: float | None = None,
                   slo_ttft_ms: float | None = None) -> list[Rule]:
@@ -844,11 +896,12 @@ def default_rules(service: str = "llm",
     page is opt-in (a healthy prompt-heavy fleet over an arbitrary
     default would page, and auto-capture profiles, out of the box).
     The structural rules (kv-pressure / prefix-hit-collapse /
-    serve-stall / migration-stall / reshard-stall) are always in the
-    set — they key on ``serve.*`` / ``kv.*`` / reshard-armed
-    ``train.*`` series only the relevant plane emits and need no
-    target, so other fleets never pay a false page for their
-    presence."""
+    serve-stall / migration-stall / reshard-stall /
+    capacity-headroom) are always in the set — they key on
+    ``serve.*`` / ``kv.*`` / reshard-armed ``train.*`` /
+    frontier-armed ``loadgen.*`` series only the relevant plane emits
+    and need no target, so other fleets never pay a false page for
+    their presence."""
     rules: list[Rule] = [
         BurnRateRule(service=service),
         StallRule(),
@@ -863,6 +916,7 @@ def default_rules(service: str = "llm",
         RecompileStormRule(),
         MigrationStallRule(),
         ReshardStallRule(),
+        CapacityHeadroomRule(),
     ]
     if slo_ttft_ms is not None:
         rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))

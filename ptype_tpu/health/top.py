@@ -199,6 +199,35 @@ def render_serve(snapshot: dict, alerts=(),
             f"{num(dedup, '{:.0f}'):>6}")
     if not serving:
         lines.append("  (no serving replicas report serve.* metrics)")
+    # Gateway goodput (ISSUE 19): the SLO-attributed good/violation
+    # split per gateway service — the series the capacity frontier
+    # reads, surfaced where the serving tails already live.
+    gateways: list[tuple[str, str, dict]] = []
+    for key, t in sorted(nodes.items()):
+        counters = t.get("metrics", {}).get("counters", {})
+        for cname in sorted(counters):
+            if (cname.startswith("gateway.")
+                    and cname.endswith(".requests")):
+                gateways.append(
+                    (key, cname[len("gateway."):-len(".requests")],
+                     counters))
+    if gateways:
+        lines.append("")
+        lines.append(f"{'gateway':<28} {'svc':>10} {'req':>7} "
+                     f"{'ans':>7} {'shed':>6} {'good':>7} "
+                     f"{'viol':>6} {'good%':>6}")
+        for key, svc, counters in gateways[:max_nodes]:
+            g = counters.get(f"gateway.{svc}.slo_good_requests")
+            v = counters.get(f"gateway.{svc}.slo_violations")
+            pct = (100.0 * g / (g + v) if g is not None
+                   and v is not None and (g + v) > 0 else None)
+            lines.append(
+                f"{key[:28]:<28} {svc[:10]:>10} "
+                f"{num(counters.get(f'gateway.{svc}.requests'), '{:.0f}'):>7} "
+                f"{num(counters.get(f'gateway.{svc}.answered'), '{:.0f}'):>7} "
+                f"{num(counters.get(f'gateway.{svc}.shed'), '{:.0f}'):>6} "
+                f"{num(g, '{:.0f}'):>7} {num(v, '{:.0f}'):>6} "
+                f"{num(pct):>6}")
     for key in sorted(errors)[:8]:
         lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
     lines.append("")
@@ -275,6 +304,89 @@ def render_scale(snapshot: dict, alerts=(),
             f"{num(_gauge(t, 'serve.active_slots')):>5} "
             f"{num(_gauge(t, 'serve.kv_free_blocks')):>7} "
             f"{num(_hist(t, 'serve.ttft_ms').get('p99')):>7}m")
+    for key in sorted(errors)[:8]:
+        lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
+    lines.append("")
+    alerts = list(alerts)
+    if alerts:
+        lines.append(f"ALERTS ({len(alerts)} recent):")
+        for a in alerts[-12:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(a.ts))
+            lines.append(
+                f"  {ts} [{a.severity:<4}] {a.rule:<14} "
+                f"{a.node[:28]:<28} {a.message}")
+    else:
+        lines.append("no alerts")
+    return "\n".join(lines)
+
+
+def _srate(telem: dict, name: str):
+    """Last sampled value of a series (the sampler's ``<ctr>.rate`` /
+    ``<hist>.p99`` stamps) — None when the node publishes no series
+    store or the series has no points yet."""
+    pts = telem.get("series", {}).get(name)
+    return pts[-1][1] if pts else None
+
+
+def render_traffic(snapshot: dict, alerts=(),
+                   max_nodes: int = 32) -> str:
+    """``obs traffic``: the traffic-plane one-pager (ISSUE 19). One
+    row per node driving open-loop load (anything exporting
+    ``loadgen.*``): the schedule's target rate, the live offered /
+    achieved rates off the sampler, SLO-attributed goodput, the
+    shed/overrun/chaos-drop split, the open-loop TTFT tail, and the
+    last measured capacity knee with live headroom against it — the
+    same numbers the ``capacity-headroom`` rule warns on, so the
+    operator and the rule read one surface."""
+    nodes = snapshot.get("nodes", {})
+    errors = snapshot.get("errors", {})
+    drivers = {k: t for k, t in nodes.items()
+               if _gauge(t, "loadgen.offered_rps") is not None
+               or (t.get("metrics", {}).get("counters", {})
+                   .get("loadgen.offered")) is not None}
+
+    def num(v, fmt="{:.0f}", dash="-"):
+        return fmt.format(v) if v is not None else dash
+
+    def cnt(t, name):
+        return t.get("metrics", {}).get("counters", {}).get(name)
+
+    lines = [
+        f"ptype traffic @ {snapshot.get('ts')} — {len(drivers)} "
+        f"load drivers ({len(nodes)} nodes, "
+        f"{len(errors)} unreachable)",
+        f"{'driver':<28} {'target':>7} {'off/s':>7} {'ach/s':>7} "
+        f"{'good%':>6} {'shed':>6} {'ovrn':>6} {'drop':>5} "
+        f"{'infl':>5} {'ttft99':>8} {'knee':>7} {'head%':>6}",
+    ]
+    for key in sorted(drivers)[:max_nodes]:
+        t = drivers[key]
+        good = cnt(t, "loadgen.slo_good")
+        bad = cnt(t, "loadgen.slo_bad")
+        pct = (100.0 * good / (good + bad)
+               if good is not None and bad is not None
+               and (good + bad) > 0 else None)
+        off_rate = _srate(t, "loadgen.offered.rate")
+        knee = _gauge(t, "loadgen.knee_rps")
+        head = (100.0 * off_rate / knee
+                if off_rate is not None and knee else None)
+        lines.append(
+            f"{key[:28]:<28} "
+            f"{num(_gauge(t, 'loadgen.offered_rps')):>7} "
+            f"{num(off_rate, '{:.1f}'):>7} "
+            f"{num(_srate(t, 'loadgen.answered.rate'), '{:.1f}'):>7} "
+            f"{num(pct, '{:.1f}'):>6} "
+            f"{num(cnt(t, 'loadgen.shed')):>6} "
+            f"{num(cnt(t, 'loadgen.overrun')):>6} "
+            f"{num(cnt(t, 'loadgen.dropped')):>5} "
+            f"{num(_gauge(t, 'loadgen.inflight')):>5} "
+            f"{num(_hist(t, 'loadgen.ttft_ms').get('p99')):>7}m "
+            f"{num(knee):>7} {num(head, '{:.0f}'):>6}")
+    if not drivers:
+        lines.append("  (no node exports loadgen.* — no open-loop "
+                     "driver is running, or its registry is not "
+                     "published; see docs/OBSERVABILITY.md "
+                     "'Traffic plane')")
     for key in sorted(errors)[:8]:
         lines.append(f"{key[:28]:<28} UNREACHABLE ({errors[key]})")
     lines.append("")
@@ -486,6 +598,20 @@ def run_scale(registry, iters: int = 0, interval_s: float = 2.0,
                    engine=engine, services=services,
                    include_local=include_local, out=out, clear=clear,
                    render=render_scale)
+
+
+def run_traffic(registry, iters: int = 0, interval_s: float = 2.0,
+                engine: AlertEngine | None = None,
+                services: list[str] | None = None,
+                include_local: bool = False, out=None,
+                clear: bool = True) -> AlertEngine:
+    """The ``obs traffic`` loop: :func:`run_top`'s poll contract with
+    the traffic-plane rendering (the capacity-headroom rule fires off
+    the same snapshot)."""
+    return run_top(registry, iters=iters, interval_s=interval_s,
+                   engine=engine, services=services,
+                   include_local=include_local, out=out, clear=clear,
+                   render=render_traffic)
 
 
 def run_topo(registry, iters: int = 0, interval_s: float = 2.0,

@@ -37,6 +37,7 @@ SLOW_FILES = {
     "test_elastic.py", "test_elastic_mp.py", "test_examples.py",
     "test_failover.py",
     "test_flash_attention.py", "test_fsdp_8b.py", "test_generate.py",
+    "test_loadgen_drills.py",
     "test_models.py", "test_moe.py", "test_mp_train.py",
     "test_multihost_walkthrough.py",
     "test_overlap.py", "test_param_server.py", "test_pipeline.py",

@@ -1078,3 +1078,65 @@ def test_ptype_tpu_package_is_pt023_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt023 = [f for f in findings if "PT023" in f]
     assert not pt023, pt023
+
+
+# --------------------------------------------------------------- PT024
+
+
+PT024_RAW_DRAWS = (
+    "import random\n"
+    "import numpy as np\n"
+    "import numpy.random as npr\n"
+    "from random import expovariate, shuffle\n"
+    "def schedule(n):\n"
+    "    ts = [random.random() for _ in range(n)]\n"      # 1
+    "    ts.append(np.random.poisson(3.0))\n"             # 2
+    "    ts.append(npr.uniform(0.0, 1.0))\n"              # 3
+    "    ts.append(expovariate(2.0))\n"                   # 4
+    "    shuffle(ts)\n"                                   # 5
+    "    return ts\n"
+)
+
+
+def test_pt024_flags_raw_draws_in_loadgen(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/loadgen/bad24.py",
+                      PT024_RAW_DRAWS)
+    assert sum("PT024" in f for f in findings) == 5, findings
+
+
+def test_pt024_silent_in_rng_home_and_outside_loadgen(tmp_path):
+    # The seeded RNG home itself wraps stdlib Random — exempt; and
+    # the rule is loadgen/-scoped, not package-wide.
+    for rel in ("ptype_tpu/loadgen/rng.py",
+                "ptype_tpu/serve/sampler24.py",
+                "tools/gen24.py"):
+        findings = _check(tmp_path, rel, PT024_RAW_DRAWS)
+        assert not any("PT024" in f for f in findings), (rel, findings)
+
+
+def test_pt024_silent_on_tracerng_draws(tmp_path):
+    src = (
+        "from ptype_tpu.loadgen.rng import TraceRng\n"
+        "def schedule(seed, n):\n"
+        "    rng = TraceRng(seed, salt='loadgen').fork('schedule')\n"
+        "    return [rng.expovariate(2.0) for _ in range(n)]\n"
+    )
+    findings = _check(tmp_path, "ptype_tpu/loadgen/ok24.py", src)
+    assert not any("PT024" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt024_clean():
+    """Replay discipline (ISSUE 19): every traffic draw in loadgen/
+    flows through the seeded TraceRng home, so a trace's seed is a
+    complete replay recipe for the frontier and the spike drill."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt024 = [f for f in findings if "PT024" in f]
+    assert not pt024, pt024

@@ -1,0 +1,447 @@
+"""Open-loop traffic observatory (ISSUE 19), fast tier: seeded-replay
+identity, the heavy-tailed shared-prefix population, the ledger's
+SLO-attributed goodput math, the never-closed-loop driver contract
+(bounded in-flight + overrun accounting), the ``loadgen.issue`` chaos
+seam with paired recovery, the capacity-frontier knee, the
+``capacity-headroom`` rule, the ``obs traffic`` / ``obs serve``
+renders, the gateway SLOTracker goodput counters — and the headline
+blind-spot demonstration: on the same under-provisioned fleet the
+open-loop TTFT tail strictly exceeds the closed-loop one."""
+
+import threading
+import time
+
+import pytest
+
+from ptype_tpu import chaos
+from ptype_tpu.chaos import FaultPlan, FaultSpec
+from ptype_tpu.errors import ShedError
+from ptype_tpu.gateway.slo import SLOTracker
+from ptype_tpu.health import CapacityHeadroomRule, render_serve, \
+    render_traffic
+from ptype_tpu.health.rules import ClusterView
+from ptype_tpu.loadgen import (ClosedLoopDriver, DriverConfig,
+                               Outcome, OpenLoopDriver, RatePoint,
+                               TraceRng, TrafficLedger, gateway_target,
+                               locate_knee, prompt_tokens,
+                               shed_burn_curve, sweep, synth_trace)
+from ptype_tpu.metrics import MetricsRegistry
+
+_RATE_KW = {
+    "poisson": {"rate_rps": 50.0},
+    "bursty": {"base_rps": 20.0, "burst_rps": 120.0,
+               "mean_on_s": 0.3, "mean_off_s": 0.4},
+    "diurnal": {"trough_rps": 10.0, "peak_rps": 90.0},
+}
+
+
+def _population(trace):
+    return [(a.family, a.prefix_id, a.prompt_len, a.prefix_len,
+             a.max_new) for a in trace.arrivals]
+
+
+# ---------------------------------------------- seeded replay (trace)
+
+
+@pytest.mark.parametrize("process", sorted(_RATE_KW))
+def test_same_seed_same_trace_all_processes(process):
+    """The satellite's replay half: same seed => identical arrival
+    timestamps AND identical request population, for every process."""
+    a = synth_trace(1234, process=process, duration_s=3.0,
+                    **_RATE_KW[process])
+    b = synth_trace(1234, process=process, duration_s=3.0,
+                    **_RATE_KW[process])
+    assert [x.t for x in a.arrivals] == [x.t for x in b.arrivals]
+    assert _population(a) == _population(b)
+    assert len(a.arrivals) > 10, "the trace must carry real traffic"
+    c = synth_trace(1235, process=process, duration_s=3.0,
+                    **_RATE_KW[process])
+    assert [x.t for x in a.arrivals] != [x.t for x in c.arrivals]
+
+
+def test_trace_rng_forks_are_stable_and_independent():
+    r = TraceRng(7)
+    assert r.fork("schedule").random() == \
+        TraceRng(7).fork("schedule").random()
+    assert r.fork("schedule").random() != r.fork("population").random()
+
+
+def test_at_rate_rescales_schedule_population_untouched():
+    """One seeded trace backs every frontier point: ``at_rate``
+    compresses the schedule affinely and leaves the request mix
+    alone, so every rate point measures the same workload."""
+    tr = synth_trace(5, process="poisson", rate_rps=50.0,
+                     duration_s=4.0)
+    fast = tr.at_rate(100.0)
+    assert _population(fast) == _population(tr)
+    assert fast.offered_rps() == pytest.approx(100.0, rel=0.05)
+    k = tr.offered_rps() / 100.0
+    for a, b in zip(tr.arrivals, fast.arrivals):
+        assert b.t == pytest.approx(a.t * k, abs=1e-9)
+
+
+def test_population_mix_heavy_tail_and_shared_prefixes():
+    tr = synth_trace(11, process="poisson", rate_rps=80.0,
+                     duration_s=6.0)
+    fams = [a.family for a in tr.arrivals]
+    assert set(fams) == {"chat", "rag", "agent"}
+    lens = sorted(a.prompt_len for a in tr.arrivals)
+    median = lens[len(lens) // 2]
+    # Heavy-tailed: the longest prompt dwarfs the typical one.
+    assert lens[-1] > 4 * median
+    by_group = {}
+    for a in tr.arrivals:
+        by_group.setdefault(a.affinity_key, []).append(a)
+    twins = next(g for g in by_group.values() if len(g) >= 2)
+    t0, t1 = prompt_tokens(twins[0]), prompt_tokens(twins[1])
+    n = twins[0].prefix_len
+    assert n == twins[1].prefix_len
+    # Identical real token prefix (paged-KV reuse is genuine) ...
+    assert (t0[0, :n] == t1[0, :n]).all()
+    # ... with per-request suffixes (not one request duplicated).
+    assert t0.shape != t1.shape or not (t0 == t1).all()
+    # And replays materialize bit-identical prompts.
+    assert (prompt_tokens(twins[0]) == t0).all()
+
+
+# -------------------------------------------------------- the ledger
+
+
+def _ok(seq, e2e_s, ttft_ms=None, tpot_ms=None, tokens=8):
+    return Outcome(seq, "chat", "ok", t_offered=0.0, t_issued=1.0,
+                   t_done=1.0 + e2e_s, tokens=tokens,
+                   ttft_ms=ttft_ms, tpot_ms=tpot_ms)
+
+
+def test_ledger_goodput_attribution_and_counters():
+    reg = MetricsRegistry()
+    led = TrafficLedger(slo_ttft_ms=100.0, slo_tpot_ms=10.0,
+                        registry=reg, offered_rps=40.0)
+    for out in (
+        _ok(0, 0.050, ttft_ms=40.0, tpot_ms=5.0),   # good
+        _ok(1, 0.500, ttft_ms=80.0, tpot_ms=5.0),   # good: real TTFT
+        _ok(2, 0.050, ttft_ms=40.0, tpot_ms=50.0),  # bad: TPOT
+        _ok(3, 0.200),             # bad: e2e fallback 200ms > 100
+        _ok(4, 0.050),             # good: fallback 50ms <= 100
+        Outcome(5, "rag", "shed", t_offered=0.1),
+        Outcome(6, "rag", "error", t_offered=0.2),
+        Outcome(7, "chat", "dropped", t_offered=0.3),
+        Outcome(8, "chat", "overrun", t_offered=0.4),
+    ):
+        led.offered()
+        led.record(out)
+    led.seal(1.0)
+    s = led.summary()
+    assert (s["offered"], s["answered"], s["good"]) == (9, 5, 3)
+    assert s["shed"] == s["errors"] == s["dropped"] == 1
+    assert s["overruns"] == 1
+    assert s["goodput_pct"] == pytest.approx(100.0 * 3 / 9)
+    assert s["goodput_rps"] == pytest.approx(3.0)
+    # TTFT histogram saw the conservative fallback for seq 3/4.
+    assert reg.counter("loadgen.slo_good").value == 3
+    assert reg.counter("loadgen.slo_bad").value == 6
+    assert reg.gauge("loadgen.offered_rps").value == 40.0
+    assert reg.histogram("loadgen.ttft_ms").count == 5
+
+
+def test_ledger_without_slos_counts_every_answer_good():
+    led = TrafficLedger()
+    led.offered()
+    led.record(_ok(0, 5.0))  # 5000ms e2e, no SLO configured
+    assert led.summary()["goodput_pct"] == 100.0
+
+
+def test_e2e_fallback_never_inflates_goodput():
+    """TTFT <= e2e always, so a target that cannot report TTFT can
+    only be under-counted: an outcome good under the fallback is
+    necessarily good under any real TTFT it could have had."""
+    led = TrafficLedger(slo_ttft_ms=100.0)
+    fallback_good = led.good(_ok(0, 0.08))
+    assert fallback_good
+    # Any real TTFT for the same request is <= its 80ms e2e.
+    assert led.good(_ok(0, 0.08, ttft_ms=79.0))
+
+
+# ------------------------------------------------- open-loop driver
+
+
+class _Fleet:
+    """A capacity-limited synthetic fleet: ``slots`` concurrent
+    requests, fixed service time — queueing is real (semaphore)."""
+
+    def __init__(self, slots, service_s):
+        self.sem = threading.Semaphore(slots)
+        self.service_s = service_s
+
+    def __call__(self, arr):
+        with self.sem:
+            time.sleep(self.service_s)
+        return {"tokens": arr.max_new}
+
+
+def test_open_loop_driver_refuses_at_bound_never_waits():
+    tr = synth_trace(3, process="poisson", rate_rps=100.0,
+                     duration_s=0.5)
+    led = TrafficLedger()
+    t0 = time.monotonic()
+    OpenLoopDriver(tr, _Fleet(2, 0.25), ledger=led,
+                   cfg=DriverConfig(max_inflight=4,
+                                    join_timeout_s=3.0)).run()
+    wall = time.monotonic() - t0
+    s = led.summary()
+    assert s["offered"] == len(tr.arrivals)
+    # The bound was hit and the driver refused rather than waited:
+    # overrun outcomes exist and every arrival is accounted.
+    refused = [o for o in led.outcomes() if o.status == "overrun"]
+    assert refused, "expected bound-refused arrivals at 100rps/2slots"
+    assert (s["answered"] + s["shed"] + s["errors"] + s["dropped"]
+            + len(refused)) == s["offered"]
+    # A waiting (closed-loop) driver would need ~len/2*0.25s ~ 6s+;
+    # the open-loop one finishes in trace time + drain.
+    assert wall < 3.0
+
+
+def test_chaos_issue_seam_drop_delay_and_paired_recovery():
+    tr = synth_trace(9, process="poisson", rate_rps=50.0,
+                     duration_s=0.4)
+    assert len(tr.arrivals) >= 8
+    plan = chaos.arm(FaultPlan([
+        FaultSpec("loadgen.issue", "drop", times=2),
+        FaultSpec("loadgen.issue", "delay", after=2, times=1,
+                  delay_s=0.08),
+    ]))
+    led = TrafficLedger()
+    try:
+        OpenLoopDriver(tr, lambda a: {"tokens": 2}, ledger=led,
+                       cfg=DriverConfig(overrun_tolerance_s=0.02,
+                                        join_timeout_s=3.0)).run()
+        s = led.summary()
+        assert s["dropped"] == 2, "drop faults swallow the arrival"
+        # The delay fault stalls the issue past tolerance: it lands
+        # in loadgen.overrun instead of silently waiting.
+        assert s["overruns"] >= 1
+        assert s["answered"] == s["offered"] - 2
+        assert {e.site for e in plan.fired()} == {"loadgen.issue"}
+        # Answered requests reported note_ok: recovery is paired.
+        assert chaos.unrecovered() == {}, plan.trace()
+    finally:
+        chaos.disarm()
+
+
+def test_driver_records_sheds_and_errors_as_typed_outcomes():
+    def target(arr):
+        if arr.seq % 3 == 0:
+            raise ShedError("admission")
+        if arr.seq % 3 == 1:
+            raise RuntimeError("boom")
+        return {"tokens": 1}
+
+    tr = synth_trace(13, process="poisson", rate_rps=60.0,
+                     duration_s=0.3)
+    s = OpenLoopDriver(tr, target).run().summary()
+    assert s["shed"] > 0 and s["errors"] > 0 and s["answered"] > 0
+    assert s["shed"] + s["errors"] + s["answered"] == s["offered"]
+
+
+def test_gateway_target_adapts_generate():
+    calls = {}
+
+    class _Gw:
+        def generate(self, prompt, max_new, deadline_s=None,
+                     affinity_key=None):
+            calls["prompt"] = prompt
+            calls["affinity_key"] = affinity_key
+            calls["deadline_s"] = deadline_s
+            import numpy as np
+            return np.zeros((1, max_new), dtype=np.int32)
+
+    tr = synth_trace(2, process="poisson", rate_rps=30.0,
+                     duration_s=0.2)
+    arr = tr.arrivals[0]
+    out = gateway_target(_Gw(), deadline_s=2.5)(arr)
+    assert out == {"tokens": arr.max_new}
+    assert calls["affinity_key"] == arr.affinity_key
+    assert calls["deadline_s"] == 2.5
+    assert calls["prompt"].shape == (1, arr.prompt_len)
+
+
+# -------------------------------------------------- capacity frontier
+
+
+def test_locate_knee_picks_highest_qualifying_rate():
+    def pt(rate, pct, rps):
+        return RatePoint(offered_rps=rate, achieved_rps=rate,
+                         goodput_rps=rps, goodput_pct=pct,
+                         ttft_p99_ms=1.0, e2e_p99_ms=1.0,
+                         shed_pct=0.0, overrun_pct=0.0,
+                         offered=100, answered=100)
+
+    pts = [pt(50, 99.0, 49), pt(100, 95.0, 95), pt(200, 91.0, 182),
+           pt(400, 60.0, 240), pt(800, 30.0, 240)]
+    assert locate_knee(pts).offered_rps == 200
+    # All points past saturation: highest absolute goodput stands in.
+    sat = [pt(400, 60.0, 240), pt(800, 30.0, 120)]
+    assert locate_knee(sat).offered_rps == 400
+    assert locate_knee([]) is None
+
+
+def test_sweep_locates_knee_and_publishes_gauge():
+    tr = synth_trace(3, process="poisson", rate_rps=60.0,
+                     duration_s=0.5)
+    reg = MetricsRegistry()
+    # 4 slots x 20ms => ~200 rps capacity; 1000 rps is deep overload.
+    fr = sweep(tr, _Fleet(4, 0.02), [20, 40, 80, 160, 1000],
+               slo_ttft_ms=60.0,
+               cfg=DriverConfig(max_inflight=256, join_timeout_s=5.0),
+               registry=reg)
+    assert [p.offered_rps for p in fr.points] == [20, 40, 80, 160,
+                                                 1000]
+    assert fr.points[0].goodput_pct >= 90.0, fr.as_dict()
+    assert fr.points[-1].goodput_pct < 90.0, fr.as_dict()
+    assert fr.knee_rps is not None and 20 <= fr.knee_rps < 1000
+    assert reg.gauge("loadgen.knee_rps").value == fr.knee_rps
+    d = fr.as_dict()
+    assert d["knee_rps"] == fr.knee_rps and len(d["points"]) == 5
+
+
+def test_shed_burn_curve_prices_budgets():
+    curve = shed_burn_curve({"offered": 1000, "shed": 50},
+                            budgets=(0.01, 0.05))
+    assert curve[0] == {"budget": 0.01, "shed_rate": 0.05,
+                        "burn": 5.0}
+    assert curve[1]["burn"] == 1.0
+
+
+# ------------------------------------- the blind spot (the headline)
+
+
+def test_open_loop_ttft_tail_strictly_exceeds_closed_loop():
+    """The satellite's other half: same under-provisioned fleet, same
+    seeded trace — the closed-loop driver self-throttles to capacity
+    and reports a flattering tail; the open-loop driver keeps issuing
+    on schedule and measures the queueing the users would feel."""
+    fleet = _Fleet(2, 0.02)          # ~100 rps capacity
+    tr = synth_trace(21, process="poisson", rate_rps=250.0,
+                     duration_s=0.4)  # ~2.5x capacity offered
+    open_s = OpenLoopDriver(
+        tr, fleet, ledger=TrafficLedger(slo_ttft_ms=60.0),
+        cfg=DriverConfig(max_inflight=512, join_timeout_s=10.0),
+    ).run().summary()
+    closed_s = ClosedLoopDriver(
+        tr, fleet, concurrency=2,
+        ledger=TrafficLedger(slo_ttft_ms=60.0),
+    ).run().summary()
+    assert open_s["ttft_p99_ms"] > 2 * closed_s["ttft_p99_ms"], (
+        open_s, closed_s)
+    # And the closed-loop run never even offered the overload: its
+    # achieved rate collapsed to fleet capacity — the blind spot.
+    assert closed_s["offered_rps"] < 150.0
+    assert open_s["goodput_pct"] < closed_s["goodput_pct"]
+
+
+# ------------------------------------------ health rule + obs views
+
+
+def _snap(nodes, ts=1000.0):
+    return {"ts": ts, "nodes": nodes, "errors": {}}
+
+
+def _driver_node(offered_pts, knee):
+    series = {"loadgen.offered": offered_pts}
+    if knee is not None:
+        series["loadgen.knee_rps"] = [[999.0, knee]]
+    return {"series": series}
+
+
+def test_capacity_headroom_rule_warns_near_the_knee():
+    rule = CapacityHeadroomRule(window_s=30.0, headroom_frac=0.9,
+                                min_offered=8.0)
+    hot = [[970.0, 0.0], [999.0, 2850.0]]       # ~98 rps sustained
+    alerts = rule.evaluate(ClusterView(_snap(
+        {"drv/a:1": _driver_node(hot, knee=100.0)})))
+    assert len(alerts) == 1 and alerts[0].node == "drv/a:1"
+    assert alerts[0].severity == "warn"
+    assert "capacity knee" in alerts[0].message
+    # Comfortable headroom: ~50 rps against a 100 rps knee.
+    cool = [[970.0, 0.0], [999.0, 1450.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"drv/a:1": _driver_node(cool, knee=100.0)}))) == []
+    # No measured frontier => structurally silent, however hot.
+    assert rule.evaluate(ClusterView(_snap(
+        {"drv/a:1": _driver_node(hot, knee=None)}))) == []
+    # A handful of requests is not "sustained".
+    few = [[970.0, 0.0], [999.0, 4.0]]
+    assert rule.evaluate(ClusterView(_snap(
+        {"drv/a:1": _driver_node(few, knee=1.0)}))) == []
+
+
+def test_capacity_headroom_rule_is_in_default_rules():
+    from ptype_tpu.health import default_rules
+    assert any(r.name == "capacity-headroom" for r in default_rules())
+
+
+def test_render_traffic_rows_and_empty_state():
+    node = {
+        "metrics": {
+            "counters": {"loadgen.offered": 120.0,
+                         "loadgen.slo_good": 90.0,
+                         "loadgen.slo_bad": 30.0,
+                         "loadgen.shed": 5.0,
+                         "loadgen.overrun": 2.0,
+                         "loadgen.dropped": 1.0},
+            "gauges": {"loadgen.offered_rps": 80.0,
+                       "loadgen.inflight": 3.0,
+                       "loadgen.knee_rps": 100.0},
+            "histograms": {"loadgen.ttft_ms": {"p99": 42.0}},
+        },
+        "series": {"loadgen.offered.rate": [[999.0, 80.0]],
+                   "loadgen.answered.rate": [[999.0, 75.0]]},
+    }
+    quiet = {"metrics": {"counters": {"train.steps": 5.0}}}
+    view = render_traffic(_snap({"drv/a:1": node, "w/b:2": quiet}))
+    assert "1 load drivers" in view and "drv/a:1" in view
+    assert "w/b:2" not in view, "non-driver nodes stay off the table"
+    assert "75.0" in view            # goodput% = 90/120 and ach rate
+    assert "100" in view             # the knee column
+    empty = render_traffic(_snap({}))
+    assert "no open-loop driver" in empty
+
+
+def test_render_serve_gateway_goodput_section():
+    node = {"metrics": {"counters": {
+        "gateway.llm.requests": 100.0,
+        "gateway.llm.answered": 88.0,
+        "gateway.llm.shed": 12.0,
+        "gateway.llm.slo_good_requests": 80.0,
+        "gateway.llm.slo_violations": 20.0}}}
+    view = render_serve(_snap({"gw/a:1": node}))
+    assert "good%" in view and "gw/a:1" in view
+    assert "80" in view and "20" in view
+
+
+# -------------------------------------------- gateway SLO goodput
+
+
+def test_slo_tracker_goodput_counters():
+    reg = MetricsRegistry()
+    t = SLOTracker("svc", registry=reg, slo_ttft_p99_ms=100.0,
+                   slo_tpot_p99_ms=10.0)
+    t.answered(50.0)                         # good: latency fallback
+    t.answered(500.0)                        # bad: fallback over SLO
+    t.answered(500.0, ttft_ms=80.0)          # good: real TTFT
+    t.answered(50.0, ttft_ms=80.0, tpot_ms=20.0)   # bad: TPOT
+    t.shed()                                 # violation
+    t.errored()                              # violation
+    g = t.goodput()
+    assert g["slo_good_requests"] == 2
+    assert g["slo_violations"] == 4
+    assert g["goodput_pct"] == pytest.approx(100.0 * 2 / 6)
+    assert reg.counter("gateway.svc.slo_good_requests").value == 2
+    p = t.percentiles()
+    assert p["slo_good_requests"] == 2 and "goodput_pct" in p
+
+
+def test_slo_tracker_without_slos_everything_answered_is_good():
+    t = SLOTracker("svc", registry=MetricsRegistry())
+    t.answered(5000.0)
+    assert t.goodput()["goodput_pct"] == 100.0

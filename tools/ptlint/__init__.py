@@ -17,7 +17,9 @@ into a package with a shared scope/dataflow core and a rule registry:
                      E711/F541/F401/F821)
 - ``rules_domain`` — PT001–PT012 (migrated from tools/lint.py with
                      behavior pinned by a golden-output test) plus
-                     PT021 KV-wire-serialization single-home
+                     PT021 KV-wire-serialization single-home and
+                     PT022–PT024 (ZeRO-3 residency, axis-name, and
+                     loadgen seeded-RNG single-home)
 - ``rules_concurrency`` — PT013 lock-discipline, PT014
                      blocking-under-lock, PT015 thread-hygiene
 - ``rules_jax``  — PT016 donation-safety, PT017 RNG-key-reuse

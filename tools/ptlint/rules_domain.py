@@ -1,4 +1,4 @@
-"""PT001–PT012 (plus PT021–PT023): the house rules.
+"""PT001–PT012 (plus PT021–PT024): the house rules.
 
 PT001–PT012 were migrated from tools/lint.py; each rule guards one
 architectural seam this repo earned the hard way (the full rationale
@@ -10,7 +10,9 @@ the same single-home family as PT008/PT011; PT022 (full-tree param
 allgather in ``train/``, ISSUE 17) extends that family to the ZeRO-3
 residency contract; PT023 (hard-coded flat ``"data"`` axis names
 outside ``parallel/``, ISSUE 18) extends it to the topology plane's
-axis-name discipline.
+axis-name discipline; PT024 (raw ``random.*``/``np.random.*`` draws
+in ``loadgen/`` outside the seeded RNG home, ISSUE 19) extends it to
+the traffic plane's replay discipline.
 """
 
 from __future__ import annotations
@@ -716,4 +718,99 @@ class _FlatAxisLiteralCheck(ast.NodeVisitor):
 def check_pt023(ctx: FileContext) -> list[Finding]:
     findings: list[Finding] = []
     _FlatAxisLiteralCheck(ctx, findings).visit(ctx.tree)
+    return findings
+
+
+# ------------------------------------------------------------------ PT024
+
+
+class _RawTrafficRandomCheck(ast.NodeVisitor):
+    """Raw ``random.*`` / ``np.random.*`` draws inside ``loadgen/``.
+
+    A traffic trace is replay evidence — the capacity frontier, the
+    spike drill, and any chaos-soak composition cite its seed — so
+    determinism has ONE home: :mod:`ptype_tpu.loadgen.rng`
+    (:class:`TraceRng`, forked streams, SHA-derived child seeds). A
+    stray ``random.random()`` or ``np.random.poisson()`` anywhere
+    else in the package silently breaks same-seed replay (module
+    state shared across traces, process-salted hashing, draw-order
+    coupling between schedule and population). Tracks plain imports,
+    aliases (``import numpy.random as npr``), and ``from random
+    import ...`` of draw functions.
+    """
+
+    #: from-imported stdlib draw verbs worth tracking by bare name.
+    _VERBS = frozenset({
+        "random", "randint", "randrange", "uniform", "choice",
+        "choices", "shuffle", "sample", "expovariate", "gauss",
+        "lognormvariate", "normalvariate", "paretovariate",
+        "betavariate", "gammavariate", "triangular", "vonmisesvariate",
+        "weibullvariate", "getrandbits",
+    })
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        #: names bound to the random / numpy.random modules
+        self.rand_mods: set[str] = set()
+        #: names bound to numpy itself (np.random.* chains)
+        self.np_mods: set[str] = set()
+        #: bare names from-imported from the random module
+        self.funcs: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            bound = a.asname or a.name.split(".")[0]
+            if a.name == "random":
+                self.rand_mods.add(bound)
+            elif a.name in ("numpy", "numpy.random") and a.asname:
+                (self.rand_mods if a.name == "numpy.random"
+                 else self.np_mods).add(a.asname)
+            elif a.name == "numpy":
+                self.np_mods.add("numpy")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "random":
+            for a in node.names:
+                if a.name in self._VERBS or a.name == "Random":
+                    self.funcs.add(a.asname or a.name)
+        elif node.module == "numpy":
+            for a in node.names:
+                if a.name == "random":
+                    self.rand_mods.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def _flag(self, node, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT024",
+            f"raw {what} inside loadgen/ — every traffic draw must "
+            f"flow through the seeded RNG home "
+            f"(loadgen/rng.py TraceRng) or same-seed replay breaks"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if (isinstance(base, ast.Name)
+                    and base.id in self.rand_mods):
+                self._flag(node, f"{base.id}.{f.attr}() draw")
+            elif (isinstance(base, ast.Attribute)
+                  and base.attr == "random"
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id in self.np_mods):
+                self._flag(
+                    node, f"{base.value.id}.random.{f.attr}() draw")
+        elif isinstance(f, ast.Name) and f.id in self.funcs:
+            self._flag(node, f"{f.id}() draw (from random import)")
+        self.generic_visit(node)
+
+
+@rule("PT024", "raw random draw in loadgen/ outside the seeded RNG "
+      "home",
+      applies=lambda ctx: (ctx.in_pkg and ctx.in_dir("loadgen")
+                           and ctx.basename != "rng.py"))
+def check_pt024(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    _RawTrafficRandomCheck(ctx, findings).visit(ctx.tree)
     return findings
