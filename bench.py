@@ -976,6 +976,56 @@ def jitwatch_main() -> None:
     })
 
 
+def forensics_main() -> None:
+    """``bench.py --forensics``: the ISSUE 20 tail-forensics numbers —
+    the marginal cost of the always-on exemplar slots on a histogram
+    observe, and the full armed per-request seam (``answered`` with a
+    five-stage split, trace id racing the exemplar reservoirs) priced
+    against a 20 ms reference request (the traffic bench's fake
+    replica), <=1% bar. Tight loops over the real calls, never a
+    wall-clock A/B — the signal is microseconds against a
+    multi-millisecond request."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ptype_tpu import metrics as metrics_mod
+    from ptype_tpu.gateway.slo import SLOTracker
+    from ptype_tpu.health.forensics import measure_forensics_overhead
+
+    probe = measure_forensics_overhead()
+    _emit({"probe": "forensics_exemplar", **probe})
+    reg = metrics_mod.MetricsRegistry()
+    slo = SLOTracker("llm", registry=reg, slo_ttft_p99_ms=10_000.0)
+    stages = {"queue-wait": 1.0, "route": 0.2, "prefill": 12.0,
+              "migrate": 3.0, "decode": 8.0}
+    iters = 5000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        slo.answered(25.0, tokens=8, ttft_ms=20.0, tpot_ms=1.0,
+                     stages=stages, trace_id="bench-forensics-trace")
+    per_req_us = (time.perf_counter() - t0) / iters * 1e6
+    ref_request_ms = 20.0
+    pct = per_req_us / (ref_request_ms * 1e3) * 100.0
+    _emit({
+        "metric": "tail forensics: armed per-request seam cost",
+        "value": round(pct, 4),
+        "unit": f"% of a {ref_request_ms:.0f}ms request",
+        "forensics_request_seam_us": round(per_req_us, 2),
+        "forensics_exemplar_marginal_us": round(
+            probe["exemplar_marginal_us"], 3),
+        "forensics_observe_plain_us": round(
+            probe["observe_plain_us"], 3),
+        "forensics_observe_armed_us": round(
+            probe["observe_armed_us"], 3),
+        "forensics_overhead_pct": round(pct, 4),
+        "within_1pct_bar": pct < 1.0,
+        "notes": {
+            "forensics_request_seam_us":
+                "one answered() with latency + 5 stage histograms, "
+                "exemplar reservoirs armed and full (steady-state "
+                "replace-min), worst-TTFT/TPOT fold included",
+        },
+    })
+
+
 # ------------------------------------------------------------ serve bench
 
 
@@ -2055,6 +2105,10 @@ def traffic_main() -> None:
             round(knee.ttft_p99_ms, 1)
             if knee and knee.ttft_p99_ms is not None else None),
         "traffic_frontier": [p.as_dict() for p in fr.points],
+        "traffic_knee_culprit_stage": (knee.culprit_stage
+                                       if knee else None),
+        "traffic_slo_bad_stages_at_knee": (
+            dict(knee.slo_bad_stages) if knee else None),
         "traffic_seed": SEED,
         "traffic_spike_slo_ttft_ms": SPIKE_SLO_TTFT_MS,
         "traffic_spike_static_ttft_p99_ms": (
@@ -2079,6 +2133,11 @@ def traffic_main() -> None:
                 "ledger-measured open-loop TTFT p99 AT the knee "
                 "(e2e stands in for TTFT on the non-streaming "
                 "fake-replica path — a conservative upper bound)",
+            "traffic_knee_culprit_stage":
+                "WHY the knee is where it is: every SLO-bad request "
+                "at the knee blamed on the stage with the largest "
+                "budget overage (gateway stage split priced against "
+                "the TTFT stage budgets); the mode of those blames",
             "spike_drill":
                 "same seeded diurnal trace; static fleet (1 replica) "
                 "vs reconciler-armed fleet (1..4) — elastic must "
@@ -2120,6 +2179,9 @@ def main() -> None:
         return
     if "--traffic" in sys.argv:
         traffic_main()
+        return
+    if "--forensics" in sys.argv:
+        forensics_main()
         return
 
     t_start = time.time()

@@ -80,6 +80,23 @@ operations need. Commands:
                full device timeline). ``obs profile summarize``
                re-parses an existing artifact tree ($PROFILE_DIR or
                $OBS_DIR/profile) without touching the cluster.
+- ``obs request <trace_id>`` — tail forensics (ISSUE 20): render one
+               request's stage-attributed waterfall (queue-wait /
+               route / prefill / migrate / decode-queue / decode …)
+               from its stitched cross-process spans. Post-mortem
+               first: reads $TRACE_FILE, else $OBS_DIR/spans.jsonl,
+               else the newest $PTYPE_TRACE_DUMP_DIR flight dump,
+               and only dials the cluster when no file exists.
+               Trace-id prefixes match (paste the short id from
+               ``obs tail``).
+- ``obs tail`` — the fleet's worst tail: per-histogram worst
+               exemplars (value + trace id, the input to ``obs
+               request``) and the gateway stage-time p99 breakdown
+               ($TAIL_LIMIT bounds rows, default 8).
+               docs/OBSERVABILITY.md "Tail forensics".
+- ``obs export`` — OpenMetrics text dump of every node's metric
+               families (counters/gauges/timings/histograms, p99
+               exemplars inline) for standard scrape tooling.
 """
 
 from __future__ import annotations
@@ -370,6 +387,45 @@ def _obs_profile(registry) -> None:
           f"TensorBoard's profile plugin / xprof)")
 
 
+def _obs_request_offline(trace_id: str) -> bool:
+    """Render a request waterfall from span files on disk — returns
+    False when no file source exists (caller falls through to the
+    live cluster pull). Sources, in order: $TRACE_FILE (a spans.jsonl
+    or flight-recorder dump), $OBS_DIR/spans.jsonl (what a plain
+    ``obs`` run writes), the newest flight dump under
+    $PTYPE_TRACE_DUMP_DIR (what an SLO violation wrote)."""
+    import os
+
+    from ptype_tpu.health import forensics
+
+    path = os.environ.get("TRACE_FILE")
+    if not path:
+        cand = os.path.join(os.environ.get("OBS_DIR", "."),
+                            "spans.jsonl")
+        if os.path.isfile(cand):
+            path = cand
+    if not path:
+        dump_dir = os.environ.get("PTYPE_TRACE_DUMP_DIR")
+        if dump_dir:
+            path = forensics.latest_dump(dump_dir)
+    if not path or not os.path.isfile(path):
+        return False
+    traces = forensics.load_dump_traces(path)
+    try:
+        wf = forensics.waterfall_from_snapshot({"traces": traces},
+                                               trace_id)
+    except KeyError:
+        # The dump predates (or never saw) this trace — fall through
+        # to the live cluster pull rather than dead-ending offline.
+        print(f"(trace {trace_id!r} not in {path}; "
+              f"{len(traces)} traces there — trying the cluster)",
+              file=sys.stderr)
+        return False
+    print(forensics.render_waterfall(wf))
+    print(f"(source: {path})")
+    return True
+
+
 def _obs() -> None:
     import os
 
@@ -387,6 +443,14 @@ def _obs() -> None:
             "PROFILE_DIR",
             os.path.join(os.environ.get("OBS_DIR", "."), "profile")))
         return
+    if len(sys.argv) > 3 and sys.argv[2] == "request":
+        # Waterfall forensics. Same post-mortem rule as profile
+        # summarize: when a span file exists ($TRACE_FILE, or the
+        # spans.jsonl / flight dump a previous obs run or SLO
+        # violation wrote), render from it without dialing — the tail
+        # request's trace must be readable after the cluster is gone.
+        if _obs_request_offline(sys.argv[3]):
+            return
     cfg = config_from_env()
     coord = RemoteCoord([cfg.platform.coordinator_address])
     try:
@@ -459,6 +523,36 @@ def _obs() -> None:
                             os.environ.get("TOP_INTERVAL", "2")))
             except KeyboardInterrupt:
                 pass
+            return
+        if len(sys.argv) > 3 and sys.argv[2] == "request":
+            from ptype_tpu.health import forensics
+
+            snap = tel.cluster_snapshot(CoordRegistry(coord),
+                                        include_local=False)
+            try:
+                wf = forensics.waterfall_from_snapshot(snap, sys.argv[3])
+            except KeyError as e:
+                # The flight ring is bounded; old request traces get
+                # evicted by probe churn. Point the operator at dumps.
+                print(f"obs request: {e.args[0]}", file=sys.stderr)
+                print("  (flight rings are bounded; an evicted trace "
+                      "may survive in $PTYPE_TRACE_DUMP_DIR flight "
+                      "dumps or $OBS_DIR/spans.jsonl)", file=sys.stderr)
+                sys.exit(1)
+            print(forensics.render_waterfall(wf))
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "tail":
+            from ptype_tpu.health import forensics
+
+            snap = tel.cluster_snapshot(CoordRegistry(coord),
+                                        include_local=False)
+            print(forensics.render_tail(
+                snap, limit=int(os.environ.get("TAIL_LIMIT", "8"))))
+            return
+        if len(sys.argv) > 2 and sys.argv[2] == "export":
+            snap = tel.cluster_snapshot(CoordRegistry(coord),
+                                        include_local=False)
+            sys.stdout.write(tel.openmetrics(snap))
             return
         snap = tel.cluster_snapshot(CoordRegistry(coord),
                                     include_local=False)

@@ -44,7 +44,7 @@ from ptype_tpu.errors import (NoClientAvailableError, RemoteError, RPCError,
 from ptype_tpu.gateway.admission import AdmissionQueue
 from ptype_tpu.gateway.directory import PrefixDirectory
 from ptype_tpu.gateway.pool import ReplicaPool
-from ptype_tpu.gateway.slo import ScaleHint, SLOTracker
+from ptype_tpu.gateway.slo import ScaleHint, SLOTracker, Stopwatch
 from ptype_tpu.registry import Registry
 
 log = logs.get_logger("gateway")
@@ -246,6 +246,7 @@ class InferenceGateway:
         with trace.span("gateway.request", service=self.service,
                         method=method):
             self.slo.arrived()
+            qsw = Stopwatch()
             try:
                 with trace.span("gateway.admit"):
                     self.admission.admit(key=affinity_key or method,
@@ -255,27 +256,33 @@ class InferenceGateway:
                 self._export_gauges()
                 trace.maybe_dump(f"shed at admission ({self.service})")
                 raise
+            queue_ms = qsw.ms()
             try:
                 return self._dispatch(method, args, deadline,
-                                      affinity_key, count_tokens)
+                                      affinity_key, count_tokens,
+                                      queue_ms=queue_ms)
             finally:
                 self.admission.release()
                 self._export_gauges()
 
     def _dispatch(self, method: str, args, deadline: float,
-                  affinity_key: str | None, count_tokens=None):
+                  affinity_key: str | None, count_tokens=None,
+                  queue_ms: float = 0.0):
         last_err: Exception | None = None
         reroutes = 0
         tried: set[str] = set()
+        route_ms = 0.0
         bo = retry.Backoff(base=0.05, cap=0.5)
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
+            rsw = Stopwatch()
             with trace.span("gateway.route") as rsp:
                 r = self.pool.pick(affinity_key, exclude=tried,
                                    prefer_domain=self.cfg.domain)
                 rsp.set_attr("replica", r.key if r is not None else None)
+            route_ms += rsw.ms()
             if r is None:
                 # Fleet momentarily empty (mass eviction / churn):
                 # wait a beat for probes to revive someone — the
@@ -288,7 +295,7 @@ class InferenceGateway:
             if conn is None or not conn.healthy:
                 continue
             self.pool.begin(r)
-            t0 = time.perf_counter()
+            rpc_sw = Stopwatch()
             fut = None
             # The dispatch span: the traceparent injected by
             # call_async is this span, so the replica's handler span
@@ -325,8 +332,7 @@ class InferenceGateway:
                 # The replica RAN the handler and it raised: an
                 # application error, not a routing problem. The replica
                 # is healthy (it answered) — account and propagate.
-                ms = (time.perf_counter() - t0) * 1000.0
-                self.pool.done(r, ms, ok=True)
+                self.pool.done(r, rpc_sw.ms(), ok=True)
                 self.slo.errored()
                 raise e
             except FuturesTimeoutError:
@@ -345,7 +351,7 @@ class InferenceGateway:
                 if reroutes > self.cfg.max_reroutes:
                     break
                 continue
-            ms = (time.perf_counter() - t0) * 1000.0
+            ms = rpc_sw.ms()
             self.pool.done(r, ms, ok=True)
             # Real generated-token count (not B × max_new with the
             # pad tail charged as throughput): Generate supplies a
@@ -359,7 +365,12 @@ class InferenceGateway:
                     tokens = int(result.shape[0]) * int(result.shape[1])
             except (AttributeError, IndexError, TypeError, ValueError):
                 pass
-            self.slo.answered(ms, tokens)
+            # Stage split (ISSUE 20): the interleaved path cannot see
+            # inside the replica, so the whole service leg is one
+            # "rpc" stage; queue-wait and route are the gateway's own.
+            self.slo.answered(ms, tokens,
+                              stages={"queue-wait": queue_ms,
+                                      "route": route_ms, "rpc": ms})
             chaos.note_ok("gateway.call", r.key)
             # The dispatch rode the rpc transport: its success also
             # pairs rpc-class faults (the gateway bypasses Client's
@@ -423,7 +434,7 @@ class InferenceGateway:
                 f"out of deadline before {method!r} on {r.key}",
                 retry_after_s=self.slo.est_service_s())
         self.pool.begin(r)
-        t0 = time.perf_counter()
+        sw = Stopwatch()
         fut = None
         try:
             with trace.span("rpc.call", method=method, replica=r.key):
@@ -433,8 +444,7 @@ class InferenceGateway:
             self.pool.done(r, None, ok=True)
             raise
         except RemoteError:
-            self.pool.done(r, (time.perf_counter() - t0) * 1000.0,
-                           ok=True)
+            self.pool.done(r, sw.ms(), ok=True)
             raise
         except FuturesTimeoutError:
             conn.forget(fut)
@@ -446,7 +456,7 @@ class InferenceGateway:
                 conn.forget(fut)
             self.pool.fail(r, str(e))
             raise
-        self.pool.done(r, (time.perf_counter() - t0) * 1000.0, ok=True)
+        self.pool.done(r, sw.ms(), ok=True)
         chaos.note_ok("rpc.call", method)
         return result
 
@@ -466,8 +476,9 @@ class InferenceGateway:
                                        if deadline_s is not None
                                        else self.cfg.default_deadline_s)
         with trace.span("gateway.request", service=self.service,
-                        method="disagg"):
+                        method="disagg") as rq:
             self.slo.arrived()
+            qsw = Stopwatch()
             try:
                 with trace.span("gateway.admit"):
                     self.admission.admit(
@@ -478,28 +489,43 @@ class InferenceGateway:
                 self._export_gauges()
                 trace.maybe_dump(f"shed at admission ({self.service})")
                 raise
+            queue_ms = qsw.ms()
             try:
                 return self._dispatch_disagg(prompt, int(max_new),
                                              gen, deadline,
-                                             affinity_key)
+                                             affinity_key, rq,
+                                             queue_ms)
             finally:
                 self.admission.release()
                 self._export_gauges()
 
     def _dispatch_disagg(self, prompt, max_new, gen, deadline,
-                         affinity_key):
-        t0 = time.perf_counter()
+                         affinity_key, rq, queue_ms=0.0):
+        req_sw = Stopwatch()
+        stages = {"queue-wait": queue_ms}
         stop_token = int(gen["stop_token"])
         counter = lambda out: _count_generated(out, stop_token)  # noqa: E731
         gen_args = (prompt, max_new, gen["temperature"], gen["seed"],
                     gen["top_k"], gen["top_p"], gen["stop_token"])
         mig_args = gen_args
         # ---- stage 1: prefill-class pick + Prefill
-        pre = self.pool.pick(affinity_key, serve_class="prefill",
-                             prefer_domain=self.cfg.domain)
+        rsw = Stopwatch()
+        with trace.span("gateway.route", serve_class="prefill") as rsp:
+            pre = self.pool.pick(affinity_key, serve_class="prefill",
+                                 prefer_domain=self.cfg.domain)
+            rsp.set_attr("replica", pre.key if pre is not None else None)
+        stages["route"] = rsw.ms()
         if pre is None or pre.conn is None or not pre.conn.healthy:
             return self._dispatch(self.cfg.generate_method, gen_args,
-                                  deadline, affinity_key, counter)
+                                  deadline, affinity_key, counter,
+                                  queue_ms=queue_ms)
+        # The request span names its replica pair and their topology
+        # domains (ISSUE 20 satellite): before this, only the locality
+        # counters recorded the split, so a stitched trace could not
+        # show which domain pair served a slow request.
+        rq.set_attr("prefill_replica", pre.key)
+        rq.set_attr("prefill_domain", pre.domain())
+        psw = Stopwatch()
         try:
             with trace.span("gateway.prefill", replica=pre.key):
                 rep = self._rcall(pre, self._mig_method("Prefill"),
@@ -514,10 +540,12 @@ class InferenceGateway:
             log.info("disagg prefill failed; interleaved fallback",
                      kv={"replica": pre.key, "err": repr(e)[:200]})
             return self._dispatch(self.cfg.generate_method, gen_args,
-                                  deadline, affinity_key, counter)
+                                  deadline, affinity_key, counter,
+                                  queue_ms=queue_ms)
+        stages["prefill"] = psw.ms()
         # Prefill returned the first token: the disagg path knows its
         # real per-request TTFT (goodput attribution, ISSUE 19).
-        ttft_ms = (time.perf_counter() - t0) * 1000.0
+        ttft_ms = req_sw.ms()
         export_id = rep["export_id"]
         first = int(rep["first_token"])
         bt = int(rep["block_tokens"])
@@ -531,17 +559,23 @@ class InferenceGateway:
             self.directory.publish(pre.key, zip(hashes, contents))
             out = np.zeros((1, max_new), np.int32)
             out[0, 0] = first
-            self.slo.answered((time.perf_counter() - t0) * 1000.0,
-                              counter(out), ttft_ms=ttft_ms)
+            self.slo.answered(req_sw.ms(), counter(out),
+                              ttft_ms=ttft_ms, stages=stages)
             return out
         # ---- stage 2: decode-class pick, steered by the directory
-        dec = self._pick_decode(pre, hashes, contents)
+        rsw = Stopwatch()
+        with trace.span("gateway.route", serve_class="decode") as rsp:
+            dec = self._pick_decode(pre, hashes, contents)
+            rsp.set_attr("replica", dec.key if dec is not None else None)
+        stages["route"] += rsw.ms()
         if dec is None:
             # One-replica fleet (or nothing else healthy): nowhere to
             # migrate — finish where the blocks already live.
             self._release_export(pre, export_id)
             return self._disagg_fallback(pre, gen_args, deadline,
-                                         counter, t0)
+                                         counter, req_sw)
+        rq.set_attr("decode_replica", dec.key)
+        rq.set_attr("decode_domain", dec.domain())
         # Locality ledger (ISSUE 18): every migration attempt counts
         # as intra- or cross-domain — the ``obs topo`` view and the
         # gateway drill's pressure assertion read these. Only when
@@ -554,23 +588,27 @@ class InferenceGateway:
                 else "serve.migrate.cross_domain").add(1)
         ticket = None
         truncate = False
+        msw = Stopwatch()  # migrate stage (and its trace span) open
+        #                    BEFORE the chaos seam: an injected wire
+        #                    delay is exactly what stage attribution —
+        #                    histogram and waterfall alike — must catch.
         try:
-            # The migration chaos seam: drop kills the transfer
-            # outright, delay stalls it mid-flight, truncate ships a
-            # wire missing blocks (the decode side detects and
-            # refuses it) — every action lands on the fallback path:
-            # local prefill on the decode replica, correct tokens,
-            # never lost.
-            f = chaos.hit("serve.migrate", dec.key)
-            if f is not None:
-                if f.action == "drop":
-                    raise RPCError("chaos: serve.migrate drop")
-                if f.action == "delay":
-                    f.sleep()
-                elif f.action == "truncate":
-                    truncate = True
             with trace.span("gateway.migrate", prefill=pre.key,
                             decode=dec.key) as msp:
+                # The migration chaos seam: drop kills the transfer
+                # outright, delay stalls it mid-flight, truncate
+                # ships a wire missing blocks (the decode side
+                # detects and refuses it) — every action lands on the
+                # fallback path: local prefill on the decode replica,
+                # correct tokens, never lost.
+                f = chaos.hit("serve.migrate", dec.key)
+                if f is not None:
+                    if f.action == "drop":
+                        raise RPCError("chaos: serve.migrate drop")
+                    if f.action == "delay":
+                        f.sleep()
+                    elif f.action == "truncate":
+                        truncate = True
                 plan = self._rcall(dec,
                                    self._mig_method("MigratePlan"),
                                    mig_args, deadline)
@@ -588,11 +626,14 @@ class InferenceGateway:
                 msp.set_attr("blocks", len(wire.get("blocks", ())))
                 msp.set_attr("bytes", int(imp.get("nbytes", 0)))
                 msp.set_attr("resident", int(plan.get("resident", 0)))
+            stages["migrate"] = msw.ms()
             self._release_export(pre, export_id)
             export_id = None
+            dsw = Stopwatch()
             tokens = self._rcall(dec,
                                  self._mig_method("MigrateDecode"),
                                  (ticket, first), deadline)
+            stages["decode"] = dsw.ms()
             ticket = None
         except ShedError:
             # The decode replica refused the plan typed (KV pool
@@ -621,7 +662,7 @@ class InferenceGateway:
             if export_id is not None:
                 self._release_export(pre, export_id)
             out = self._disagg_fallback(dec, gen_args, deadline,
-                                        counter, t0)
+                                        counter, req_sw)
             # The decode replica prefilled locally: it now holds the
             # prompt's sealed blocks — publish them, and pair the
             # injected fault (the request completed; the seam
@@ -634,11 +675,12 @@ class InferenceGateway:
         emitted = [int(t) for t in tokens][:max_new]
         out[0, :len(emitted)] = emitted
         self.directory.publish(dec.key, zip(hashes, contents))
-        e2e_ms = (time.perf_counter() - t0) * 1000.0
+        e2e_ms = req_sw.ms()
         n_out = counter(out)
         self.slo.answered(e2e_ms, n_out, ttft_ms=ttft_ms,
                           tpot_ms=((e2e_ms - ttft_ms) / (n_out - 1)
-                                   if n_out > 1 else None))
+                                   if n_out > 1 else None),
+                          stages=stages)
         chaos.note_ok("serve.migrate", dec.key)
         chaos.note_ok("gateway.call", dec.key)
         return out
@@ -678,7 +720,8 @@ class InferenceGateway:
                 best, best_ov = r, ov
         return best
 
-    def _disagg_fallback(self, dec, gen_args, deadline, counter, t0):
+    def _disagg_fallback(self, dec, gen_args, deadline, counter,
+                         req_sw):
         """Local prefill on the decode replica — the migration
         failure path. The replica re-prefills from the prompt (its
         prefix cache may still shortcut it) and owns the decode; only
@@ -689,9 +732,7 @@ class InferenceGateway:
             try:
                 out = self._rcall(dec, self.cfg.generate_method,
                                   gen_args, deadline)
-                self.slo.answered(
-                    (time.perf_counter() - t0) * 1000.0,
-                    counter(out))
+                self.slo.answered(req_sw.ms(), counter(out))
                 return out
             except Exception as e:  # noqa: BLE001 — fall through to
                 # the re-routed dispatch, which sheds typed if no one
@@ -834,6 +875,7 @@ class InferenceGateway:
             "tokens_per_sec": round(self.slo.tokens_per_sec(), 1),
             "shed_rate": round(self.slo.shed_rate(), 4),
             "scale_hint": {"delta": hint.delta, "reason": hint.reason},
+            "tail": self.slo.worst(),
             "pool": self.pool.status(),
         }
 

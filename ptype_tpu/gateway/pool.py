@@ -48,6 +48,7 @@ import time
 from ptype_tpu import lockcheck
 
 from ptype_tpu import chaos, logs, retry, rpc as rpc_mod
+from ptype_tpu.gateway.slo import Stopwatch
 from ptype_tpu.registry import Node, Registry
 
 log = logs.get_logger("gateway.pool")
@@ -339,7 +340,7 @@ class ReplicaPool:
         if conn is None:
             self._probe_failed(r, "dial failed")
             return
-        t0 = time.perf_counter()
+        sw = Stopwatch()
         fut = None
         try:
             fut = conn.call_async(self.info_method, ())
@@ -349,7 +350,7 @@ class ReplicaPool:
                 conn.forget(fut)
             self._probe_failed(r, str(e))
             return
-        ms = (time.perf_counter() - t0) * 1000.0
+        ms = sw.ms()
         was_down = not r.up
         fresh: list[float] = []
         with r.lock:

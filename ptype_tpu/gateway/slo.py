@@ -31,6 +31,14 @@ Metric names (under the process-global registry by default):
 ``gateway.<svc>.slo_violations``        everything else that arrived:
                                         sheds, errors, and answers
                                         over SLO (counter)
+``gateway.<svc>.stage_ms.<stage>``      per-request time in one named
+                                        pipeline stage (histogram; the
+                                        ``slo-stage-breach`` rule reads
+                                        the sampled ``.p99`` series)
+``gateway.<svc>.exemplar_dumps``        SLO-violating requests that
+                                        landed a full flight-recorder
+                                        dump (counter; rate-limited by
+                                        ``trace.maybe_dump``)
 ======================================  ================================
 
 Goodput is first-class (ISSUE 19): the good/violation pair moves per
@@ -47,12 +55,20 @@ the counters stay meaningful as plain answered/failed accounting.
 
 from __future__ import annotations
 
+import threading
 import time
 
 from ptype_tpu import lockcheck
 from dataclasses import dataclass, field
 
 from ptype_tpu import metrics as metrics_mod
+from ptype_tpu import trace as trace_mod
+
+#: Worst-value slots kept per reservoir metric (TTFT / TPOT): the
+#: bounded tail-exemplar memory ``obs tail`` and ``Gateway.Info``
+#: surface. Small on purpose — links to replayable traces, not a
+#: second histogram.
+WORST_SLOTS = 8
 
 
 @dataclass
@@ -63,6 +79,29 @@ class ScaleHint:
     delta: int
     reason: str
     signals: dict = field(default_factory=dict)
+
+
+class Stopwatch:
+    """The gateway's ONE latency clock. Raw ``time.perf_counter()``
+    pairs beside the dispatch code rotted into three slightly
+    different stamps before ISSUE 20; PT025 now forbids ad-hoc
+    perf_counter latency measurement in ``gateway/`` and
+    ``serve_engine/`` — attribution has one home (this class and the
+    serving ledger), so every latency the SLO tracker, the stage
+    histograms, and the traffic ledger see is the same clock."""
+
+    __slots__ = ("_t0",)
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    def ms(self) -> float:
+        """Elapsed wall milliseconds since construction."""
+        return (time.perf_counter() - self._t0) * 1000.0
+
+    def s(self) -> float:
+        """Elapsed wall seconds since construction."""
+        return time.perf_counter() - self._t0
 
 
 class SLOTracker:
@@ -97,12 +136,23 @@ class SLOTracker:
         self.g_hint = reg.gauge(f"{p}.scale_hint")
         self.c_good = reg.counter(f"{p}.slo_good_requests")
         self.c_violations = reg.counter(f"{p}.slo_violations")
+        self.c_exemplar_dumps = reg.counter(f"{p}.exemplar_dumps")
         self._lock = lockcheck.lock("gateway.slo")
         #: (t, latency_ms, tokens) for answered requests in the window.
         self._ok: list[tuple[float, float, int]] = []
         #: (t,) stamps for sheds in the window.
         self._sheds: list[float] = []
         self._ewma_ms = 0.0
+        #: Per-stage latency histograms, lazily created on first
+        #: :meth:`stage` call (``gateway.<svc>.stage_ms.<stage>``).
+        self._h_stage: dict[str, metrics_mod.Histogram] = {}
+        #: Bounded worst-TTFT / worst-TPOT exemplar reservoirs:
+        #: worst-first dicts with trace ids and stage splits attached.
+        self._worst_ttft: list[dict] = []
+        self._worst_tpot: list[dict] = []
+        #: The calling thread's last answered request (trace id +
+        #: stage split) — the loadgen driver's attribution seam.
+        self._tls = threading.local()
 
     # ------------------------------------------------------------ intake
 
@@ -111,19 +161,96 @@ class SLOTracker:
 
     def answered(self, latency_ms: float, tokens: int = 0,
                  ttft_ms: float | None = None,
-                 tpot_ms: float | None = None) -> None:
+                 tpot_ms: float | None = None,
+                 stages: dict | None = None,
+                 trace_id: str | None = None) -> None:
+        if trace_id is None:
+            trace_id = trace_mod.current_trace_id()
         self.c_answered.add(1)
-        self.h_latency.observe(latency_ms)
-        if self._good(latency_ms, ttft_ms, tpot_ms):
+        self.h_latency.observe(latency_ms, trace_id)
+        if stages:
+            for name, ms in stages.items():
+                self.stage(name, ms, trace_id)
+        ok = self._good(latency_ms, ttft_ms, tpot_ms)
+        if ok:
             self.c_good.add(1)
         else:
             self.c_violations.add(1)
+            # The tail-exemplar lifecycle (ISSUE 20): an SLO-violating
+            # request dumps the whole flight ring (rate-limited inside
+            # maybe_dump) so the p99 links to a replayable trace.
+            if trace_mod.maybe_dump(
+                    f"slo-violation:{self.service}") is not None:
+                self.c_exemplar_dumps.add(1)
+        self._note_worst(latency_ms, ttft_ms, tpot_ms, stages,
+                         trace_id, ok)
         now = time.monotonic()
         with self._lock:
             self._ok.append((now, latency_ms, int(tokens)))
             self._trim(now)
             self._ewma_ms = (latency_ms if self._ewma_ms == 0.0
                              else 0.2 * latency_ms + 0.8 * self._ewma_ms)
+
+    def stage(self, name: str, ms: float,
+              trace_id: str | None = None) -> None:
+        """Record one request's time in one named pipeline stage into
+        ``gateway.<svc>.stage_ms.<name>`` — the histograms the health
+        sampler stamps into ``...stage_ms.<name>.p99`` series and the
+        ``slo-stage-breach`` rule prices against its budget table."""
+        h = self._h_stage.get(name)
+        if h is None:
+            h = self._h_stage[name] = self._reg.histogram(
+                f"gateway.{self.service}.stage_ms.{name}")
+        h.observe(float(ms), trace_id)
+
+    def _note_worst(self, latency_ms: float, ttft_ms: float | None,
+                    tpot_ms: float | None, stages: dict | None,
+                    trace_id: str | None, ok: bool) -> None:
+        """Fold one answered request into the worst-TTFT/TPOT
+        reservoirs and the thread-local last-request slot."""
+        entry = {"latency_ms": round(latency_ms, 3),
+                 "ttft_ms": (round(ttft_ms, 3)
+                             if ttft_ms is not None else None),
+                 "tpot_ms": (round(tpot_ms, 3)
+                             if tpot_ms is not None else None),
+                 "trace_id": trace_id,
+                 "stages": dict(stages) if stages else {},
+                 "slo_ok": ok, "ts": round(time.time(), 3)}
+        self._tls.last = entry
+        # TTFT falls back to e2e — same conservative bound _good uses.
+        ttft = ttft_ms if ttft_ms is not None else latency_ms
+        with self._lock:
+            self._fold_worst(self._worst_ttft, ttft, entry)
+            if tpot_ms is not None:
+                self._fold_worst(self._worst_tpot, tpot_ms, entry)
+
+    @staticmethod
+    def _fold_worst(res: list[dict], value: float, entry: dict) -> None:
+        item = {"value_ms": round(float(value), 3), **entry}
+        if len(res) < WORST_SLOTS:
+            res.append(item)
+        else:
+            i = min(range(len(res)), key=lambda j: res[j]["value_ms"])
+            if value > res[i]["value_ms"]:
+                res[i] = item
+
+    def worst(self, limit: int = WORST_SLOTS) -> dict:
+        """Worst-first TTFT/TPOT exemplar reservoirs — each entry
+        carries the trace id and the per-stage split, so a tail
+        number is one ``obs request <trace_id>`` from its waterfall."""
+        with self._lock:
+            ttft = sorted(self._worst_ttft,
+                          key=lambda e: -e["value_ms"])[:limit]
+            tpot = sorted(self._worst_tpot,
+                          key=lambda e: -e["value_ms"])[:limit]
+        return {"ttft": ttft, "tpot": tpot}
+
+    def last_request(self) -> dict | None:
+        """The calling thread's most recent answered request (trace
+        id, stage split, SLO verdict) — how an in-process driver
+        (loadgen's ``gateway_target``) attributes each outcome to its
+        culprit stage without a second measurement path."""
+        return getattr(self._tls, "last", None)
 
     def _good(self, latency_ms: float, ttft_ms: float | None,
               tpot_ms: float | None) -> bool:

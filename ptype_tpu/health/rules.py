@@ -529,6 +529,73 @@ class TtftRule(Rule):
         return out
 
 
+class StageBreachRule(Rule):
+    """Stage-budgeted SLO attribution: the gateway decomposes every
+    request's wall into named stages (``gateway.<svc>.stage_ms.<stage>``
+    histograms — queue-wait / route / prefill / migrate / decode /
+    rpc), and this rule prices each stage's p99 against its share of
+    the TTFT SLO (:data:`ptype_tpu.health.forensics
+    .DEFAULT_STAGE_FRACTIONS`). Where ``ttft-p99`` pages with a
+    number, this pages with a CULPRIT — the page message names the
+    stage eating the budget and points the runbook at ``obs tail`` →
+    ``obs request``. One page per node names only the worst-overage
+    stage: three stages breaching at once is one incident, not three
+    pages."""
+
+    name = "slo-stage-breach"
+    severity = "page"
+
+    def __init__(self, service: str = "llm",
+                 slo_ttft_ms: float = 2000.0,
+                 fractions: dict | None = None,
+                 min_count: float = 8.0):
+        from ptype_tpu.health import forensics
+        self.service = service
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.budgets = forensics.stage_budgets_ms(slo_ttft_ms, fractions)
+        self.min_count = float(min_count)
+
+    def evaluate(self, view: ClusterView) -> list[Alert]:
+        out = []
+        prefix = f"gateway.{self.service}.stage_ms."
+        for node in view.node_keys():
+            series = self.nodes_series(view, node)
+            worst = None  # (overage_ms, stage, p99, budget)
+            for name in series:
+                if not (name.startswith(prefix)
+                        and name.endswith(".p99")):
+                    continue
+                stage = name[len(prefix):-len(".p99")]
+                budget = self.budgets.get(stage)
+                if budget is None:
+                    continue
+                count = view.last(node, f"{prefix}{stage}.count")
+                if count is None or count[1] < self.min_count:
+                    continue  # a handful of requests' tail is noise
+                last = view.last(node, name)
+                if last is None:
+                    continue
+                over = last[1] - budget
+                if over > 0 and (worst is None or over > worst[0]):
+                    worst = (over, stage, last[1], budget)
+            if worst is not None:
+                over, stage, p99, budget = worst
+                out.append(self._alert(
+                    node,
+                    f"gateway {self.service} stage '{stage}' p99 "
+                    f"{p99:.0f}ms over its {budget:.0f}ms budget "
+                    f"({over:.0f}ms overage; stage budgets decompose "
+                    f"TTFT SLO {self.slo_ttft_ms:.0f}ms) — "
+                    f"obs tail, then obs request <trace_id>",
+                    value=p99, threshold=budget,
+                    service=self.service, stage=stage))
+        return out
+
+    @staticmethod
+    def nodes_series(view: ClusterView, node: str) -> dict:
+        return view.nodes.get(node, {}).get("series", {}) or {}
+
+
 class KvPressureRule(Rule):
     """Paged-KV pool pressure: a replica's admission headroom
     (``kv.free_blocks`` / ``kv.total_blocks``) sat below ``free_frac``
@@ -920,6 +987,10 @@ def default_rules(service: str = "llm",
     ]
     if slo_ttft_ms is not None:
         rules.append(TtftRule(slo_ttft_ms=slo_ttft_ms))
+        # Same opt-in SLO target, finer verdict: the stage-budget rule
+        # pages naming the culprit stage rather than the total.
+        rules.append(StageBreachRule(service=service,
+                                     slo_ttft_ms=slo_ttft_ms))
     if slo_p99_ms is not None:
         rules.insert(1, P99Rule(service=service, slo_p99_ms=slo_p99_ms))
     return rules

@@ -602,6 +602,11 @@ class ServingLedger:
             "reserve_wait_ms": round(rec.reserve_wait_s() * 1e3, 3),
             "prompt_tokens": rec.prompt_tokens,
             "reused_blocks": rec.reused_blocks,
+            # Forensics stage tag: an admit wait on a decode-class
+            # engine (KV arrived over the wire) is decode-queue time,
+            # not front-door queue-wait.
+            "stage": ("decode-queue" if rec.t_mig0 is not None
+                      else "queue-wait"),
         }
         if rec.reason == "shed":
             admit.status = "shed"
@@ -615,14 +620,15 @@ class ServingLedger:
             sp.dur_s = mig
             sp.attrs = {"blocks": rec.migrate_blocks,
                         "bytes": rec.migrate_bytes,
-                        "dedup_blocks": rec.reused_blocks}
+                        "dedup_blocks": rec.reused_blocks,
+                        "stage": "migrate"}
             recd.record(sp)
         for i, (w0, dur, tokens) in enumerate(rec.chunks):
             sp = trace.Span(f"serve.prefill.chunk[{i}]", trace_id,
                             parent_id)
             sp.start_s = w0
             sp.dur_s = dur
-            sp.attrs = {"tokens": tokens}
+            sp.attrs = {"tokens": tokens, "stage": "prefill"}
             recd.record(sp)
         if rec.t_first is not None:
             dec = trace.Span("serve.decode", trace_id, parent_id)
@@ -630,6 +636,7 @@ class ServingLedger:
             dec.dur_s = max(0.0, rec.t_done - rec.t_first)
             dec.attrs = {"tokens": len(rec.tok_t),
                          "reason": rec.reason,
+                         "stage": "decode",
                          "ttft_ms": round(rec.ttft_s() * 1e3, 3)}
             tpot = rec.tpot_s()
             if tpot is not None:

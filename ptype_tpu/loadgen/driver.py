@@ -34,8 +34,11 @@ paired recovery, and traffic replay composes with the chaos soak.
 Targets are callables ``target(arrival) -> result``: a raw token
 array (tokens counted from its shape), or a dict with optional
 ``tokens`` / ``ttft_ms`` / ``tpot_ms`` keys when the target can
-report first-token timing. :func:`gateway_target` adapts an
-:class:`~ptype_tpu.gateway.InferenceGateway`.
+report first-token timing, plus ``stages`` / ``trace_id`` when it can
+report the gateway's per-stage wall split (the ledger prices those
+against the TTFT stage budgets to blame each SLO-bad request on a
+culprit stage). :func:`gateway_target` adapts an
+:class:`~ptype_tpu.gateway.InferenceGateway` and reports all five.
 """
 
 from __future__ import annotations
@@ -149,10 +152,15 @@ class OpenLoopDriver:
                     and tokens > 1):
                 tpot_ms = max(0.0, ((done - issued) * 1000.0
                                     - ttft_ms)) / (tokens - 1)
+            stages = trace_id = None
+            if isinstance(res, dict):
+                stages = res.get("stages")
+                trace_id = res.get("trace_id")
             led.record(Outcome(arr.seq, arr.family, "ok",
                                t_offered=arr.t, t_issued=issued,
                                t_done=done, tokens=tokens,
-                               ttft_ms=ttft_ms, tpot_ms=tpot_ms))
+                               ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+                               stages=stages, trace_id=trace_id))
         finally:
             led.inflight(-1)
 
@@ -230,6 +238,20 @@ def gateway_target(gw, *, deadline_s: float | None = None,
                           deadline_s=deadline_s,
                           affinity_key=arr.affinity_key)
         tokens, _, _ = _parse_result(out)
-        return {"tokens": tokens}
+        rep = {"tokens": tokens}
+        # The SLO tracker stamps its thread-local with the request the
+        # calling thread just finished — gw.generate ran right here,
+        # so this is OUR request's stage split and trace id, with no
+        # tracing dependency and no extra RPC.
+        slo = getattr(gw, "slo", None)
+        last = slo.last_request() if slo is not None else None
+        if last is not None:
+            rep["stages"] = last.get("stages")
+            rep["trace_id"] = last.get("trace_id")
+            if last.get("ttft_ms") is not None:
+                rep["ttft_ms"] = last["ttft_ms"]
+            if last.get("tpot_ms") is not None:
+                rep["tpot_ms"] = last["tpot_ms"]
+        return rep
 
     return target

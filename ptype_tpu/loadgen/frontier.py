@@ -52,6 +52,10 @@ class RatePoint:
     overrun_pct: float
     offered: int
     answered: int
+    #: Stage-blamed SLO-bad counts + the single worst culprit — WHY
+    #: this point's goodput is what it is (forensics attribution).
+    slo_bad_stages: dict = field(default_factory=dict)
+    culprit_stage: str | None = None
 
     def as_dict(self) -> dict:
         return {k: (round(v, 3) if isinstance(v, float) else v)
@@ -84,7 +88,9 @@ def point_from_summary(s: dict) -> RatePoint:
         e2e_p99_ms=s["e2e_p99_ms"],
         shed_pct=100.0 * s["shed"] / offered,
         overrun_pct=100.0 * s["overruns"] / offered,
-        offered=s["offered"], answered=s["answered"])
+        offered=s["offered"], answered=s["answered"],
+        slo_bad_stages=dict(s.get("slo_bad_stages") or {}),
+        culprit_stage=s.get("culprit_stage"))
 
 
 def locate_knee(points: list[RatePoint],

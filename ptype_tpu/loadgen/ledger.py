@@ -33,6 +33,11 @@ the node's registry so the sampler publishes the series):
 ``loadgen.overrun``             late or bound-refused issues (ctr)
 ``loadgen.slo_good``            answered AND met TTFT+TPOT SLOs (ctr)
 ``loadgen.slo_bad``             everything else offered (ctr)
+``loadgen.slo_bad.<culprit>``   slo_bad attributed to its culprit
+                                stage (gateway stage timings priced
+                                against the TTFT stage budgets); a
+                                shed blames ``queue-wait``, other
+                                non-answers blame their status (ctr)
 ``loadgen.inflight``            open requests at the driver (gauge)
 ``loadgen.offered_rps``         the schedule's target rate (gauge)
 ``loadgen.knee_rps``            last measured capacity knee (gauge,
@@ -74,6 +79,13 @@ class Outcome:
     tokens: int = 0
     ttft_ms: float | None = None
     tpot_ms: float | None = None
+    #: Gateway stage decomposition of this request's wall (name → ms),
+    #: read off the SLO tracker's thread-local by the driver target —
+    #: no tracing dependency, works on every answered request.
+    stages: dict | None = None
+    #: The request's trace id when tracing was armed — links an SLO-bad
+    #: outcome to its replayable waterfall (``obs request``).
+    trace_id: str | None = None
 
     @property
     def e2e_ms(self) -> float | None:
@@ -118,6 +130,14 @@ class TrafficLedger:
         self._outcomes: list[Outcome] = []
         self._inflight = 0
         self._wall_s: float | None = None
+        # Stage budgets for culprit attribution: the TTFT SLO
+        # decomposed per stage (lazy import keeps loadgen light for
+        # targets that never price stages).
+        self._budgets: dict | None = None
+        if slo_ttft_ms is not None:
+            from ptype_tpu.health import forensics
+            self._budgets = forensics.stage_budgets_ms(slo_ttft_ms)
+        self._culprits: dict[str, int] = {}
 
     @property
     def registry(self) -> metrics_mod.MetricsRegistry:
@@ -157,17 +177,32 @@ class TrafficLedger:
             return False
         return True
 
+    def culprit_of(self, out: Outcome) -> str | None:
+        """The stage (or status) to blame for an SLO-bad outcome: the
+        gateway's per-request stage split priced against the TTFT
+        stage budgets when the target reported one; a shed blames
+        ``queue-wait`` (the admission gate IS queue pressure); other
+        non-answers blame their status so nothing vanishes."""
+        if self.good(out):
+            return None
+        if out.stages:
+            from ptype_tpu.health import forensics
+            return forensics.culprit_stage(out.stages, self._budgets)
+        if out.status == "shed":
+            return "queue-wait"
+        return out.status if out.status != "ok" else "unattributed"
+
     def record(self, out: Outcome) -> None:
         if out.status == "ok":
             self.c_answered.add(1)
             e2e = out.e2e_ms
             if e2e is not None:
-                self.h_e2e.observe(e2e)
+                self.h_e2e.observe(e2e, out.trace_id)
                 ttft = (out.ttft_ms if out.ttft_ms is not None
                         else e2e)
-                self.h_ttft.observe(ttft)
+                self.h_ttft.observe(ttft, out.trace_id)
             if out.tpot_ms is not None:
-                self.h_tpot.observe(out.tpot_ms)
+                self.h_tpot.observe(out.tpot_ms, out.trace_id)
         elif out.status == "shed":
             self.c_shed.add(1)
         elif out.status == "error":
@@ -180,6 +215,12 @@ class TrafficLedger:
             self.c_good.add(1)
         else:
             self.c_bad.add(1)
+            culprit = self.culprit_of(out)
+            if culprit:
+                self._reg.counter(f"loadgen.slo_bad.{culprit}").add(1)
+                with self._lock:
+                    self._culprits[culprit] = (
+                        self._culprits.get(culprit, 0) + 1)
         with self._lock:
             self._outcomes.append(out)
 
@@ -208,6 +249,7 @@ class TrafficLedger:
         outs = self.outcomes()
         with self._lock:
             wall = self._wall_s
+            culprits = dict(self._culprits)
         by = lambda s: [o for o in outs if o.status == s]  # noqa: E731
         ok = by("ok")
         ttfts = [(o.ttft_ms if o.ttft_ms is not None else o.e2e_ms)
@@ -235,6 +277,12 @@ class TrafficLedger:
             "ttft_p99_ms": self._pct(ttfts, 99),
             "e2e_p99_ms": self._pct(e2es, 99),
             "wall_s": wall,
+            # WHY the knee is where it is: every slo_bad request blamed
+            # on its culprit stage, plus the single worst stage — what
+            # bench --traffic reports next to the knee.
+            "slo_bad_stages": culprits,
+            "culprit_stage": (max(culprits, key=culprits.get)
+                              if culprits else None),
         }
 
 

@@ -265,14 +265,28 @@ class Gauge:
             self.value += delta
 
 
+#: Exemplar slots kept per histogram: the K worst observations that
+#: arrived with a trace id attached. Small and fixed — the point is a
+#: handful of replayable links off the p99, not a second reservoir.
+EXEMPLAR_SLOTS = 8
+
+
 class Histogram:
     """Windowed reservoir with exact percentiles over the last
     ``window`` observations — the tail-latency surface (p50/p95/p99)
     the gateway's SLO accounting and autoscale signals read. A ring
     buffer, not a sketch: serving windows are small (thousands), and
-    exact tails are what an SLO check needs."""
+    exact tails are what an SLO check needs.
 
-    __slots__ = ("name", "window", "_ring", "_idx", "_count", "_lock")
+    **Exemplars** (ISSUE 20): when an observation happens inside an
+    active trace (or the caller passes ``trace_id``), the value keeps
+    its trace id in one of :data:`EXEMPLAR_SLOTS` worst-value slots —
+    so the p99 a dashboard shows links to a real replayable trace in
+    the flight recorder, not an anonymous number. Free when tracing
+    is disabled (one global load in :func:`trace.current_trace_id`)."""
+
+    __slots__ = ("name", "window", "_ring", "_idx", "_count", "_lock",
+                 "_exemplars")
 
     def __init__(self, name: str, window: int = 2048):
         self.name = name
@@ -280,16 +294,28 @@ class Histogram:
         self._ring: list[float] = []
         self._idx = 0
         self._count = 0
+        self._exemplars: list[tuple[float, str, float]] = []
         self._lock = lockcheck.lock("metrics.histogram")
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        v = float(value)
+        if trace_id is None:
+            trace_id = trace_mod.current_trace_id()
         with self._lock:
             if len(self._ring) < self.window:
-                self._ring.append(float(value))
+                self._ring.append(v)
             else:
-                self._ring[self._idx] = float(value)
+                self._ring[self._idx] = v
                 self._idx = (self._idx + 1) % self.window
             self._count += 1
+            if trace_id:
+                ex = self._exemplars
+                if len(ex) < EXEMPLAR_SLOTS:
+                    ex.append((v, trace_id, time.time()))
+                else:
+                    i = min(range(len(ex)), key=lambda j: ex[j][0])
+                    if v > ex[i][0]:
+                        ex[i] = (v, trace_id, time.time())
 
     @property
     def count(self) -> int:
@@ -306,11 +332,24 @@ class Histogram:
                           int(round(p / 100.0 * (len(data) - 1)))))
         return data[rank]
 
+    def exemplars(self) -> list[dict]:
+        """Worst-first ``{value, trace_id, ts}`` exemplar slots —
+        what ``obs tail`` and the OpenMetrics exporter surface."""
+        with self._lock:
+            ex = list(self._exemplars)
+        ex.sort(key=lambda e: -e[0])
+        return [{"value": round(v, 3), "trace_id": tid,
+                 "ts": round(ts, 3)} for v, tid, ts in ex]
+
     def summary(self) -> dict:
-        return {"count": self.count,
-                "p50": self.percentile(50.0),
-                "p95": self.percentile(95.0),
-                "p99": self.percentile(99.0)}
+        out = {"count": self.count,
+               "p50": self.percentile(50.0),
+               "p95": self.percentile(95.0),
+               "p99": self.percentile(99.0)}
+        ex = self.exemplars()
+        if ex:  # key present only when real links exist — snapshot
+            out["exemplars"] = ex  # shape is pinned by older tests
+        return out
 
 
 class MetricsRegistry:

@@ -343,6 +343,88 @@ def render_summary(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------- OpenMetrics
+
+
+def _om_name(name: str) -> str:
+    """Metric-name sanitization: ``gateway.llm.stage_ms.queue-wait``
+    → ``gateway_llm_stage_ms_queue_wait`` (OpenMetrics charset)."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _om_labels(labels: dict | None, extra: str = "") -> str:
+    parts = [f'{_om_name(k)}="{v}"' for k, v in (labels or {}).items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def openmetrics(source, labels: dict | None = None) -> str:
+    """Render metrics as OpenMetrics text — the scrape format every
+    standard collector speaks, so a long soak needs no bespoke reader.
+
+    ``source`` is a :class:`~ptype_tpu.metrics.MetricsRegistry`, one
+    process's ``snapshot()`` dict, or a full :func:`cluster_snapshot`
+    (each node rendered with a ``node`` label). Counters render as
+    ``_total`` samples, gauges as gauges, timings and histograms as
+    quantile-labelled summaries; a histogram's worst trace-linked
+    exemplar rides its ``quantile="0.99"`` sample in OpenMetrics
+    exemplar syntax (``# {trace_id="..."} value``) — the p99 line
+    literally names the trace to pull with ``obs request``."""
+    snap = source.snapshot() if hasattr(source, "snapshot") else source
+    lines: list[str] = []
+    if "nodes" in snap and "counters" not in snap:
+        for key in sorted(snap["nodes"]):
+            m = snap["nodes"][key].get("metrics", {})
+            node_labels = dict(labels or {})
+            node_labels["node"] = key
+            _om_family(lines, m, node_labels)
+    else:
+        _om_family(lines, snap, labels)
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def _om_family(lines: list, snap: dict, labels: dict | None) -> None:
+    lab = _om_labels(labels)
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        om = _om_name(name)
+        lines.append(f"# TYPE {om} counter")
+        lines.append(f"{om}_total{lab} {v}")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        om = _om_name(name)
+        lines.append(f"# TYPE {om} gauge")
+        lines.append(f"{om}{lab} {v}")
+    for name, s in sorted((snap.get("timings") or {}).items()):
+        om = _om_name(name)
+        lines.append(f"# TYPE {om} summary")
+        for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"),
+                       ("0.99", "p99_s")):
+            qlab = _om_labels(labels, 'quantile="%s"' % q)
+            lines.append(f"{om}{qlab} {s.get(key, 0.0)}")
+        lines.append(f"{om}_count{lab} {s.get('count', 0)}")
+    for name, s in sorted((snap.get("histograms") or {}).items()):
+        om = _om_name(name)
+        lines.append(f"# TYPE {om} summary")
+        exemplars = s.get("exemplars") or []
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            qlab = _om_labels(labels, 'quantile="%s"' % q)
+            line = f"{om}{qlab} {s.get(key, 0.0)}"
+            if q == "0.99" and exemplars:
+                ex = exemplars[0]  # worst-first
+                line += (' # {trace_id="%s"} %s %s'
+                         % (ex["trace_id"], ex["value"],
+                            ex.get("ts", 0.0)))
+            lines.append(line)
+        lines.append(f"{om}_count{lab} {s.get('count', 0)}")
+
+
 # ------------------------------------------------------------ bench probe
 
 

@@ -275,6 +275,16 @@ def traceparent() -> str | None:
     return f"00-{sp.trace_id}-{sp.span_id}-01"
 
 
+def current_trace_id() -> str | None:
+    """The active trace id on this thread, or None — the exemplar
+    seam (:meth:`ptype_tpu.metrics.Histogram.observe` attaches it to
+    tail observations). One global load when tracing is disabled."""
+    if _recorder is None:
+        return None
+    sp = _current.get()
+    return sp.trace_id if sp is not None else None
+
+
 def parse_traceparent(tp) -> tuple[str, str] | None:
     """(trace_id, span_id) from a traceparent, or None if malformed —
     a peer's garbage must degrade to 'start a fresh trace', not raise."""

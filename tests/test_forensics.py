@@ -1,0 +1,661 @@
+"""Tail-forensics plane (ISSUE 20): critical-path waterfalls, always-on
+SLO exemplars, stage-budgeted paging, and the chaos drills that prove
+an injected delay pages with the right culprit stage.
+
+Unit tier runs on synthetic spans/series; the integration tier drives
+the REAL two-stage prefill→migrate→decode path over real sockets
+(test_migrate's tiny-model fleet) and asserts the stitched waterfall
+names every stage with ≤5% unattributed gap.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ptype_tpu import chaos, trace
+from ptype_tpu import telemetry as tel
+from ptype_tpu.gateway.slo import SLOTracker
+from ptype_tpu.health import forensics
+from ptype_tpu.health.rules import ClusterView, StageBreachRule
+from ptype_tpu.metrics import EXEMPLAR_SLOTS, MetricsRegistry
+
+# ------------------------------------------------- histogram exemplars
+
+
+def test_histogram_exemplars_keep_worst_values():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.ms")
+    for i in range(EXEMPLAR_SLOTS + 20):
+        h.observe(float(i), trace_id=f"tid{i}")
+    ex = h.exemplars()
+    assert len(ex) == EXEMPLAR_SLOTS
+    # Worst-first, and the replace-min kept exactly the top values.
+    vals = [e["value"] for e in ex]
+    assert vals == sorted(vals, reverse=True)
+    assert vals[0] == float(EXEMPLAR_SLOTS + 19)
+    assert ex[0]["trace_id"] == f"tid{EXEMPLAR_SLOTS + 19}"
+    # summary() carries the slots only when real links exist.
+    assert "exemplars" in h.summary()
+    h2 = reg.histogram("t2.ms")
+    h2.observe(1.0)  # no trace id, no active trace
+    assert "exemplars" not in h2.summary()
+
+
+def test_exemplar_rides_active_span_trace_id():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.ms")
+    trace.enable(service="ut")
+    try:
+        with trace.span("unit.work"):
+            tid = trace.current_trace_id()
+            h.observe(42.0)
+    finally:
+        trace.disable()
+    ex = h.exemplars()
+    assert len(ex) == 1 and ex[0]["trace_id"] == tid
+
+
+# ------------------------------------------------------- stage budgets
+
+
+def test_stage_budgets_and_culprit():
+    budgets = forensics.stage_budgets_ms(1000.0)
+    assert budgets["queue-wait"] == pytest.approx(200.0)
+    assert budgets["migrate"] == pytest.approx(500.0)
+    # Largest overage wins even when another stage is absolutely longer.
+    stages = {"decode": 900.0, "migrate": 700.0}
+    assert forensics.culprit_stage(stages, budgets) == "migrate"
+    # Nothing over budget → longest stage stands in.
+    assert forensics.culprit_stage(
+        {"prefill": 100.0, "route": 10.0}, budgets) == "prefill"
+    # No budgets at all → longest stage; empty → None.
+    assert forensics.culprit_stage({"a": 1.0, "b": 2.0}) == "b"
+    assert forensics.culprit_stage({}) is None
+
+
+# -------------------------------------------------- waterfall (synthetic)
+
+
+def _sp(name, start, dur, tid="t1", span_id="s", parent=None, **attrs):
+    d = {"name": name, "trace_id": tid, "span_id": span_id,
+         "parent_id": parent, "start_s": start, "dur_s": dur,
+         "status": "ok", "tid": 1}
+    if attrs:
+        d["attrs"] = attrs
+    return d
+
+
+def test_waterfall_engine_span_overrides_gateway_window():
+    # 100ms request: 10ms admit, 5ms route, 50ms prefill rpc whose
+    # first 20ms the ENGINE spent in its own admit queue, 30ms decode.
+    spans = [
+        _sp("gateway.request", 0.0, 0.100, span_id="root"),
+        _sp("gateway.admit", 0.0, 0.010, span_id="a", parent="root"),
+        _sp("gateway.route", 0.010, 0.005, span_id="r", parent="root"),
+        _sp("gateway.prefill", 0.015, 0.050, span_id="p", parent="root"),
+        _sp("serve.admit", 0.015, 0.020, span_id="ea", parent="p",
+            stage="queue-wait"),
+        _sp("gateway.migrate", 0.065, 0.005, span_id="m", parent="root"),
+        _sp("rpc.call", 0.070, 0.030, span_id="d", parent="root",
+            method="Generator.MigrateDecode"),
+    ]
+    wf = forensics.extract_waterfall(spans)
+    st = wf["stages"]
+    # The engine admit carved queue time OUT of the prefill rpc wall.
+    assert st["queue-wait"] == pytest.approx(30.0, abs=1e-6)
+    assert st["prefill"] == pytest.approx(30.0, abs=1e-6)
+    assert st["migrate"] == pytest.approx(5.0, abs=1e-6)
+    assert st["decode"] == pytest.approx(30.0, abs=1e-6)  # by rpc method
+    assert st["route"] == pytest.approx(5.0, abs=1e-6)
+    assert wf["wall_ms"] == pytest.approx(100.0)
+    assert wf["coverage_pct"] == pytest.approx(100.0)
+    assert wf["ok"]
+
+
+def test_waterfall_reports_honest_gap_and_floor():
+    spans = [
+        _sp("gateway.request", 0.0, 0.100, span_id="root"),
+        _sp("gateway.admit", 0.0, 0.010, span_id="a", parent="root"),
+        # 90ms of the wall covered by nothing stage-mapped.
+    ]
+    wf = forensics.extract_waterfall(spans)
+    assert wf["unattributed_ms"] == pytest.approx(90.0)
+    assert wf["coverage_pct"] == pytest.approx(10.0)
+    assert not wf["ok"]
+
+
+def test_waterfall_requires_trace_id_when_ambiguous():
+    spans = [_sp("gateway.request", 0.0, 0.1, tid="aa", span_id="r1"),
+             _sp("gateway.request", 0.0, 0.1, tid="bb", span_id="r2")]
+    with pytest.raises(ValueError, match="pass trace_id"):
+        forensics.extract_waterfall(spans)
+    wf = forensics.extract_waterfall(spans, trace_id="aa")
+    assert wf["trace_id"] == "aa"
+    # Snapshot lookup accepts the short prefix operators paste.
+    snap = {"traces": {"aabbccdd": [
+        _sp("gateway.request", 0.0, 0.1, tid="aabbccdd", span_id="r3")]}}
+    wf2 = forensics.waterfall_from_snapshot(snap, "aab")
+    assert wf2["trace_id"] == "aabbccdd"
+    with pytest.raises(KeyError):
+        forensics.waterfall_from_snapshot(snap, "zz")
+
+
+def test_render_waterfall_and_tail_smoke():
+    spans = [
+        _sp("gateway.request", 0.0, 0.020, span_id="root"),
+        _sp("gateway.admit", 0.0, 0.005, span_id="a", parent="root"),
+        _sp("rpc.call", 0.005, 0.015, span_id="c", parent="root",
+            method="Generator.Generate"),
+    ]
+    out = forensics.render_waterfall(forensics.extract_waterfall(spans))
+    assert "queue-wait" in out and "rpc" in out and "coverage" in out
+    reg = MetricsRegistry()
+    reg.histogram("gateway.llm.ttft_ms").observe(1234.5, "feedc0de")
+    reg.histogram("gateway.llm.stage_ms.migrate").observe(900.0, "feedc0de")
+    tail = forensics.render_tail(
+        {"ts": 0.0, "nodes": {"gw": {"metrics": reg.snapshot()}}})
+    assert "feedc0de" in tail and "migrate" in tail
+    assert "obs request" in tail
+    # A bare registry snapshot works too (single-process obs).
+    assert "feedc0de" in forensics.render_tail(reg.snapshot())
+
+
+# ----------------------------------------------------- SLO tracker seam
+
+
+def test_slo_tracker_stages_worst_and_thread_local():
+    reg = MetricsRegistry()
+    slo = SLOTracker("svc", registry=reg, slo_ttft_p99_ms=100.0)
+    slo.answered(250.0, tokens=4, ttft_ms=220.0,
+                 stages={"queue-wait": 200.0, "prefill": 20.0},
+                 trace_id="slowreq")
+    slo.answered(30.0, tokens=4, ttft_ms=25.0, tpot_ms=2.0,
+                 stages={"queue-wait": 1.0, "prefill": 20.0},
+                 trace_id="fastreq")
+    # Stage histograms exist under the documented names.
+    snap = reg.snapshot()
+    assert "gateway.svc.stage_ms.queue-wait" in snap["histograms"]
+    ex = snap["histograms"]["gateway.svc.stage_ms.queue-wait"]["exemplars"]
+    assert ex[0]["trace_id"] == "slowreq"
+    # Worst-TTFT reservoir: worst-first, entries carry trace + stages.
+    worst = slo.worst()["ttft"]
+    assert worst[0]["trace_id"] == "slowreq"
+    assert worst[0]["value_ms"] == pytest.approx(220.0)
+    assert worst[0]["stages"]["queue-wait"] == pytest.approx(200.0)
+    assert worst[0]["slo_ok"] is False
+    # Thread-local last_request: this thread sees its own answer only.
+    assert slo.last_request()["trace_id"] == "fastreq"
+    seen = {}
+    def other():
+        seen["last"] = slo.last_request()
+        slo.answered(10.0, ttft_ms=5.0, trace_id="otherreq")
+        seen["mine"] = slo.last_request()["trace_id"]
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["last"] is None and seen["mine"] == "otherreq"
+    assert slo.last_request()["trace_id"] == "fastreq"
+
+
+def test_slo_violation_dumps_flight_ring_rate_limited(tmp_path):
+    reg = MetricsRegistry()
+    slo = SLOTracker("svc", registry=reg, slo_ttft_p99_ms=50.0)
+    trace.enable(service="ut", dump_dir=str(tmp_path))
+    trace._dump_last = 0.0  # the rate limiter is module-global
+    try:
+        with trace.span("unit.req"):
+            pass
+        slo.answered(500.0, ttft_ms=400.0, trace_id="bad1")
+        slo.answered(500.0, ttft_ms=400.0, trace_id="bad2")
+    finally:
+        trace.disable()
+    dumps = [n for n in os.listdir(tmp_path) if n.startswith("flight-")]
+    assert len(dumps) == 1  # second violation inside the min interval
+    assert reg.counter("gateway.svc.exemplar_dumps").value == 1
+    # The dump round-trips through the offline loaders.
+    path = forensics.latest_dump(str(tmp_path))
+    assert path is not None
+    assert forensics.load_dump_traces(path)
+
+
+# -------------------------------------------------- openmetrics export
+
+
+def test_openmetrics_families_and_exemplars():
+    reg = MetricsRegistry()
+    reg.counter("loadgen.slo_bad").add(3)
+    reg.gauge("gateway.llm.queue_depth").set(2.0)
+    reg.timing("step.ms").observe(0.01)
+    reg.histogram("gateway.llm.ttft_ms").observe(123.0, "cafe01")
+    text = tel.openmetrics(reg)
+    assert "loadgen_slo_bad_total 3" in text
+    assert "gateway_llm_queue_depth 2" in text
+    assert 'quantile="0.99"' in text
+    assert '{trace_id="cafe01"}' in text  # exemplar on the p99 line
+    assert text.endswith("# EOF\n")
+    # Cluster form labels every sample with its node.
+    snap = {"ts": 0.0, "nodes": {"gw/h:1": {"metrics": reg.snapshot()}}}
+    ctext = tel.openmetrics(snap)
+    assert 'node="gw/h:1"' in ctext
+
+
+# ------------------------------------------------ stage-breach paging
+
+
+def _breach_snap(stage_ms: dict, count: float = 20.0,
+                 svc: str = "llm") -> dict:
+    series = {}
+    for stage, p99 in stage_ms.items():
+        base = f"gateway.{svc}.stage_ms.{stage}"
+        series[f"{base}.p99"] = [[1000.0, p99]]
+        series[f"{base}.count"] = [[1000.0, count]]
+    return {"ts": 1000.0, "nodes": {"gw": {"series": series}},
+            "errors": {}}
+
+
+def test_stage_breach_rule_pages_worst_overage_only():
+    rule = StageBreachRule(service="llm", slo_ttft_ms=1000.0)
+    # migrate 300ms over its 500 budget; queue-wait 50 over its 200:
+    # ONE page naming migrate.
+    snap = _breach_snap({"migrate": 800.0, "queue-wait": 250.0,
+                         "prefill": 100.0})
+    alerts = rule.evaluate(ClusterView(snap))
+    assert len(alerts) == 1
+    assert alerts[0].severity == "page"
+    assert alerts[0].labels["stage"] == "migrate"
+    assert "'migrate'" in alerts[0].message
+    assert "obs tail" in alerts[0].message
+    # All under budget → quiet.
+    ok = _breach_snap({"migrate": 100.0, "queue-wait": 50.0})
+    assert rule.evaluate(ClusterView(ok)) == []
+    # Below the traffic floor a noisy tail cannot page.
+    few = _breach_snap({"migrate": 800.0}, count=3.0)
+    assert rule.evaluate(ClusterView(few)) == []
+
+
+def test_stage_breach_in_default_rules():
+    from ptype_tpu.health.rules import default_rules
+    # Opt-in like ttft-p99: only an operator-picked SLO target arms it.
+    names = [r.name for r in default_rules(service="llm",
+                                           slo_ttft_ms=2000.0)]
+    assert "slo-stage-breach" in names and "ttft-p99" in names
+    no_slo = [r.name for r in default_rules(service="llm")]
+    assert "slo-stage-breach" not in no_slo
+
+
+# ------------------------------------------- ledger blame attribution
+
+
+def test_ledger_attributes_slo_bad_to_culprit_stage():
+    from ptype_tpu.loadgen.ledger import Outcome, TrafficLedger
+
+    reg = MetricsRegistry()
+    led = TrafficLedger(slo_ttft_ms=100.0, registry=reg)
+    mk = lambda seq, **kw: Outcome(seq=seq, family="chat", t_offered=0.0,  # noqa: E731
+                                   t_issued=0.0, **kw)
+    # Good request: no blame.
+    led.record(mk(0, status="ok", t_done=0.05, ttft_ms=50.0, tokens=4,
+                  stages={"queue-wait": 10.0, "prefill": 40.0}))
+    # Bad with stages: the budget overage names migrate.
+    led.record(mk(1, status="ok", t_done=0.5, ttft_ms=400.0, tokens=4,
+                  trace_id="bad1",
+                  stages={"queue-wait": 30.0, "migrate": 350.0,
+                          "prefill": 50.0}))
+    # Shed blames the queue; an error blames its status.
+    led.record(mk(2, status="shed"))
+    led.record(mk(3, status="error"))
+    s = led.summary()
+    assert s["slo_bad_stages"]["migrate"] == 1
+    assert s["slo_bad_stages"]["queue-wait"] == 1
+    assert s["slo_bad_stages"]["error"] == 1
+    assert s["culprit_stage"] in ("migrate", "queue-wait", "error")
+    assert reg.counter("loadgen.slo_bad.migrate").value == 1
+    assert reg.counter("loadgen.slo_bad.queue-wait").value == 1
+    # The frontier point carries the blame through.
+    from ptype_tpu.loadgen.frontier import point_from_summary
+    p = point_from_summary(s)
+    assert p.slo_bad_stages["migrate"] == 1
+    assert p.culprit_stage == s["culprit_stage"]
+
+
+def test_gateway_target_reports_stages_and_trace_id():
+    from ptype_tpu.loadgen.arrivals import synth_trace
+    from ptype_tpu.loadgen.driver import gateway_target
+
+    reg = MetricsRegistry()
+    slo = SLOTracker("svc", registry=reg, slo_ttft_p99_ms=1000.0)
+
+    class _Gw:
+        def __init__(self):
+            self.slo = slo
+
+        def generate(self, prompt, max_new_tokens=8, **kw):
+            import numpy as np
+            self.slo.answered(12.0, tokens=max_new_tokens, ttft_ms=9.0,
+                              stages={"queue-wait": 2.0, "rpc": 10.0},
+                              trace_id="drv1")
+            return np.zeros((1, max_new_tokens), np.int32)
+
+    gw = _Gw()
+    target = gateway_target(gw, vocab=256)
+    arr = synth_trace(7, duration_s=1.0, rate_rps=3.0).arrivals[0]
+    rep = target(arr)
+    assert rep["stages"] == {"queue-wait": 2.0, "rpc": 10.0}
+    assert rep["trace_id"] == "drv1"
+    assert rep["ttft_ms"] == pytest.approx(9.0)
+
+
+# -------------------------------------------------- obs CLI (offline)
+
+
+def test_obs_request_renders_from_separate_process(tmp_path):
+    """Acceptance: `obs request <trace_id>` renders the waterfall in a
+    process that never saw the spans — only the dump file."""
+    import json
+
+    spans = [
+        _sp("gateway.request", 0.0, 0.100, tid="deadbeef", span_id="r"),
+        _sp("gateway.admit", 0.0, 0.020, tid="deadbeef", span_id="a",
+            parent="r"),
+        _sp("gateway.prefill", 0.020, 0.050, tid="deadbeef",
+            span_id="p", parent="r"),
+        _sp("gateway.migrate", 0.070, 0.030, tid="deadbeef",
+            span_id="m", parent="r"),
+    ]
+    path = tmp_path / "spans.jsonl"
+    path.write_text("\n".join(json.dumps(s) for s in spans) + "\n")
+    env = dict(os.environ, TRACE_FILE=str(path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "ptype_tpu", "obs", "request", "deadbe"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "deadbeef" in out.stdout
+    for stage in ("queue-wait", "prefill", "migrate"):
+        assert stage in out.stdout
+    assert "(source:" in out.stdout
+
+
+def test_forensics_overhead_probe_shape():
+    r = forensics.measure_forensics_overhead(iters=2000)
+    assert r["iters"] == 2000
+    assert r["observe_armed_us"] >= 0.0
+    assert r["exemplar_marginal_us"] < 100.0  # microseconds, not ms
+
+
+# =================================================================
+# Integration tier: the REAL two-stage path over real sockets.
+# =================================================================
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ptype_tpu.models import transformer as tfm  # noqa: E402
+from ptype_tpu.serve_engine import PagedGeneratorActor  # noqa: E402
+
+CFG = tfm.preset("tiny", dtype=jnp.float32)
+RNG = np.random.default_rng(20)
+BT = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return jax.jit(lambda r: tfm.init_params(r, CFG))(
+        jax.random.PRNGKey(0))
+
+
+def _prompt(n, rng=RNG):
+    return jnp.asarray(rng.integers(1, CFG.vocab_size, n),
+                       jnp.int32)[None]
+
+
+def _engine(params, serve_class):
+    kw = dict(params=params, n_slots=2, block_tokens=BT,
+              prefill_chunk=32, serve_class=serve_class,
+              metrics_registry=MetricsRegistry())
+    return PagedGeneratorActor(CFG, **kw)
+
+
+def _fleet(params, gw_registry, **cfg_over):
+    """Two REAL paged engines (prefill + decode class) over RPC —
+    test_migrate's fleet, with the gateway registry held by the test
+    so the sampler/rules can read the stage histograms."""
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.registry import CoordRegistry
+
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    actors, servers, regs = [], [], []
+    for name, cls in (("pre0", "prefill"), ("dec0", "decode")):
+        a = _engine(params, cls)
+        s = ActorServer("127.0.0.1", 0)
+        s.register(a, "Generator")
+        s.serve()
+        regs.append(registry.register("llm-disagg", name,
+                                      "127.0.0.1", s.port))
+        actors.append(a)
+        servers.append(s)
+    cfg = GatewayConfig(probe_interval_s=0.1, probe_timeout_s=2.0,
+                        default_deadline_s=60.0, disagg=True,
+                        kv_wire="exact", **cfg_over)
+    gw = InferenceGateway(registry, "llm-disagg", cfg,
+                          metrics_registry=gw_registry)
+
+    def close():
+        gw.close()
+        for r in regs:
+            r.close()
+        for s in servers:
+            s.close()
+        for a in actors:
+            a.close()
+        state.close()
+
+    return gw, actors, close
+
+
+def _wait_classes(gw, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        classes = {r.serve_class() for r in gw.pool.healthy()}
+        if {"prefill", "decode"} <= classes:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _warm(actors):
+    """Trigger every disagg-path compile OUTSIDE the gateway's SLO
+    accounting (direct actor calls share the in-process jit cache) so
+    stage histograms measure serving, not compilation."""
+    pre, dec = actors
+    p = _prompt(24)
+    rep = pre.Prefill(p, 4)
+    plan = dec.MigratePlan(p, 4)
+    wire = pre.ExportBlocks(rep["export_id"], plan["need"], "exact")
+    dec.ImportBlocks(plan["ticket"], wire)
+    pre.ReleaseExport(rep["export_id"])
+    dec.MigrateDecode(plan["ticket"], rep["first_token"])
+
+
+def test_disagg_waterfall_stitched_over_sockets(params):
+    """Satellite: the stitched cross-process waterfall of a real
+    prefill→migrate→decode request names every stage, keeps parent
+    links intact, and leaves ≤5% of wall unattributed."""
+    gw, actors, close = _fleet(params, MetricsRegistry())
+    try:
+        assert _wait_classes(gw)
+        _warm(actors)
+        trace.enable(service="gw")
+        try:
+            out = gw.generate(_prompt(24), max_new_tokens=4)
+            assert out.shape == (1, 4)
+            spans = trace.recorder().to_dicts()
+        finally:
+            trace.disable()
+        traces = tel.stitch_traces(spans)
+        # Find the disagg request's trace: the one whose root is
+        # gateway.request and that carries a migrate leg.
+        tid = None
+        for t, ss in traces.items():
+            names = {s["name"] for s in ss}
+            if "gateway.request" in names and "gateway.migrate" in names:
+                tid = t
+                break
+        assert tid is not None, sorted(
+            {s["name"] for s in spans})
+        rows = traces[tid]
+        by_id = {s["span_id"]: s for s in rows}
+        root = next(s for s in rows if s["name"] == "gateway.request")
+        # Satellite: the request span names its replica pair + domains.
+        attrs = root.get("attrs") or {}
+        assert attrs.get("prefill_replica", "").startswith("127.0.0.1:")
+        assert attrs.get("decode_replica", "").startswith("127.0.0.1:")
+        assert attrs["prefill_replica"] != attrs["decode_replica"]
+        assert "prefill_domain" in attrs and "decode_domain" in attrs
+        # Parent links: every non-root span chains up to the root.
+        for s in rows:
+            if s["span_id"] == root["span_id"]:
+                continue
+            p = s.get("parent_id")
+            hops = 0
+            while p is not None and p in by_id and hops < 20:
+                if p == root["span_id"]:
+                    break
+                p = by_id[p].get("parent_id")
+                hops += 1
+            assert p == root["span_id"], (s["name"], s.get("parent_id"))
+        wf = forensics.extract_waterfall(rows, tid)
+        for stage in ("queue-wait", "route", "prefill", "migrate",
+                      "decode"):
+            assert stage in wf["stages"], wf["stages"]
+        assert wf["coverage_pct"] >= 95.0, forensics.render_waterfall(wf)
+        assert wf["ok"]
+        # And the renderer round-trips it.
+        assert "migrate" in forensics.render_waterfall(wf)
+    finally:
+        close()
+
+
+def test_chaos_migrate_delay_pages_migrate_stage(params):
+    """Acceptance drill: an injected serve.migrate delay makes the
+    slo-stage-breach rule page naming 'migrate', and every worst-TTFT
+    exemplar's waterfall stays ≥95% attributed."""
+    from ptype_tpu.health.series import Sampler, SeriesStore
+
+    reg = MetricsRegistry()
+    gw, actors, close = _fleet(params, reg, slo_ttft_p99_ms=150.0)
+    try:
+        assert _wait_classes(gw)
+        _warm(actors)
+        trace.enable(service="gw")
+        plan = chaos.FaultPlan([chaos.FaultSpec(
+            site="serve.migrate", action="delay", delay_s=0.25,
+            times=999)])
+        chaos.arm(plan)
+        try:
+            for _ in range(5):
+                gw.generate(_prompt(24), max_new_tokens=4)
+        finally:
+            chaos.disarm()
+        # Sample the registry into series, the shape the rule reads.
+        store = SeriesStore()
+        sampler = Sampler(reg, store, cadence_s=1.0, memory=False)
+        sampler.sample_once()
+        snap = {"ts": time.time(),
+                "nodes": {"gw": {"series": store.snapshot()}},
+                "errors": {}}
+        rule = StageBreachRule(service="llm-disagg", slo_ttft_ms=150.0,
+                               min_count=4)
+        alerts = rule.evaluate(ClusterView(snap))
+        assert len(alerts) == 1, [a.message for a in alerts]
+        assert alerts[0].labels["stage"] == "migrate"
+        # Every worst-TTFT exemplar links a waterfall that attributes
+        # the injected delay (≥95% of wall in named stages).
+        spans = trace.recorder().to_dicts()
+        traces = tel.stitch_traces(spans)
+        worst = gw.slo.worst()["ttft"]
+        assert worst, "no TTFT exemplars recorded"
+        checked = 0
+        for e in worst:
+            tid = e.get("trace_id")
+            if tid is None or tid not in traces:
+                continue
+            wf = forensics.extract_waterfall(traces[tid], tid)
+            assert wf["coverage_pct"] >= 95.0, \
+                forensics.render_waterfall(wf)
+            # The delay landed IN the migrate stage, not a gap.
+            assert wf["stages"].get("migrate", 0.0) >= 200.0
+            checked += 1
+        assert checked >= 1
+    finally:
+        trace.disable()
+        close()
+
+
+def test_chaos_admit_delay_pages_queue_wait(params):
+    """Acceptance drill: an injected gateway.admit delay names
+    'queue-wait' — the admission gate, not the replicas."""
+    del params  # cheap fake fleet: the admission gate is gateway-side
+    from ptype_tpu.actor import ActorServer
+    from ptype_tpu.coord.core import CoordState
+    from ptype_tpu.coord.local import LocalCoord
+    from ptype_tpu.gateway import GatewayConfig, InferenceGateway
+    from ptype_tpu.health.series import Sampler, SeriesStore
+    from ptype_tpu.registry import CoordRegistry
+
+    class _FakeGen:
+        def Generate(self, prompt, max_new_tokens=8, *a, **k):
+            return np.full((1, int(max_new_tokens)), 7, np.int32)
+
+        def Info(self):
+            return {"in_flight": 0, "queue_depth": 0}
+
+    reg = MetricsRegistry()
+    state = CoordState(sweep_interval=0.1)
+    registry = CoordRegistry(LocalCoord(state), lease_ttl=2.0)
+    srv = ActorServer("127.0.0.1", 0)
+    srv.register(_FakeGen(), "Generator")
+    srv.serve()
+    lease = registry.register("llm", "fake0", "127.0.0.1", srv.port)
+    gw = InferenceGateway(
+        registry, "llm",
+        GatewayConfig(probe_interval_s=0.1, probe_timeout_s=2.0,
+                      slo_ttft_p99_ms=150.0),
+        metrics_registry=reg)
+    try:
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and not gw.pool.healthy():
+            time.sleep(0.05)
+        assert gw.pool.healthy()
+        chaos.arm(chaos.FaultPlan([chaos.FaultSpec(
+            site="gateway.admit", action="delay", delay_s=0.2,
+            times=999)]))
+        try:
+            for _ in range(5):
+                gw.generate(np.ones((1, 8), np.int32),
+                            max_new_tokens=4)
+        finally:
+            chaos.disarm()
+        store = SeriesStore()
+        Sampler(reg, store, cadence_s=1.0, memory=False).sample_once()
+        snap = {"ts": time.time(),
+                "nodes": {"gw": {"series": store.snapshot()}},
+                "errors": {}}
+        rule = StageBreachRule(service="llm", slo_ttft_ms=150.0,
+                               min_count=4)
+        alerts = rule.evaluate(ClusterView(snap))
+        assert len(alerts) == 1, [a.message for a in alerts]
+        assert alerts[0].labels["stage"] == "queue-wait"
+    finally:
+        gw.close()
+        lease.close()
+        srv.close()
+        state.close()

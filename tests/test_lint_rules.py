@@ -1140,3 +1140,79 @@ def test_ptype_tpu_package_is_pt024_clean():
                 lint.check_file(os.path.join(dirpath, f), findings)
     pt024 = [f for f in findings if "PT024" in f]
     assert not pt024, pt024
+
+
+# ------------------------------------------------------------------ PT025
+
+
+RAW_LATENCY = (
+    "import time\n"
+    "def call(self):\n"
+    "    t0 = time.perf_counter()\n"
+    "    do()\n"
+    "    ms = (time.perf_counter() - t0) * 1e3\n"
+)
+
+
+def test_pt025_flags_adhoc_perf_counter_in_gateway(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/gateway/bad25.py",
+                      RAW_LATENCY)
+    assert any("PT025" in f for f in findings), findings
+
+
+def test_pt025_flags_from_import_alias_in_serve_engine(tmp_path):
+    src = ("from time import perf_counter as pc\n"
+           "def step():\n"
+           "    t0 = pc()\n")
+    findings = _check(tmp_path, "ptype_tpu/serve_engine/bad25.py",
+                      src)
+    assert any("PT025" in f for f in findings), findings
+
+
+def test_pt025_exempts_the_stopwatch_home(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/gateway/slo.py",
+                      RAW_LATENCY)
+    assert not any("PT025" in f for f in findings), findings
+
+
+def test_pt025_silent_outside_request_path_dirs(tmp_path):
+    findings = _check(tmp_path, "ptype_tpu/health/probe25.py",
+                      RAW_LATENCY)
+    assert not any("PT025" in f for f in findings), findings
+
+
+def test_pt025_monotonic_deadline_math_is_legal(tmp_path):
+    src = ("import time\n"
+           "def call(self, deadline_s):\n"
+           "    deadline = time.monotonic() + deadline_s\n"
+           "    while time.monotonic() < deadline:\n"
+           "        pass\n")
+    findings = _check(tmp_path, "ptype_tpu/gateway/deadline.py", src)
+    assert not any("PT025" in f for f in findings), findings
+
+
+def test_pt025_honors_suppression(tmp_path):
+    src = ("import time\n"
+           "def call(self):\n"
+           "    t0 = time.perf_counter()  # noqa: probe harness\n")
+    findings = _check(tmp_path, "ptype_tpu/gateway/sup25.py", src)
+    assert not any("PT025" in f for f in findings), findings
+
+
+def test_ptype_tpu_package_is_pt025_clean():
+    """Attribution has one home (ISSUE 20): every latency measurement
+    in gateway/ rides the Stopwatch -> SLOTracker stage seam (and
+    serve_engine/ the serving ledger), so the waterfall, exemplars,
+    and stage budgets see every millisecond a private timer would
+    have hidden."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ptype_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                lint.check_file(os.path.join(dirpath, f), findings)
+    pt025 = [f for f in findings if "PT025" in f]
+    assert not pt025, pt025

@@ -584,7 +584,10 @@ GOLDEN_TREE = {
         "import time\n"
         "def t():\n"
         "    return time.perf_counter()\n",
-        [(3, "PT010")]),
+        # PT025 (tail forensics) overlaps PT010's domain by design:
+        # an engine-side perf_counter is both a raw stamp and an
+        # unattributed latency measurement.
+        [(3, "PT010"), (3, "PT025")]),
     "ptype_tpu/serve_engine/draw.py": (
         "import jax\n"
         "def pick(key, lg):\n"

@@ -814,3 +814,67 @@ def check_pt024(ctx: FileContext) -> list[Finding]:
     findings: list[Finding] = []
     _RawTrafficRandomCheck(ctx, findings).visit(ctx.tree)
     return findings
+
+
+# ------------------------------------------------------------------ PT025
+
+
+class _AdHocLatencyCheck(ast.NodeVisitor):
+    """Flags every ``perf_counter`` call — the caller scopes WHERE."""
+
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.mods: set[str] = set()
+        self.funcs: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "time":
+                self.mods.add(a.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "perf_counter":
+                    self.funcs.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call) -> None:
+        self.findings.append(self.ctx.finding(
+            node, "PT025",
+            "ad-hoc perf_counter latency measurement in request-path "
+            "code — attribution has ONE home: gateway legs time "
+            "through gateway/slo.py Stopwatch (which feeds the "
+            "stage_ms histograms, exemplars, and the stage-breach "
+            "page), engine legs through the serving ledger's seams. "
+            "A private timer is a latency number no waterfall, "
+            "exemplar, or budget will ever see"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr == "perf_counter"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in (self.mods or {"time", "_time"})):
+            self._flag(node)
+        elif isinstance(fn, ast.Name) and fn.id in self.funcs:
+            self._flag(node)
+        self.generic_visit(node)
+
+
+@rule("PT025", "ad-hoc perf_counter latency measurement outside the "
+      "sanctioned timing seams",
+      applies=lambda ctx: (ctx.in_pkg
+                           and (ctx.in_dir("gateway")
+                                or ctx.in_dir("serve_engine"))
+                           and ctx.basename != "slo.py"))
+def check_pt025(ctx: FileContext) -> list[Finding]:
+    # gateway/slo.py is exempt by scope: it IS the sanctioned home
+    # (Stopwatch + SLOTracker). serve_engine/ additionally carries
+    # PT010 (any raw wall-clock read); PT025 adds the latency-specific
+    # story so a gateway file moved there keeps the same verdict.
+    findings: list[Finding] = []
+    _AdHocLatencyCheck(ctx, findings).visit(ctx.tree)
+    return findings
